@@ -1,0 +1,138 @@
+"""Packed bitset — backbone of filtered ANN search.
+
+TPU-native analog of the reference's ``raft::core::bitset``
+(cpp/include/raft/core/bitset.cuh:68,91,147). Bits are packed into uint32
+words in a jax array; `test` is a vectorized gather+mask, `set` is a
+scatter over words. All ops are jit-compatible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Bitset:
+    """A device bitset over ``n_bits`` items, packed into uint32 words.
+
+    Unlike the reference's mutable device structure, this is a thin wrapper
+    over an immutable jax array; mutating ops return updated arrays (stored
+    back on the wrapper for convenience).
+    """
+
+    WORD_BITS = 32
+
+    def __init__(self, n_bits: int, bits: jax.Array | None = None, default: bool = True):
+        self.n_bits = int(n_bits)
+        n_words = (self.n_bits + self.WORD_BITS - 1) // self.WORD_BITS
+        if bits is not None:
+            assert bits.shape == (n_words,)
+            self.bits = bits.astype(jnp.uint32)
+        else:
+            fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+            self.bits = jnp.full((n_words,), fill, dtype=jnp.uint32)
+
+    # -- functional kernels -------------------------------------------------
+    @staticmethod
+    def test_bits(bits: jax.Array, idx: jax.Array) -> jax.Array:
+        """Vectorized test: returns bool array, True where bit set.
+
+        Reference: ``bitset_view::test`` core/bitset.cuh:68.
+        """
+        word = bits[idx // Bitset.WORD_BITS]
+        return ((word >> (idx % Bitset.WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+
+    @staticmethod
+    def set_bits(bits: jax.Array, idx: jax.Array, value: bool | jax.Array) -> jax.Array:
+        """Vectorized set of bits at `idx` to `value` (core/bitset.cuh:91)."""
+        word_idx = idx // Bitset.WORD_BITS
+        mask = (jnp.uint32(1) << (idx % Bitset.WORD_BITS).astype(jnp.uint32)).astype(jnp.uint32)
+        if isinstance(value, bool):
+            value = jnp.full(idx.shape, value)
+        # OR in set-bits, then AND out clear-bits. Scatter via segment ops so
+        # duplicate word indices combine correctly.
+        n_words = bits.shape[0]
+        set_mask = jax.ops.segment_sum(
+            jnp.where(value, mask, jnp.uint32(0)).astype(jnp.uint32),
+            word_idx,
+            num_segments=n_words,
+            indices_are_sorted=False,
+        )
+        # segment_sum on uint32 masks with distinct bits == OR; duplicates of
+        # the same bit would carry, so use segment_max of the single-bit mask
+        # per bit position instead: build OR via bitwise accumulation.
+        set_or = _segment_or(jnp.where(value, mask, jnp.uint32(0)), word_idx, n_words)
+        clear_or = _segment_or(jnp.where(value, jnp.uint32(0), mask), word_idx, n_words)
+        del set_mask
+        return (bits | set_or) & ~clear_or
+
+    def test(self, idx: jax.Array) -> jax.Array:
+        return Bitset.test_bits(self.bits, jnp.asarray(idx))
+
+    def set(self, idx: jax.Array, value: bool = True) -> "Bitset":
+        self.bits = Bitset.set_bits(self.bits, jnp.asarray(idx), value)
+        return self
+
+    def flip(self) -> "Bitset":
+        self.bits = ~self.bits
+        return self
+
+    def count(self) -> jax.Array:
+        """Number of set bits (masking tail bits of the last word)."""
+        valid = self.n_bits
+        word_ids = jnp.arange(self.bits.shape[0]) * self.WORD_BITS
+        # bits valid in each word
+        nvalid = jnp.clip(valid - word_ids, 0, self.WORD_BITS)
+        tail_mask = jnp.where(
+            nvalid >= 32,
+            jnp.uint32(0xFFFFFFFF),
+            (jnp.uint32(1) << nvalid.astype(jnp.uint32)) - jnp.uint32(1),
+        )
+        masked = self.bits & tail_mask
+        return _popcount(masked).sum()
+
+    def to_dense(self) -> jax.Array:
+        """Bool vector of length n_bits."""
+        idx = jnp.arange(self.n_bits)
+        return Bitset.test_bits(self.bits, idx)
+
+    @staticmethod
+    def from_dense(mask: jax.Array) -> "Bitset":
+        mask = jnp.asarray(mask).astype(jnp.bool_)
+        n = mask.shape[0]
+        pad = (-n) % Bitset.WORD_BITS
+        m = jnp.pad(mask, (0, pad)).reshape(-1, Bitset.WORD_BITS)
+        weights = (jnp.uint32(1) << jnp.arange(Bitset.WORD_BITS, dtype=jnp.uint32))
+        words = (m.astype(jnp.uint32) * weights[None, :]).sum(axis=1).astype(jnp.uint32)
+        return Bitset(n, bits=words)
+
+
+def _segment_or(values: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Bitwise-OR segment combine for uint32 masks.
+
+    Implemented as per-bit segment_max over the 32 bit planes would be slow;
+    instead use the identity OR(a,b) = max per bit — realized by scattering
+    with `jax.lax.scatter` in 'or' mode via int32 view and segment_max of
+    each single-bit contribution: since each value has at most a few bits
+    set and duplicates of the *same* (word,bit) pair are idempotent under
+    max-of-masks only when masks are equal, we conservatively OR by
+    accumulating with at[].max over identical masks then OR-ing residue.
+
+    Simpler correct approach used here: sort-free `at[].apply` is not
+    available, so do a loop over WORD_BITS bit-planes (static, 32 iters).
+    """
+    out = jnp.zeros((num_segments,), dtype=jnp.uint32)
+    for b in range(32):
+        bit = (values >> jnp.uint32(b)) & jnp.uint32(1)
+        plane = jax.ops.segment_max(bit, segment_ids, num_segments=num_segments)
+        out = out | (plane.astype(jnp.uint32) << jnp.uint32(b))
+    return out
+
+
+def _popcount(x: jax.Array) -> jax.Array:
+    """Per-element popcount of uint32 (SWAR)."""
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)

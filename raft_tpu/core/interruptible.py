@@ -1,0 +1,78 @@
+"""Cooperative cancellation of device synchronization points.
+
+Analog of the reference's ``raft::interruptible``
+(cpp/include/raft/core/interruptible.hpp:39-105): one token per thread,
+``cancel`` from another thread makes the target thread's next
+``synchronize`` raise. With XLA async dispatch the sync points are
+``block_until_ready`` calls; we poll the flag while waiting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    pass
+
+
+class Interruptible:
+    _tokens: Dict[int, "Interruptible"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._cancelled = threading.Event()
+
+    @classmethod
+    def get_token(cls, thread_id: int | None = None) -> "Interruptible":
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with cls._lock:
+            if tid not in cls._tokens:
+                cls._tokens[tid] = Interruptible()
+            return cls._tokens[tid]
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def check(self) -> None:
+        """Raise if cancelled, clearing the flag (one-shot like the ref)."""
+        if self._cancelled.is_set():
+            self._cancelled.clear()
+            raise InterruptedException("raft_tpu: interrupted")
+
+    def synchronize(self, arr: jax.Array, poll_s: float = 0.01) -> None:
+        """Interruptible block_until_ready (interruptible.hpp:71-100)."""
+        # jax has no timed wait; emulate with a worker thread + polling.
+        done = threading.Event()
+        err: list[BaseException] = []
+
+        def _wait():
+            try:
+                jax.block_until_ready(arr)
+            except BaseException as e:  # propagate device errors
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_wait, daemon=True)
+        t.start()
+        while not done.wait(poll_s):
+            self.check()
+        self.check()
+        if err:
+            raise err[0]
+
+
+def synchronize(arr: jax.Array) -> None:
+    Interruptible.get_token().synchronize(arr)
+
+
+def cancel(thread_id: int) -> None:
+    Interruptible.get_token(thread_id).cancel()

@@ -1,0 +1,76 @@
+"""Logging with a callback sink.
+
+Analog of the reference's spdlog-backed logger with a Python-interceptable
+callback sink (cpp/include/raft/core/logger-inl.hpp:74-112,
+core/logger-macros.hpp). We build on the stdlib ``logging`` module and keep
+the callback-sink hook so embedders can intercept records the way pylibraft
+intercepts spdlog.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Callable, Optional
+
+_FMT = "[%(levelname)s] [%(asctime)s] %(name)s: %(message)s"
+
+logger = logging.getLogger("raft_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(_FMT))
+    logger.addHandler(_h)
+    logger.setLevel(logging.WARNING)
+
+# level names matching the reference's RAFT_LEVEL_* (logger-macros.hpp)
+TRACE = 5
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARN = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+OFF = logging.CRITICAL + 10
+
+logging.addLevelName(TRACE, "TRACE")
+
+
+def set_level(level: int) -> None:
+    logger.setLevel(level)
+
+
+def set_pattern(fmt: str) -> None:
+    for h in logger.handlers:
+        h.setFormatter(logging.Formatter(fmt))
+
+
+class _CallbackHandler(logging.Handler):
+    def __init__(self, cb: Callable[[int, str], None], flush_cb: Optional[Callable[[], None]] = None):
+        super().__init__()
+        self._cb = cb
+        self._flush_cb = flush_cb
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self._cb(record.levelno, self.format(record))
+
+    def flush(self) -> None:
+        if self._flush_cb:
+            self._flush_cb()
+
+
+_callback_handler: Optional[_CallbackHandler] = None
+
+
+def set_callback(cb: Optional[Callable[[int, str], None]], flush_cb=None) -> None:
+    """Install/remove a callback sink (reference logger-inl.hpp:74 sink)."""
+    global _callback_handler
+    if _callback_handler is not None:
+        logger.removeHandler(_callback_handler)
+        _callback_handler = None
+    if cb is not None:
+        _callback_handler = _CallbackHandler(cb, flush_cb)
+        _callback_handler.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(_callback_handler)
+
+
+def log_trace(msg: str, *args) -> None:
+    logger.log(TRACE, msg, *args)

@@ -1,0 +1,173 @@
+"""The resources "handle".
+
+TPU-native analog of the reference's ``raft::resources`` /
+``raft::device_resources`` (reference: cpp/include/raft/core/resources.hpp:47,
+cpp/include/raft/core/device_resources.hpp:61). The reference handle is a
+type-indexed lazy container of CUDA streams, cuBLAS/cuSOLVER handles, and
+communicators. On TPU, XLA owns scheduling and kernel libraries, so the
+handle shrinks to:
+
+  * the target device (or sharding mesh) computations should run on,
+  * a functional RNG key (split on demand),
+  * an optional communicator (comms facade over jax collectives),
+  * a logger and workspace-size hints used by tiled algorithms.
+
+The lazy slot-registry *idea* is kept (``add_resource_factory`` /
+``get_resource``) so that comms and future subsystems can be injected the
+same way the reference injects its COMMUNICATOR slot
+(cpp/include/raft/core/resource/resource_types.hpp:29).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+class resource_type:
+    """Slot names for the lazy resource registry.
+
+    Mirrors the reference's ``enum resource_type``
+    (core/resource/resource_types.hpp:29-48); the CUDA-specific slots
+    (cublas/cusolver/stream pool/...) have no TPU analog and are absent.
+    """
+
+    DEVICE = "device"
+    MESH = "mesh"
+    COMMUNICATOR = "communicator"
+    SUB_COMMUNICATOR = "sub_communicator"
+    RNG_KEY = "rng_key"
+    WORKSPACE_LIMIT = "workspace_limit"
+    LOGGER = "logger"
+
+
+class Resources:
+    """Lazy, type-indexed resource container (reference core/resources.hpp:47).
+
+    Factories are registered per slot and instantiated on first
+    ``get_resource``. Thread-safe like the reference (which documents the
+    handle as not thread-safe for mutation but safe for reads; we just lock).
+    """
+
+    def __init__(self) -> None:
+        self._factories: dict[str, Callable[[], Any]] = {}
+        self._resources: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def add_resource_factory(self, slot: str, factory: Callable[[], Any]) -> None:
+        with self._lock:
+            self._factories[slot] = factory
+            self._resources.pop(slot, None)
+
+    def has_resource_factory(self, slot: str) -> bool:
+        with self._lock:
+            return slot in self._factories or slot in self._resources
+
+    def get_resource(self, slot: str) -> Any:
+        with self._lock:
+            if slot not in self._resources:
+                if slot not in self._factories:
+                    raise KeyError(f"no resource factory registered for slot {slot!r}")
+                self._resources[slot] = self._factories[slot]()
+            return self._resources[slot]
+
+    def set_resource(self, slot: str, value: Any) -> None:
+        with self._lock:
+            self._resources[slot] = value
+
+
+class DeviceResources(Resources):
+    """The user-facing handle (reference core/device_resources.hpp:61).
+
+    Convenience accessors over `Resources`. Where the reference exposes
+    ``get_cuda_stream``/``get_cublas_handle``, we expose the device/mesh, a
+    splittable RNG key, and the communicator.
+
+    Parameters
+    ----------
+    device : optional jax.Device — default device for placement.
+    mesh : optional jax.sharding.Mesh for distributed algorithms.
+    seed : int seed for the handle's RNG stream.
+    workspace_limit : soft cap (bytes) tiled algorithms use when picking
+        batch/tile sizes (analog of the reference's workspace memory
+        resource limit, device_resources.hpp:64-70).
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        mesh: Optional["jax.sharding.Mesh"] = None,
+        seed: int = 0,
+        workspace_limit: int = 2 * 1024**3,
+    ) -> None:
+        super().__init__()
+        self.add_resource_factory(
+            resource_type.DEVICE, lambda: device if device is not None else jax.devices()[0]
+        )
+        self.add_resource_factory(resource_type.MESH, lambda: mesh)
+        self.add_resource_factory(resource_type.RNG_KEY, lambda: jax.random.PRNGKey(seed))
+        self.add_resource_factory(resource_type.WORKSPACE_LIMIT, lambda: workspace_limit)
+
+    # -- accessors (reference: core/resource/*.hpp, 15 accessor headers) ----
+    @property
+    def device(self) -> jax.Device:
+        return self.get_resource(resource_type.DEVICE)
+
+    @property
+    def mesh(self):
+        return self.get_resource(resource_type.MESH)
+
+    def set_mesh(self, mesh) -> None:
+        self.set_resource(resource_type.MESH, mesh)
+
+    @property
+    def comms(self):
+        """The injected communicator (reference core/resource/comms.hpp)."""
+        return self.get_resource(resource_type.COMMUNICATOR)
+
+    def set_comms(self, comms) -> None:
+        self.set_resource(resource_type.COMMUNICATOR, comms)
+
+    @property
+    def workspace_limit(self) -> int:
+        return self.get_resource(resource_type.WORKSPACE_LIMIT)
+
+    def set_workspace_limit(self, nbytes: int) -> None:
+        self.set_resource(resource_type.WORKSPACE_LIMIT, nbytes)
+
+    def rng_key(self) -> jax.Array:
+        """Split and return a fresh PRNG key from the handle's stream.
+
+        Functional replacement for the reference's per-handle RngState
+        mutation — each call advances the handle's key.
+        """
+        key = self.get_resource(resource_type.RNG_KEY)
+        key, sub = jax.random.split(key)
+        self.set_resource(resource_type.RNG_KEY, key)
+        return sub
+
+    def sync(self) -> None:
+        """Block until all queued work is complete.
+
+        Analog of ``device_resources::sync_stream``; with XLA async dispatch
+        this blocks on all live arrays (used by benches for timing).
+        """
+        (jax.device_put(np.zeros(()), self.device) + 0).block_until_ready()
+
+
+# Process-wide default-handle pool: analog of device_resources_manager
+# (reference core/device_resources_manager.hpp:43) — one handle per device,
+# created on first use.
+_default_handles: dict[int, DeviceResources] = {}
+_default_lock = threading.Lock()
+
+
+def get_device_resources(device: Optional[jax.Device] = None) -> DeviceResources:
+    dev = device if device is not None else jax.devices()[0]
+    with _default_lock:
+        if dev.id not in _default_handles:
+            _default_handles[dev.id] = DeviceResources(device=dev)
+        return _default_handles[dev.id]

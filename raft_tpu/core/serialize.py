@@ -1,0 +1,103 @@
+"""NumPy-format array (de)serialization.
+
+Analog of the reference's mdspan serializer
+(cpp/include/raft/core/serialize.hpp:35,
+cpp/include/raft/core/detail/mdspan_numpy_serializer.hpp), which writes
+arrays in the NumPy ``.npy`` format so artifacts interoperate with numpy.
+We write the exact same format via numpy itself, plus small helpers for
+length-prefixed multi-array index files with version tags (the per-index
+serializers in neighbors/ build on these).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, BinaryIO
+
+import jax
+import numpy as np
+
+MAGIC = b"RAFT_TPU"
+
+
+def serialize_mdspan(fp: BinaryIO, arr) -> None:
+    """Write one array in .npy format (reference core/serialize.hpp:35)."""
+    np.save(fp, np.asarray(arr), allow_pickle=False)
+
+
+def deserialize_mdspan(fp: BinaryIO) -> np.ndarray:
+    return np.load(fp, allow_pickle=False)
+
+
+def save_npy(path: str, arr) -> None:
+    np.save(path, np.asarray(arr), allow_pickle=False)
+
+
+def load_npy(path: str) -> np.ndarray:
+    return np.load(path, allow_pickle=False)
+
+
+def serialize_scalar(fp: BinaryIO, value) -> None:
+    """Scalar serialization matching the reference's serialize_scalar idea."""
+    if isinstance(value, bool):
+        fp.write(struct.pack("<B?", 0, value))
+    elif isinstance(value, int):
+        fp.write(struct.pack("<Bq", 1, value))
+    elif isinstance(value, float):
+        fp.write(struct.pack("<Bd", 2, value))
+    elif isinstance(value, str):
+        raw = value.encode()
+        fp.write(struct.pack("<Bq", 3, len(raw)))
+        fp.write(raw)
+    else:
+        raise TypeError(f"unsupported scalar type {type(value)}")
+
+
+def deserialize_scalar(fp: BinaryIO):
+    (tag,) = struct.unpack("<B", fp.read(1))
+    if tag == 0:
+        return struct.unpack("<?", fp.read(1))[0]
+    if tag == 1:
+        return struct.unpack("<q", fp.read(8))[0]
+    if tag == 2:
+        return struct.unpack("<d", fp.read(8))[0]
+    if tag == 3:
+        (n,) = struct.unpack("<q", fp.read(8))
+        return fp.read(n).decode()
+    raise ValueError(f"bad scalar tag {tag}")
+
+
+def write_index_file(path: str, kind: str, version: int, meta: dict[str, Any], arrays: dict[str, Any]) -> None:
+    """Versioned index container: header + json meta + named .npy blocks.
+
+    Analog of the reference's per-index binary serializers with version tags
+    (neighbors/ivf_flat_serialize.cuh, detail/ivf_pq_serialize.cuh,
+    detail/cagra/cagra_serialize.cuh).
+    """
+    with open(path, "wb") as fp:
+        fp.write(MAGIC)
+        meta_blob = json.dumps(
+            {"kind": kind, "version": version, "meta": meta, "arrays": list(arrays)}
+        ).encode()
+        fp.write(struct.pack("<q", len(meta_blob)))
+        fp.write(meta_blob)
+        for name, arr in arrays.items():
+            serialize_mdspan(fp, arr)
+
+
+def read_index_file(path: str, kind: str, min_version: int = 0):
+    """Returns (version, meta, arrays-dict of numpy arrays)."""
+    with open(path, "rb") as fp:
+        magic = fp.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a raft_tpu index file")
+        (n,) = struct.unpack("<q", fp.read(8))
+        header = json.loads(fp.read(n).decode())
+        if header["kind"] != kind:
+            raise ValueError(f"{path}: expected index kind {kind!r}, found {header['kind']!r}")
+        if header["version"] < min_version:
+            raise ValueError(f"{path}: version {header['version']} < required {min_version}")
+        arrays = {name: deserialize_mdspan(fp) for name in header["arrays"]}
+        return header["version"], header["meta"], arrays

@@ -1,0 +1,51 @@
+"""Profiler range annotations.
+
+Analog of the reference's NVTX ranges (cpp/include/raft/core/nvtx.hpp:48-96:
+RAII ``range`` + ``push_range``/``pop_range``), mapped onto
+``jax.profiler.TraceAnnotation`` so ranges show up in XLA/TPU profiler
+traces. Disabled cheaply when profiling is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Iterator
+
+import jax
+
+_range_stack: list[Any] = []
+
+
+@contextlib.contextmanager
+def annotate(name: str, **kwargs) -> Iterator[None]:
+    """RAII-style range (reference nvtx.hpp ``common::nvtx::range``)."""
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield
+
+
+def push_range(name: str) -> None:
+    t = jax.profiler.TraceAnnotation(name)
+    t.__enter__()
+    _range_stack.append(t)
+
+
+def pop_range() -> None:
+    if _range_stack:
+        _range_stack.pop().__exit__(None, None, None)
+
+
+def annotated(name: str | None = None):
+    """Decorator adding a trace annotation around a function."""
+
+    def deco(fn):
+        label = name or f"raft_tpu.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,43 @@
+"""Distance layer (SURVEY.md §2.6): pairwise distances over all reference
+metrics, fused 1-NN argmin, masked NN, and gram kernels."""
+
+from raft_tpu.distance.types import (
+    DistanceType,
+    KernelParams,
+    KernelType,
+    METRIC_NAMES,
+    is_min_close,
+    resolve_metric,
+)
+from raft_tpu.distance.pairwise import pairwise_distance, distance
+from raft_tpu.distance.fused_l2_nn import (
+    fused_l2_nn_argmin,
+    fused_l2_nn_min_reduce,
+    masked_l2_nn_argmin,
+)
+from raft_tpu.distance.kernels import (
+    gram_matrix,
+    linear_kernel,
+    polynomial_kernel,
+    rbf_kernel,
+    tanh_kernel,
+)
+
+__all__ = [
+    "DistanceType",
+    "KernelParams",
+    "KernelType",
+    "METRIC_NAMES",
+    "is_min_close",
+    "resolve_metric",
+    "pairwise_distance",
+    "distance",
+    "fused_l2_nn_argmin",
+    "fused_l2_nn_min_reduce",
+    "masked_l2_nn_argmin",
+    "gram_matrix",
+    "linear_kernel",
+    "polynomial_kernel",
+    "rbf_kernel",
+    "tanh_kernel",
+]

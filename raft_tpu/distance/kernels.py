@@ -1,0 +1,49 @@
+"""Gram (kernel) matrices: linear / polynomial / RBF / tanh.
+
+Analog of the reference's gram kernels
+(cpp/include/raft/distance/kernels.cuh, detail/kernels/ — SVM-style kernel
+matrices). All four are GEMM + elementwise epilogue → pure MXU work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.types import KernelParams, KernelType
+from raft_tpu.utils.precision import dist_dot
+
+
+def linear_kernel(x, y) -> jax.Array:
+    return dist_dot(jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32).T)
+
+
+def polynomial_kernel(x, y, gamma: float = 1.0, coef0: float = 0.0, degree: int = 3) -> jax.Array:
+    return (gamma * linear_kernel(x, y) + coef0) ** degree
+
+
+def tanh_kernel(x, y, gamma: float = 1.0, coef0: float = 0.0) -> jax.Array:
+    return jnp.tanh(gamma * linear_kernel(x, y) + coef0)
+
+
+def rbf_kernel(x, y, gamma: float = 1.0) -> jax.Array:
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    dot = dist_dot(x, y.T)
+    xn = jnp.sum(x * x, axis=1)
+    yn = jnp.sum(y * y, axis=1)
+    d2 = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * dot, 0.0)
+    return jnp.exp(-gamma * d2)
+
+
+def gram_matrix(x, y, params: KernelParams) -> jax.Array:
+    """Dispatch on KernelParams (reference detail/kernels/gram_matrix.cuh)."""
+    if params.kernel == KernelType.LINEAR:
+        return linear_kernel(x, y)
+    if params.kernel == KernelType.POLYNOMIAL:
+        return polynomial_kernel(x, y, params.gamma, params.coef0, params.degree)
+    if params.kernel == KernelType.RBF:
+        return rbf_kernel(x, y, params.gamma)
+    if params.kernel == KernelType.TANH:
+        return tanh_kernel(x, y, params.gamma, params.coef0)
+    raise ValueError(f"unknown kernel {params.kernel}")

@@ -1,0 +1,102 @@
+"""Distance metric types.
+
+Mirrors the reference's ``raft::distance::DistanceType``
+(cpp/include/raft/distance/distance_types.hpp:23-67) including enum values,
+plus ``is_min_close`` (:72) and the gram-kernel params (:87-104).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class DistanceType(enum.IntEnum):
+    L2Expanded = 0
+    L2SqrtExpanded = 1
+    CosineExpanded = 2
+    L1 = 3
+    L2Unexpanded = 4
+    L2SqrtUnexpanded = 5
+    InnerProduct = 6
+    Linf = 7
+    Canberra = 8
+    LpUnexpanded = 9
+    CorrelationExpanded = 10
+    JaccardExpanded = 11
+    HellingerExpanded = 12
+    Haversine = 13
+    BrayCurtis = 14
+    JensenShannon = 15
+    HammingUnexpanded = 16
+    KLDivergence = 17
+    RusselRaoExpanded = 18
+    DiceExpanded = 19
+    Precomputed = 100
+
+
+# pylibraft-compatible metric name aliases
+# (python/pylibraft/pylibraft/distance/pairwise_distance.pyx DISTANCE_TYPES)
+METRIC_NAMES: dict[str, DistanceType] = {
+    "sqeuclidean": DistanceType.L2Expanded,
+    "l2": DistanceType.L2SqrtExpanded,
+    "euclidean": DistanceType.L2SqrtExpanded,
+    "l2_expanded": DistanceType.L2Expanded,
+    "l2_sqrt_expanded": DistanceType.L2SqrtExpanded,
+    "cosine": DistanceType.CosineExpanded,
+    "l1": DistanceType.L1,
+    "cityblock": DistanceType.L1,
+    "manhattan": DistanceType.L1,
+    "l2_unexpanded": DistanceType.L2Unexpanded,
+    "l2_sqrt_unexpanded": DistanceType.L2SqrtUnexpanded,
+    "inner_product": DistanceType.InnerProduct,
+    "dot": DistanceType.InnerProduct,
+    "chebyshev": DistanceType.Linf,
+    "linf": DistanceType.Linf,
+    "canberra": DistanceType.Canberra,
+    "minkowski": DistanceType.LpUnexpanded,
+    "lp": DistanceType.LpUnexpanded,
+    "correlation": DistanceType.CorrelationExpanded,
+    "jaccard": DistanceType.JaccardExpanded,
+    "hellinger": DistanceType.HellingerExpanded,
+    "haversine": DistanceType.Haversine,
+    "braycurtis": DistanceType.BrayCurtis,
+    "jensenshannon": DistanceType.JensenShannon,
+    "hamming": DistanceType.HammingUnexpanded,
+    "kl_divergence": DistanceType.KLDivergence,
+    "russellrao": DistanceType.RusselRaoExpanded,
+    "dice": DistanceType.DiceExpanded,
+}
+
+
+def resolve_metric(metric) -> DistanceType:
+    if isinstance(metric, DistanceType):
+        return metric
+    if isinstance(metric, int):
+        return DistanceType(metric)
+    name = str(metric).lower()
+    if name not in METRIC_NAMES:
+        raise ValueError(f"unknown metric {metric!r}; known: {sorted(METRIC_NAMES)}")
+    return METRIC_NAMES[name]
+
+
+def is_min_close(metric: DistanceType) -> bool:
+    """True if smaller distance = more similar (distance_types.hpp:72)."""
+    return metric != DistanceType.InnerProduct
+
+
+class KernelType(enum.IntEnum):
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclass
+class KernelParams:
+    """Gram kernel params (distance_types.hpp:98-104)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0

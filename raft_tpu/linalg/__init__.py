@@ -1,0 +1,39 @@
+"""Linear algebra layer (SURVEY.md §2.3).
+
+The reference wraps cuBLAS/cuSOLVER (linalg/gemm.cuh, svd.cuh, eig.cuh,
+qr.cuh) and hand-writes an elementwise/map/reduce kernel family. On TPU the
+decompositions come from ``jax.lax.linalg``/``jnp.linalg`` (XLA-native) and
+the elementwise/reduce family is free in XLA — these wrappers exist to give
+consumers the reference's API surface with jit-compatible semantics.
+"""
+
+from raft_tpu.linalg.blas import gemm, gemv, axpy, dot
+from raft_tpu.linalg.decomp import svd, rsvd, eig, eigh, qr, lstsq, cholesky, cholesky_r1_update
+from raft_tpu.linalg.reduce import (
+    add,
+    binary_op,
+    coalesced_reduction,
+    map_op,
+    map_reduce,
+    matrix_vector_op,
+    mean_squared_error,
+    multiply,
+    norm,
+    normalize,
+    reduce,
+    reduce_cols_by_key,
+    reduce_rows_by_key,
+    strided_reduction,
+    subtract,
+    unary_op,
+)
+from raft_tpu.linalg.lanczos import lanczos_eigsh
+
+__all__ = [
+    "gemm", "gemv", "axpy", "dot",
+    "svd", "rsvd", "eig", "eigh", "qr", "lstsq", "cholesky", "cholesky_r1_update",
+    "add", "binary_op", "coalesced_reduction", "map_op", "map_reduce",
+    "matrix_vector_op", "mean_squared_error", "multiply", "norm", "normalize",
+    "reduce", "reduce_cols_by_key", "reduce_rows_by_key", "strided_reduction",
+    "subtract", "unary_op", "lanczos_eigsh",
+]

@@ -1,0 +1,38 @@
+"""BLAS-level ops (reference linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh —
+cuBLAS wrappers there; MXU matmuls here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gemm(a, b, alpha: float = 1.0, beta: float = 0.0, c=None, trans_a=False, trans_b=False) -> jax.Array:
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = alpha * jnp.dot(a, b, preferred_element_type=jnp.float32)
+    if c is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(c)
+    return out
+
+
+def gemv(a, x, alpha: float = 1.0, beta: float = 0.0, y=None, trans=False) -> jax.Array:
+    a = jnp.asarray(a)
+    if trans:
+        a = a.T
+    out = alpha * jnp.dot(a, jnp.asarray(x), preferred_element_type=jnp.float32)
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out
+
+
+def axpy(alpha: float, x, y) -> jax.Array:
+    return alpha * jnp.asarray(x) + jnp.asarray(y)
+
+
+def dot(x, y) -> jax.Array:
+    return jnp.dot(jnp.asarray(x), jnp.asarray(y), preferred_element_type=jnp.float32)

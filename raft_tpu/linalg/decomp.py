@@ -1,0 +1,92 @@
+"""Dense decompositions (reference linalg/{svd,rsvd,eig,qr,lstsq}.cuh —
+cuSOLVER wrappers there; XLA-native factorizations here)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def svd(a, full_matrices: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (U, S, V) with a = U @ diag(S) @ V.T (reference svd.cuh
+    svdQR convention returns V not V^T; we match that)."""
+    u, s, vt = jnp.linalg.svd(jnp.asarray(a, jnp.float32), full_matrices=full_matrices)
+    return u, s, vt.T
+
+
+def rsvd(a, k: int, p: int = 10, n_iter: int = 2, key=None):
+    """Randomized SVD (reference linalg/rsvd.cuh): range finding with power
+    iterations then exact SVD on the small projection."""
+    a = jnp.asarray(a, jnp.float32)
+    m, n = a.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    l = min(k + p, n)
+    omega = jax.random.normal(key, (n, l), jnp.float32)
+    y = a @ omega
+    for _ in range(n_iter):
+        y = a @ (a.T @ y)
+    q, _ = jnp.linalg.qr(y)
+    b = q.T @ a
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :k], s[:k], vt[:k].T
+
+
+def eigh(a) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric eigendecomposition, ascending eigenvalues
+    (reference linalg/eig.cuh eigDC)."""
+    w, v = jnp.linalg.eigh(jnp.asarray(a, jnp.float32))
+    return w, v
+
+
+# reference eig.cuh only handles symmetric matrices (cusolverDnsyevd)
+eig = eigh
+
+
+def qr(a) -> Tuple[jax.Array, jax.Array]:
+    return jnp.linalg.qr(jnp.asarray(a, jnp.float32))
+
+
+def lstsq(a, b) -> jax.Array:
+    """Least squares via normal equations w/ QR fallback semantics
+    (reference linalg/lstsq.cuh lstsqEig/lstsqSvdQR)."""
+    sol, *_ = jnp.linalg.lstsq(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32))
+    return sol
+
+
+def cholesky(a, lower: bool = True) -> jax.Array:
+    c = jnp.linalg.cholesky(jnp.asarray(a, jnp.float32))
+    return c if lower else c.T
+
+
+def cholesky_r1_update(l, x, lower: bool = True) -> jax.Array:
+    """Rank-1 Cholesky update: chol(A + x x^T) given L = chol(A)
+    (reference linalg/cholesky_r1_update.cuh). Small-n host-style loop is
+    fine — the reference also runs O(n^2) sequential updates."""
+    l = jnp.asarray(l, jnp.float32)
+    if not lower:
+        l = l.T
+    x = jnp.asarray(x, jnp.float32).copy()
+    n = l.shape[0]
+
+    def body(carry, k):
+        l, x = carry
+        lkk = l[k, k]
+        xk = x[k]
+        r = jnp.sqrt(lkk * lkk + xk * xk)
+        c = r / lkk
+        s = xk / lkk
+        row = l[:, k]
+        idx = jnp.arange(n)
+        below = idx > k
+        new_col = jnp.where(below, (row + s * x) / c, row)
+        new_col = new_col.at[k].set(r)
+        x = jnp.where(below, c * x - s * new_col, x)
+        l = l.at[:, k].set(new_col)
+        return (l, x), None
+
+    (l, _), _ = jax.lax.scan(body, (l, x), jnp.arange(n))
+    return l if lower else l.T

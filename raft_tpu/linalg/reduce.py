@@ -1,0 +1,112 @@
+"""Elementwise / map / reduce engine.
+
+The reference hand-writes this family as CUDA kernels
+(linalg/{unary_op,binary_op,map,map_reduce,reduce,coalesced_reduction,
+strided_reduction,norm,normalize,matrix_vector_op,reduce_rows_by_key,
+reduce_cols_by_key}.cuh). In XLA all of it is fused automatically; these
+wrappers preserve the reference's API names and semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def unary_op(x, op: Callable) -> jax.Array:
+    return op(jnp.asarray(x))
+
+
+def binary_op(x, y, op: Callable) -> jax.Array:
+    return op(jnp.asarray(x), jnp.asarray(y))
+
+
+def map_op(op: Callable, *arrays) -> jax.Array:
+    return op(*[jnp.asarray(a) for a in arrays])
+
+
+def map_reduce(x, map_fn: Callable, reduce_fn: Callable = jnp.sum, axis=None) -> jax.Array:
+    return reduce_fn(map_fn(jnp.asarray(x)), axis=axis)
+
+
+def add(x, y):
+    return jnp.asarray(x) + jnp.asarray(y)
+
+
+def subtract(x, y):
+    return jnp.asarray(x) - jnp.asarray(y)
+
+
+def multiply(x, y):
+    return jnp.asarray(x) * jnp.asarray(y)
+
+
+def reduce(x, axis=1, op: Callable = jnp.sum, map_fn: Callable | None = None):
+    """Row/col reduction with optional pre-map (reference linalg/reduce.cuh)."""
+    x = jnp.asarray(x)
+    if map_fn is not None:
+        x = map_fn(x)
+    return op(x, axis=axis)
+
+
+def coalesced_reduction(x, op: Callable = jnp.sum):
+    """Reduce along the contiguous (last) axis (linalg/coalesced_reduction.cuh)."""
+    return op(jnp.asarray(x), axis=-1)
+
+
+def strided_reduction(x, op: Callable = jnp.sum):
+    """Reduce along the strided (first) axis (linalg/strided_reduction.cuh)."""
+    return op(jnp.asarray(x), axis=0)
+
+
+def norm(x, norm_type: str = "l2", axis: int = 1, sqrt: bool = False) -> jax.Array:
+    x = jnp.asarray(x)
+    if norm_type == "l2":
+        out = jnp.sum(x * x, axis=axis)
+        return jnp.sqrt(out) if sqrt else out
+    if norm_type == "l1":
+        return jnp.sum(jnp.abs(x), axis=axis)
+    if norm_type == "linf":
+        return jnp.max(jnp.abs(x), axis=axis)
+    raise ValueError(norm_type)
+
+
+def normalize(x, axis: int = 1, norm_type: str = "l2", eps: float = 1e-12) -> jax.Array:
+    x = jnp.asarray(x)
+    if norm_type == "l2":
+        n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    elif norm_type == "l1":
+        n = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    else:
+        raise ValueError(norm_type)
+    return x / jnp.maximum(n, eps)
+
+
+def matrix_vector_op(matrix, vec, op: Callable = jnp.add, along_rows: bool = True) -> jax.Array:
+    """Broadcast op of a vector over a matrix (linalg/matrix_vector_op.cuh).
+    along_rows=True: vec has one entry per column."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    return op(m, v[None, :] if along_rows else v[:, None])
+
+
+def reduce_rows_by_key(x, keys, n_keys: int, weights=None) -> jax.Array:
+    """Sum rows sharing a key (linalg/reduce_rows_by_key.cuh) → [n_keys, d]."""
+    x = jnp.asarray(x)
+    if weights is not None:
+        x = x * jnp.asarray(weights)[:, None]
+    return jax.ops.segment_sum(x, jnp.asarray(keys), num_segments=n_keys)
+
+
+def reduce_cols_by_key(x, keys, n_keys: int) -> jax.Array:
+    """Sum columns sharing a key (linalg/reduce_cols_by_key.cuh) → [rows, n_keys]."""
+    x = jnp.asarray(x)
+    return jax.ops.segment_sum(x.T, jnp.asarray(keys), num_segments=n_keys).T
+
+
+def mean_squared_error(a, b) -> jax.Array:
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    return jnp.mean((a - b) ** 2)

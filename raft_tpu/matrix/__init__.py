@@ -1,0 +1,38 @@
+"""Matrix layer (SURVEY.md §2.4): utilities + the select_k top-k engine."""
+
+from raft_tpu.matrix.select_k import select_k, select_k_threshold
+from raft_tpu.matrix.ops import (
+    argmax,
+    argmin,
+    col_wise_sort,
+    eye,
+    gather,
+    gather_if,
+    init,
+    linewise_op,
+    norm,
+    reverse,
+    scatter,
+    slice_matrix,
+    triangular_lower,
+    triangular_upper,
+)
+
+__all__ = [
+    "select_k",
+    "select_k_threshold",
+    "argmax",
+    "argmin",
+    "col_wise_sort",
+    "eye",
+    "gather",
+    "gather_if",
+    "init",
+    "linewise_op",
+    "norm",
+    "reverse",
+    "scatter",
+    "slice_matrix",
+    "triangular_lower",
+    "triangular_upper",
+]

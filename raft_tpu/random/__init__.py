@@ -1,0 +1,25 @@
+"""Random layer (SURVEY.md §2.5): RNG state + dataset generators."""
+
+from raft_tpu.random.rng import RngState, uniform, normal, randint, bernoulli
+from raft_tpu.random.generators import (
+    make_blobs,
+    make_regression,
+    multi_variable_gaussian,
+    permute,
+    rmat_rectangular_generator,
+    sample_without_replacement,
+)
+
+__all__ = [
+    "RngState",
+    "uniform",
+    "normal",
+    "randint",
+    "bernoulli",
+    "make_blobs",
+    "make_regression",
+    "multi_variable_gaussian",
+    "permute",
+    "rmat_rectangular_generator",
+    "sample_without_replacement",
+]

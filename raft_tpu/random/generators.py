@@ -1,0 +1,151 @@
+"""Dataset generators.
+
+Analogs of the reference's random generators (SURVEY.md §2.5):
+make_blobs.cuh, make_regression.cuh, multi_variable_gaussian.cuh,
+rmat_rectangular_generator.cuh (pylibraft-exposed), permute.cuh,
+sample_without_replacement.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _as_key
+
+
+def make_blobs(
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers=None,
+    shuffle: bool = True,
+    seed: int | RngState | jax.Array = 0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Isotropic Gaussian blobs (reference random/make_blobs.cuh).
+
+    Returns (X [n_samples, n_features], labels [n_samples]).
+    """
+    key = _as_key(seed)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            k1, (n_clusters, n_features), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1],
+        )
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(k2, (n_samples,), 0, n_clusters)
+    noise = cluster_std * jax.random.normal(k3, (n_samples, n_features), dtype=dtype)
+    x = centers[labels] + noise
+    if shuffle:
+        perm = jax.random.permutation(k4, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels.astype(jnp.int32)
+
+
+def make_regression(
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    seed: int | RngState | jax.Array = 0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model regression data (reference random/make_regression.cuh).
+    Returns (X, y, coef)."""
+    key = _as_key(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    n_informative = n_informative if n_informative is not None else n_features
+    x = jax.random.normal(k1, (n_samples, n_features), dtype=dtype)
+    coef = jnp.zeros((n_features, n_targets), dtype)
+    coef = coef.at[:n_informative].set(
+        100.0 * jax.random.uniform(k2, (n_informative, n_targets), dtype=dtype)
+    )
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(k3, y.shape, dtype=dtype)
+    return x, y.squeeze(), coef.squeeze()
+
+
+def multi_variable_gaussian(mean, cov, n_samples: int, seed=0, dtype=jnp.float32) -> jax.Array:
+    """Samples from N(mean, cov) (reference random/multi_variable_gaussian.cuh)."""
+    key = _as_key(seed)
+    mean = jnp.asarray(mean, dtype)
+    cov = jnp.asarray(cov, dtype)
+    return jax.random.multivariate_normal(key, mean, cov, (n_samples,), dtype=dtype)
+
+
+def permute(x, seed=0) -> Tuple[jax.Array, jax.Array]:
+    """Random row permutation (reference random/permute.cuh).
+    Returns (permuted_rows, permutation)."""
+    x = jnp.asarray(x)
+    key = _as_key(seed)
+    perm = jax.random.permutation(key, x.shape[0])
+    return x[perm], perm.astype(jnp.int32)
+
+
+def sample_without_replacement(n_population: int, n_samples: int, weights=None, seed=0) -> jax.Array:
+    """Weighted sampling w/o replacement via Gumbel top-k — the same
+    one-pass trick as the reference's per-item keyed selection
+    (random/sample_without_replacement.cuh)."""
+    key = _as_key(seed)
+    if weights is None:
+        return jax.random.permutation(key, n_population)[:n_samples].astype(jnp.int32)
+    logw = jnp.log(jnp.maximum(jnp.asarray(weights, jnp.float32), 1e-30))
+    g = jax.random.gumbel(key, (n_population,))
+    _, idx = jax.lax.top_k(logw + g, n_samples)
+    return idx.astype(jnp.int32)
+
+
+def rmat_rectangular_generator(
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    theta=None,
+    seed=0,
+) -> Tuple[jax.Array, jax.Array]:
+    """R-MAT graph generator (reference
+    random/rmat_rectangular_generator.cuh; pylibraft
+    random/rmat_rectangular_generator.pyx).
+
+    theta: [max(r_scale,c_scale), 4] per-level quadrant probabilities
+    (a,b,c,d) or a flat [4] reused per level (defaults to the classic
+    0.57/0.19/0.19/0.05). Returns (src [n_edges], dst [n_edges]).
+
+    Each of the scale levels doubles the row/col space; per edge and level a
+    quadrant is drawn and its bit appended — expressed as a vectorized scan
+    over levels (no per-edge loops).
+    """
+    key = _as_key(seed)
+    max_scale = max(r_scale, c_scale)
+    if theta is None:
+        theta = jnp.tile(jnp.asarray([0.57, 0.19, 0.19, 0.05], jnp.float32), (max_scale, 1))
+    else:
+        theta = jnp.asarray(theta, jnp.float32)
+        if theta.ndim == 1:
+            theta = jnp.tile(theta[None, :], (max_scale, 1))
+    probs = theta / theta.sum(axis=1, keepdims=True)
+
+    u = jax.random.uniform(key, (max_scale, n_edges))
+    cum = jnp.cumsum(probs, axis=1)
+    quad = (u[:, :, None] > cum[:, None, :]).sum(axis=2)  # [levels, edges] in 0..3
+    row_bit = (quad >= 2).astype(jnp.int64)  # c,d quadrants go down
+    col_bit = (quad % 2).astype(jnp.int64)   # b,d quadrants go right
+
+    levels = jnp.arange(max_scale)
+    r_active = (levels < r_scale)[:, None]
+    c_active = (levels < c_scale)[:, None]
+    r_weights = jnp.where(r_active, 1 << jnp.minimum(r_scale - 1 - levels, 62), 0)
+    c_weights = jnp.where(c_active, 1 << jnp.minimum(c_scale - 1 - levels, 62), 0)
+    src = (row_bit * r_weights).sum(axis=0)
+    dst = (col_bit * c_weights).sum(axis=0)
+    return src.astype(jnp.int64), dst.astype(jnp.int64)

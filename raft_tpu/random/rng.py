@@ -1,0 +1,52 @@
+"""RNG state.
+
+Analog of the reference's ``RngState`` with Philox/PCG generators
+(random/rng_state.hpp:28-38, rng_device.cuh). JAX's counter-based threefry
+serves the same role (reproducible, parallel-safe); `RngState` wraps a key
+with the reference's seed/advance semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RngState:
+    """Mutable key holder mirroring raft::random::RngState(seed)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.key = jax.random.PRNGKey(self.seed)
+
+    def advance(self, n: int = 1) -> None:
+        for _ in range(n):
+            self.key, _ = jax.random.split(self.key)
+
+    def next_key(self) -> jax.Array:
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def _as_key(state) -> jax.Array:
+    if isinstance(state, RngState):
+        return state.next_key()
+    if isinstance(state, int):
+        return jax.random.PRNGKey(state)
+    return state  # assume PRNGKey
+
+
+def uniform(state, shape, low=0.0, high=1.0, dtype=jnp.float32) -> jax.Array:
+    return jax.random.uniform(_as_key(state), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def normal(state, shape, mu=0.0, sigma=1.0, dtype=jnp.float32) -> jax.Array:
+    return mu + sigma * jax.random.normal(_as_key(state), shape, dtype=dtype)
+
+
+def randint(state, shape, low, high, dtype=jnp.int32) -> jax.Array:
+    return jax.random.randint(_as_key(state), shape, low, high, dtype=dtype)
+
+
+def bernoulli(state, shape, p=0.5) -> jax.Array:
+    return jax.random.bernoulli(_as_key(state), p, shape)

@@ -1,0 +1,31 @@
+"""Utility toolbox: tiling/alignment math and small helpers.
+
+TPU analog of the reference's ``cpp/include/raft/util`` (SURVEY.md §2.2).
+Most of the reference's device toolbox (warp shuffles, vectorized loads,
+atomics) is absorbed by XLA/Pallas; what carries over is the Pow2 tiling
+math (util/pow2_utils.cuh), integer utilities (util/integer_utils.hpp), and
+batching helpers used by tiled host-side drivers.
+"""
+
+from raft_tpu.utils.math import (
+    Pow2,
+    round_up_to_multiple,
+    round_down_to_multiple,
+    cdiv,
+    is_pow2,
+    next_pow2,
+    bound_by_power_of_two,
+)
+from raft_tpu.utils.batch import batch_ranges, BatchLoadIterator
+
+__all__ = [
+    "Pow2",
+    "round_up_to_multiple",
+    "round_down_to_multiple",
+    "cdiv",
+    "is_pow2",
+    "next_pow2",
+    "bound_by_power_of_two",
+    "batch_ranges",
+    "BatchLoadIterator",
+]

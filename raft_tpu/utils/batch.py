@@ -1,0 +1,61 @@
+"""Host↔device batched streaming helpers.
+
+Analog of the reference's ``batch_load_iterator``
+(cpp/include/raft/spatial/knn/detail/ann_utils.cuh:397), which streams
+out-of-core host datasets to the device in fixed-size batches during index
+builds. Here batches are numpy slices moved with ``jax.device_put``; a
+one-slot prefetch overlaps host slicing with device work (XLA's async
+dispatch provides the device-side overlap).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def batch_ranges(n: int, batch_size: int):
+    """Yield (start, stop) covering [0, n) in chunks of batch_size."""
+    for start in range(0, n, batch_size):
+        yield start, min(start + batch_size, n)
+
+
+class BatchLoadIterator:
+    """Iterate device-resident batches of a host array.
+
+    Yields ``(offset, device_batch)``. The final batch may be shorter; pass
+    ``pad_to_full=True`` to zero-pad it to ``batch_size`` (static shapes →
+    one XLA compilation for all batches).
+    """
+
+    def __init__(
+        self,
+        host_array: np.ndarray,
+        batch_size: int,
+        device: Optional[jax.Device] = None,
+        pad_to_full: bool = False,
+    ):
+        self.host = host_array
+        self.batch_size = int(batch_size)
+        self.device = device
+        self.pad_to_full = pad_to_full
+
+    def __len__(self) -> int:
+        return -(-self.host.shape[0] // self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[int, jax.Array]]:
+        n = self.host.shape[0]
+        pending: Optional[Tuple[int, jax.Array]] = None
+        for start, stop in batch_ranges(n, self.batch_size):
+            chunk = self.host[start:stop]
+            if self.pad_to_full and chunk.shape[0] < self.batch_size:
+                pad = np.zeros((self.batch_size - chunk.shape[0],) + chunk.shape[1:], chunk.dtype)
+                chunk = np.concatenate([chunk, pad], axis=0)
+            dev = jax.device_put(chunk, self.device)
+            if pending is not None:
+                yield pending
+            pending = (start, dev)
+        if pending is not None:
+            yield pending

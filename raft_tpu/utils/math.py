@@ -1,0 +1,57 @@
+"""Alignment and power-of-two math (reference util/pow2_utils.cuh,
+util/integer_utils.hpp). Used throughout tiled algorithms to align block
+shapes to TPU (8,128)/(16,128) tile constraints."""
+
+from __future__ import annotations
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up_to_multiple(x: int, m: int) -> int:
+    return cdiv(x, m) * m
+
+
+def round_down_to_multiple(x: int, m: int) -> int:
+    return (x // m) * m
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def next_pow2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def bound_by_power_of_two(x: int) -> int:
+    """Largest power of two <= x (x>=1)."""
+    return 1 << (x.bit_length() - 1)
+
+
+class Pow2:
+    """Power-of-two alignment helper (reference util/pow2_utils.cuh Pow2<V>)."""
+
+    def __init__(self, value: int):
+        assert is_pow2(value), f"Pow2 requires a power of two, got {value}"
+        self.value = value
+        self.mask = value - 1
+        self.log2 = value.bit_length() - 1
+
+    def round_down(self, x: int) -> int:
+        return x & ~self.mask
+
+    def round_up(self, x: int) -> int:
+        return (x + self.mask) & ~self.mask
+
+    def div(self, x: int) -> int:
+        return x >> self.log2
+
+    def mod(self, x: int) -> int:
+        return x & self.mask
+
+    def is_aligned(self, x: int) -> bool:
+        return (x & self.mask) == 0

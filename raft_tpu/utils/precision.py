@@ -1,0 +1,30 @@
+"""Matmul precision policy for distance math.
+
+On TPU, the MXU's default fp32 matmul uses bf16 passes (~1e-2 relative
+error) — unacceptable for distance computations that feed k-selection.
+Distance GEMMs therefore default to ``Precision.HIGHEST`` (full fp32 via
+multi-pass). The intended fast path is to feed bf16 *inputs* (the TPU-KNN
+recipe): HIGHEST on bf16 operands is a single MXU pass with fp32
+accumulation, which is both fast and accurate enough for recall targets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_precision = jax.lax.Precision.HIGHEST
+
+
+def set_dist_precision(p) -> None:
+    global _precision
+    _precision = p
+
+
+def get_dist_precision():
+    return _precision
+
+
+def dist_dot(a, b):
+    """a @ b with fp32 accumulation at the distance-math precision policy."""
+    return jnp.dot(a, b, preferred_element_type=jnp.float32, precision=_precision)

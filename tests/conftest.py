@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+The reference tests all require a GPU (SURVEY.md §4); our analog of its
+`LocalCUDACluster` multi-GPU-without-a-cluster strategy is JAX's virtual
+multi-device CPU host — sharding/collective tests run on an 8-device mesh
+with no TPU attached. Must be set before jax is imported anywhere.
+"""
+
+import os
+
+# Env-var JAX_PLATFORMS does not override the axon TPU plugin; the config
+# update below does. XLA_FLAGS must still be set before backend init.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    return Mesh(devs, ("shard",))

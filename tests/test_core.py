@@ -1,0 +1,63 @@
+import io
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.core import (
+    Bitset,
+    DeviceResources,
+    deserialize_mdspan,
+    serialize_mdspan,
+)
+from raft_tpu.core.resources import get_device_resources
+from raft_tpu.core.serialize import read_index_file, write_index_file
+
+
+def test_resources_lazy_slots():
+    h = DeviceResources(seed=7)
+    k1 = h.rng_key()
+    k2 = h.rng_key()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    h.set_workspace_limit(123)
+    assert h.workspace_limit == 123
+
+
+def test_default_handle_pool():
+    h1 = get_device_resources()
+    h2 = get_device_resources()
+    assert h1 is h2
+
+
+def test_serialize_roundtrip(rng, tmp_path):
+    arr = rng.standard_normal((5, 7)).astype(np.float32)
+    buf = io.BytesIO()
+    serialize_mdspan(buf, arr)
+    buf.seek(0)
+    out = deserialize_mdspan(buf)
+    np.testing.assert_array_equal(arr, out)
+
+    p = str(tmp_path / "idx.bin")
+    write_index_file(p, "test_index", 3, {"metric": "l2"}, {"a": arr, "b": np.arange(4)})
+    version, meta, arrays = read_index_file(p, "test_index")
+    assert version == 3 and meta["metric"] == "l2"
+    np.testing.assert_array_equal(arrays["a"], arr)
+
+
+def test_bitset(rng):
+    n = 100
+    bs = Bitset(n, default=False)
+    assert int(bs.count()) == 0
+    idx = jnp.asarray([0, 5, 31, 32, 63, 99])
+    bs.set(idx, True)
+    assert int(bs.count()) == 6
+    tested = np.asarray(bs.test(jnp.asarray([0, 1, 5, 99, 98])))
+    np.testing.assert_array_equal(tested, [True, False, True, True, False])
+    bs.flip()
+    assert int(bs.count()) == n - 6
+
+
+def test_bitset_from_dense(rng):
+    mask = rng.random(77) < 0.5
+    bs = Bitset.from_dense(mask)
+    np.testing.assert_array_equal(np.asarray(bs.to_dense()), mask)
+    assert int(bs.count()) == mask.sum()

@@ -1,0 +1,85 @@
+"""pairwise_distance vs numpy/scipy oracles — the analog of the reference's
+per-metric distance tests (cpp/test/distance/dist_*.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance import DistanceType, pairwise_distance, is_min_close
+from tests.oracles import naive_pairwise
+
+GENERAL_METRICS = [
+    "sqeuclidean", "euclidean", "l1", "chebyshev", "inner_product",
+    "cosine", "correlation", "canberra", "minkowski", "braycurtis", "hamming",
+]
+POSITIVE_METRICS = ["jensenshannon", "hellinger", "kl_divergence"]
+BOOLEAN_METRICS = ["russellrao", "jaccard", "dice"]
+
+
+@pytest.mark.parametrize("metric", GENERAL_METRICS)
+@pytest.mark.parametrize("m,n,d", [(33, 47, 17), (128, 256, 64)])
+def test_general_metrics(rng, metric, m, n, d):
+    x = rng.standard_normal((m, d)).astype(np.float32)
+    y = rng.standard_normal((n, d)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric, metric_arg=3.0))
+    want = naive_pairwise(x, y, metric, p=3.0)
+    # expanded-form metrics accumulate in fp32 (MXU) vs the fp64 oracle —
+    # same tolerance story as the reference's distance tests
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("metric", POSITIVE_METRICS)
+def test_positive_metrics(rng, metric):
+    m, n, d = 40, 50, 32
+    x = rng.random((m, d)).astype(np.float32) + 0.01
+    y = rng.random((n, d)).astype(np.float32) + 0.01
+    if metric in ("jensenshannon", "hellinger", "kl_divergence"):
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric))
+    want = naive_pairwise(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("metric", BOOLEAN_METRICS)
+def test_boolean_metrics(rng, metric):
+    m, n, d = 30, 35, 64
+    x = (rng.random((m, d)) < 0.3).astype(np.float32)
+    y = (rng.random((n, d)) < 0.3).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric))
+    want = naive_pairwise(x, y, metric)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_haversine(rng):
+    x = np.stack([
+        rng.uniform(-np.pi / 2, np.pi / 2, 20),
+        rng.uniform(-np.pi, np.pi, 20),
+    ], axis=1).astype(np.float32)
+    y = np.stack([
+        rng.uniform(-np.pi / 2, np.pi / 2, 25),
+        rng.uniform(-np.pi, np.pi, 25),
+    ], axis=1).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, "haversine"))
+    want = naive_pairwise(x, y, "haversine")
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_tiled_matches_untiled(rng):
+    # elementwise path with forced small tiles must equal one-shot result
+    x = rng.standard_normal((70, 19)).astype(np.float32)
+    y = rng.standard_normal((90, 19)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, "l1", tile_m=16, tile_n=32))
+    want = naive_pairwise(x, y, "l1")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_is_min_close():
+    assert not is_min_close(DistanceType.InnerProduct)
+    assert is_min_close(DistanceType.L2Expanded)
+
+
+def test_l2_self_distance_zero(rng):
+    x = rng.standard_normal((50, 33)).astype(np.float32)
+    d = np.asarray(pairwise_distance(x, x, "sqeuclidean"))
+    assert (np.diag(d) >= 0).all()
+    assert np.diag(d).max() < 1e-2

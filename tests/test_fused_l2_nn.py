@@ -1,0 +1,42 @@
+import numpy as np
+import pytest
+
+from raft_tpu.distance import fused_l2_nn_argmin, masked_l2_nn_argmin
+from tests.oracles import naive_pairwise
+
+
+@pytest.mark.parametrize("m,n,d", [(100, 37, 16), (257, 1000, 64)])
+@pytest.mark.parametrize("sqrt", [False, True])
+def test_fused_l2_nn(rng, m, n, d, sqrt):
+    x = rng.standard_normal((m, d)).astype(np.float32)
+    y = rng.standard_normal((n, d)).astype(np.float32)
+    val, idx = fused_l2_nn_argmin(x, y, sqrt=sqrt)
+    val, idx = np.asarray(val), np.asarray(idx)
+    dist = naive_pairwise(x, y, "sqeuclidean")
+    want_idx = dist.argmin(axis=1)
+    want_val = dist.min(axis=1)
+    if sqrt:
+        want_val = np.sqrt(want_val)
+    np.testing.assert_array_equal(idx, want_idx)
+    np.testing.assert_allclose(val, want_val, rtol=1e-3, atol=1e-3)
+
+
+def test_fused_l2_nn_tiled_matches(rng):
+    x = rng.standard_normal((64, 24)).astype(np.float32)
+    y = rng.standard_normal((999, 24)).astype(np.float32)
+    val_t, idx_t = fused_l2_nn_argmin(x, y, tile_n=128)
+    val_f, idx_f = fused_l2_nn_argmin(x, y)
+    np.testing.assert_array_equal(np.asarray(idx_t), np.asarray(idx_f))
+    np.testing.assert_allclose(np.asarray(val_t), np.asarray(val_f), rtol=1e-5)
+
+
+def test_masked_l2_nn(rng):
+    m, n, d = 40, 60, 8
+    x = rng.standard_normal((m, d)).astype(np.float32)
+    y = rng.standard_normal((n, d)).astype(np.float32)
+    adj = rng.random((m, n)) < 0.5
+    adj[:, 0] = True  # no empty rows
+    val, idx = masked_l2_nn_argmin(x, y, adj)
+    dist = naive_pairwise(x, y, "sqeuclidean")
+    dist[~adj] = np.inf
+    np.testing.assert_array_equal(np.asarray(idx), dist.argmin(axis=1))

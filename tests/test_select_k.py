@@ -1,0 +1,49 @@
+"""select_k vs numpy sort — analog of cpp/test/matrix select_k suites which
+cross-check every algo against a reference implementation."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.matrix import select_k
+from raft_tpu.matrix.select_k import select_k_threshold
+
+
+@pytest.mark.parametrize("batch,n,k", [(1, 100, 5), (16, 1000, 32), (4, 257, 256), (3, 4096, 1000)])
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k(rng, batch, n, k, select_min):
+    x = rng.standard_normal((batch, n)).astype(np.float32)
+    vals, idxs = select_k(x, k, select_min=select_min)
+    vals, idxs = np.asarray(vals), np.asarray(idxs)
+    order = np.argsort(x if select_min else -x, axis=1)[:, :k]
+    want = np.take_along_axis(x, order, axis=1)
+    np.testing.assert_allclose(np.sort(vals, axis=1), np.sort(want, axis=1), rtol=1e-6)
+    # indices must point at the right values
+    np.testing.assert_allclose(np.take_along_axis(x, idxs, axis=1), vals)
+
+
+def test_select_k_with_in_idx(rng):
+    x = rng.standard_normal((4, 50)).astype(np.float32)
+    src = rng.integers(0, 10_000, (4, 50)).astype(np.int32)
+    vals, idxs = select_k(x, 7, in_idx=src)
+    idxs = np.asarray(idxs)
+    # every returned index must come from the source-index map
+    for b in range(4):
+        assert set(idxs[b].tolist()) <= set(src[b].tolist())
+
+
+def test_select_k_1d(rng):
+    x = rng.standard_normal(64).astype(np.float32)
+    vals, idxs = select_k(x, 4)
+    assert vals.shape == (4,)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(x)[:4], rtol=1e-6)
+
+
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_threshold_path(rng, select_min):
+    x = rng.standard_normal((4, 8192)).astype(np.float32)
+    k = 500
+    vals, idxs = select_k_threshold(x, k, select_min=select_min)
+    vals = np.asarray(vals)
+    want = np.sort(x, axis=1)
+    want = want[:, :k] if select_min else want[:, ::-1][:, :k]
+    np.testing.assert_allclose(np.sort(vals, axis=1), np.sort(want, axis=1), rtol=1e-5)
