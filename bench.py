@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Current headline: IVF-Flat-class search throughput on a synthetic SIFT-1M
+workload. Until IVF-Flat lands, falls back to brute-force KNN on SIFT-10K
+(BASELINE.md north-star config #1). Runs on whatever jax.devices()[0] is
+(the real TPU chip under the driver).
+
+Baseline (vs_baseline denominator): see BASELINE.md — A100-class reference
+throughput for the same config. Values are estimates until the reference
+harness is run on GPU hardware; documented per-config in _BASELINES.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+# Estimated A100/raft-24.02 reference throughputs (queries/s) for the
+# BASELINE.md north-star configs. Marked estimates: the reference publishes
+# no numeric tables (BASELINE.md), so these are FLOP/bandwidth-derived
+# A100 figures to normalize against until real GPU runs are recorded.
+_BASELINES = {
+    "bruteforce_sift10k_qps": 2.0e6,   # 10k x 10k x 128 L2 + top-k, batch 10k
+    "ivfflat_sift1m_qps": 4.0e5,       # nlist=1024, nprobe=64, batch 10k, r@10>0.95
+}
+
+
+def _sift_like(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    # SIFT-ish: non-negative, clustered-ish fp32
+    centers = rng.uniform(0, 128, (64, d))
+    x = centers[rng.integers(0, 64, n)] + rng.normal(0, 12, (n, d))
+    return np.clip(x, 0, 255).astype(np.float32)
+
+
+def bench_bruteforce_sift10k():
+    import jax
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.bench.harness import compute_recall, time_fn
+    from tests.oracles import naive_knn  # numpy oracle
+
+    n, d, nq, k = 10_000, 128, 10_000, 10
+    x = jax.device_put(_sift_like(n, d, seed=1))
+    q = jax.device_put(_sift_like(nq, d, seed=2))
+
+    index = brute_force.build(x, "sqeuclidean")
+    dist, idx = brute_force.search(index, q, k)
+    jax.block_until_ready(idx)
+
+    # recall sanity on a subset (exact method -> ~1.0)
+    sub = 500
+    _, want = naive_knn(np.asarray(q[:sub]), np.asarray(x), k)
+    recall = compute_recall(np.asarray(idx[:sub]), want)
+
+    search_s = time_fn(lambda: brute_force.search(index, q, k)[1], iters=20, warmup=3)
+    qps = nq / search_s
+    return {
+        "metric": "bruteforce_sift10k_qps",
+        "value": round(qps, 1),
+        "unit": "QPS (k=10, batch=10k, L2, recall=%.3f)" % recall,
+        "vs_baseline": round(qps / _BASELINES["bruteforce_sift10k_qps"], 3),
+    }
+
+
+def bench_ivfflat_sift1m():
+    import jax
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.bench.harness import compute_recall, time_fn
+
+    n, d, nq, k = 1_000_000, 128, 10_000, 10
+    x = jax.device_put(_sift_like(n, d, seed=1))
+    q = jax.device_put(_sift_like(nq, d, seed=2))
+
+    params = ivf_flat.IndexParams(n_lists=1024, metric="sqeuclidean")
+    index = ivf_flat.build(params, x)
+    sp = ivf_flat.SearchParams(n_probes=64)
+    dist, idx = ivf_flat.search(sp, index, q, k)
+    jax.block_until_ready(idx)
+
+    # recall vs exact on a query subset
+    sub = 1000
+    _, bf_idx = brute_force.knn(q[:sub], x, k)
+    recall = compute_recall(np.asarray(idx[:sub]), np.asarray(bf_idx))
+
+    search_s = time_fn(lambda: ivf_flat.search(sp, index, q, k)[1], iters=20, warmup=3)
+    qps = nq / search_s
+    return {
+        "metric": "ivfflat_sift1m_qps",
+        "value": round(qps, 1),
+        "unit": "QPS (nlist=1024, nprobe=64, k=10, batch=10k, recall=%.3f)" % recall,
+        "vs_baseline": round(qps / _BASELINES["ivfflat_sift1m_qps"], 3),
+    }
+
+
+def main():
+    try:
+        from raft_tpu.neighbors import ivf_flat  # noqa: F401
+        result = bench_ivfflat_sift1m()
+    except ImportError:
+        result = bench_bruteforce_sift10k()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

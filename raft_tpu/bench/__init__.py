@@ -1,0 +1,24 @@
+"""Benchmark harness (SURVEY.md §2.16): dataset IO + ANN bench driver."""
+
+from raft_tpu.bench.datasets import read_bin, write_bin, read_groundtruth, write_groundtruth
+from raft_tpu.bench.harness import (
+    BenchResult,
+    compute_recall,
+    export_csv,
+    pareto_frontier,
+    run_case,
+    time_fn,
+)
+
+__all__ = [
+    "read_bin",
+    "write_bin",
+    "read_groundtruth",
+    "write_groundtruth",
+    "BenchResult",
+    "compute_recall",
+    "export_csv",
+    "pareto_frontier",
+    "run_case",
+    "time_fn",
+]

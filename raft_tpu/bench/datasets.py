@@ -1,0 +1,83 @@
+"""ANN benchmark dataset I/O.
+
+Analog of the reference bench harness's dataset loaders
+(cpp/bench/ann/src/common/dataset.hpp:45-128): ``.fbin`` / ``.u8bin`` /
+``.i8bin`` binary files — a header of two little-endian uint32 (n_rows,
+n_cols) followed by row-major data — memory-mapped with optional row
+subsets. Ground-truth neighbor files use the same container with int32/
+float32 payloads (bigann convention).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SUFFIX_DTYPES = {
+    ".fbin": np.float32,
+    ".u8bin": np.uint8,
+    ".i8bin": np.int8,
+    ".ibin": np.int32,
+}
+
+
+def _dtype_for(path: str, dtype=None):
+    if dtype is not None:
+        return np.dtype(dtype)
+    for suffix, dt in _SUFFIX_DTYPES.items():
+        if path.endswith(suffix):
+            return np.dtype(dt)
+    raise ValueError(f"cannot infer dtype from {path!r}; pass dtype=")
+
+
+def read_bin(
+    path: str,
+    dtype=None,
+    rows: Optional[Tuple[int, int]] = None,
+    mmap: bool = True,
+) -> np.ndarray:
+    """Read a *.bin dataset; ``rows=(start, count)`` selects a subset
+    (reference dataset.hpp subset support)."""
+    dt = _dtype_for(path, dtype)
+    with open(path, "rb") as fp:
+        header = np.fromfile(fp, dtype=np.uint32, count=2)
+        n, d = int(header[0]), int(header[1])
+    offset = 8
+    if mmap:
+        arr = np.memmap(path, dtype=dt, mode="r", offset=offset, shape=(n, d))
+    else:
+        with open(path, "rb") as fp:
+            fp.seek(offset)
+            arr = np.fromfile(fp, dtype=dt).reshape(n, d)
+    if rows is not None:
+        start, count = rows
+        arr = arr[start : start + count]
+    return arr
+
+
+def write_bin(path: str, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    with open(path, "wb") as fp:
+        np.asarray(arr.shape, dtype=np.uint32).tofile(fp)
+        arr.tofile(fp)
+
+
+def read_groundtruth(prefix: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read bigann-style groundtruth: ``<prefix>.neighbors.ibin`` +
+    ``<prefix>.distances.fbin`` (raft-ann-bench generate_groundtruth
+    layout)."""
+    neighbors = read_bin(prefix + ".neighbors.ibin")
+    distances = (
+        read_bin(prefix + ".distances.fbin")
+        if os.path.exists(prefix + ".distances.fbin")
+        else None
+    )
+    return np.asarray(neighbors), None if distances is None else np.asarray(distances)
+
+
+def write_groundtruth(prefix: str, neighbors: np.ndarray, distances: Optional[np.ndarray] = None) -> None:
+    write_bin(prefix + ".neighbors.ibin", neighbors.astype(np.int32))
+    if distances is not None:
+        write_bin(prefix + ".distances.fbin", distances.astype(np.float32))

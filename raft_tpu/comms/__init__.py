@@ -1,0 +1,47 @@
+"""Distributed communication layer (SURVEY.md §2.12).
+
+The reference exposes a virtual ``comms_iface`` (allreduce/bcast/allgather/
+reducescatter/p2p/comm_split/barrier — core/comms.hpp:123-230) implemented
+over NCCL+UCX (comms/std_comms.hpp) or MPI (comms/mpi_comms.hpp), injected
+into the handle. The TPU-native equivalent keeps the facade but implements
+every collective with ``jax.lax`` primitives over a mesh axis inside
+``shard_map`` — XLA lowers them onto ICI rings (and DCN across slices), so
+there is no NCCL/UCX analog to manage and no streams to sync.
+
+Use: build a ``Comms`` from a mesh axis; inside ``shard_map``-decorated
+functions call its methods (they are thin names over jax.lax collectives);
+``comm_split`` maps to operating on a sub-axis of the mesh.
+"""
+
+from raft_tpu.comms.comms import Comms, default_mesh, local_handle
+from raft_tpu.comms.ops import (
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    device_multicast_sendrecv,
+    device_sendrecv,
+    gather,
+    reduce,
+    reducescatter,
+)
+from raft_tpu.comms.sharded import sharded_knn, sharded_pairwise_distance
+
+__all__ = [
+    "Comms",
+    "default_mesh",
+    "local_handle",
+    "allreduce",
+    "allgather",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "reduce",
+    "gather",
+    "reducescatter",
+    "device_sendrecv",
+    "device_multicast_sendrecv",
+    "sharded_knn",
+    "sharded_pairwise_distance",
+]

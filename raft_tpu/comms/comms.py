@@ -1,0 +1,106 @@
+"""Comms facade + bootstrap.
+
+``Comms`` is the analog of the reference's value-facade ``comms_t``
+(core/comms.hpp:252) bound to a mesh axis, injected into the handle the way
+std_comms is injected via the COMMUNICATOR slot
+(core/resource/comms.hpp). The raft-dask bootstrap
+(python/raft-dask/raft_dask/common/comms.py:173 ``Comms.init`` + NCCL
+unique-id broadcast) collapses to: construct a Mesh (single-host) or call
+``jax.distributed.initialize`` (multi-host) — the TPU runtime owns rank
+discovery, so there is no unique-id exchange to implement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.comms import ops as _ops
+from raft_tpu.core.resources import DeviceResources
+
+
+def default_mesh(n_devices: Optional[int] = None, axis_name: str = "shard") -> Mesh:
+    """Build a 1-D mesh over the first n devices (all by default)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+class Comms:
+    """Communicator bound to one mesh axis (reference comms_t).
+
+    rank/size are static per-callsite inside shard_map; the collective
+    methods simply forward to raft_tpu.comms.ops with the bound axis.
+    ``comm_split`` returns a Comms on another axis of the same mesh —
+    the reference's sub-communicator concept (core/comms.hpp comm_split;
+    SUB_COMMUNICATOR slot).
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str = "shard"):
+        if axis_name not in mesh.axis_names:
+            raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def rank(self):
+        """Callable only inside shard_map (like comms_t::get_rank on-device)."""
+        return jax.lax.axis_index(self.axis_name)
+
+    def comm_split(self, axis_name: str) -> "Comms":
+        return Comms(self.mesh, axis_name)
+
+    # -- collectives (inside shard_map) ------------------------------------
+    def allreduce(self, x, op: str = "sum"):
+        return _ops.allreduce(x, self.axis_name, op)
+
+    def bcast(self, x, root: int = 0):
+        return _ops.bcast(x, self.axis_name, root)
+
+    def reduce(self, x, root: int = 0, op: str = "sum"):
+        return _ops.reduce(x, self.axis_name, root, op)
+
+    def allgather(self, x, axis: int = 0, tiled: bool = False):
+        return _ops.allgather(x, self.axis_name, axis, tiled)
+
+    def gather(self, x, root: int = 0, axis: int = 0):
+        return _ops.gather(x, self.axis_name, root, axis)
+
+    def reducescatter(self, x, scatter_axis: int = 0):
+        return _ops.reducescatter(x, self.axis_name, scatter_axis)
+
+    def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        return _ops.alltoall(x, self.axis_name, split_axis, concat_axis)
+
+    def device_sendrecv(self, x, shift: int = 1):
+        return _ops.device_sendrecv(x, self.axis_name, shift)
+
+    def barrier(self):
+        return _ops.barrier(self.axis_name)
+
+
+def local_handle(
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "shard",
+    seed: int = 0,
+) -> DeviceResources:
+    """Handle with an injected communicator — the raft-dask
+    ``local_handle(sessionId)`` analog (raft_dask/common/comms.py:248)."""
+    mesh = mesh if mesh is not None else default_mesh(axis_name=axis_name)
+    h = DeviceResources(seed=seed, mesh=mesh)
+    h.set_comms(Comms(mesh, axis_name))
+    return h
+
+
+def init_multihost(coordinator_address: Optional[str] = None, **kwargs) -> None:
+    """Multi-host bootstrap: the raft-dask Comms.init analog. On TPU pods
+    ``jax.distributed.initialize`` discovers peers from the runtime; no
+    NCCL unique-id broadcast is needed."""
+    jax.distributed.initialize(coordinator_address=coordinator_address, **kwargs)

@@ -1,0 +1,104 @@
+"""Sharded (multi-chip) algorithms over a device mesh.
+
+The reference's multi-GPU model (SURVEY.md §2.18): each rank holds an index
+shard; queries are replicated; per-shard top-k results are merged. Consumers
+wire it with raft-dask + NCCL. Here the whole pattern is one ``shard_map``:
+the dataset is sharded over the mesh axis, each device runs the local
+search, and the shard top-ks are all-gathered and merged on-device over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.common import merge_topk
+
+
+def sharded_knn(
+    queries,
+    dataset,
+    k: int,
+    mesh: Mesh,
+    axis_name: str = "shard",
+    metric="sqeuclidean",
+    metric_arg: float = 2.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN with the dataset row-sharded over ``mesh[axis_name]``.
+
+    Dataset rows must be divisible by the axis size (pad upstream). Queries
+    are replicated; each shard computes a local top-k with *global* ids
+    (rank offset added), then shard results are all-gathered and merged —
+    the knn_merge_parts-over-NCCL pattern
+    (detail/knn_merge_parts.cuh + raft-dask) as a single XLA program.
+    """
+    metric = resolve_metric(metric)
+    queries = jnp.asarray(queries)
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    nshards = mesh.shape[axis_name]
+    if n % nshards != 0:
+        raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
+    shard_rows = n // nshards
+    select_min = is_min_close(metric)
+
+    def local(q, db_shard):
+        rank = jax.lax.axis_index(axis_name)
+        d, i = brute_force._search(
+            q, db_shard, None, None, None, int(k), int(metric), float(metric_arg),
+            int(min(shard_rows, 8192)),
+        )
+        i = i + (rank * shard_rows).astype(i.dtype)
+        # gather all shards' candidates onto every device, merge locally
+        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)  # [m, S*k]
+        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
+        return merge_topk(gd, gi, k, select_min)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)(queries, dataset)
+
+
+def sharded_pairwise_distance(
+    x,
+    y,
+    mesh: Mesh,
+    axis_name: str = "shard",
+    metric="sqeuclidean",
+    metric_arg: float = 2.0,
+) -> jax.Array:
+    """Pairwise distance with x row-sharded over the mesh: each device
+    computes its row block against replicated y; the result stays sharded
+    (the caller sees one logical [m, n] array)."""
+    from raft_tpu.distance.pairwise import _pairwise
+
+    metric = resolve_metric(metric)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    nshards = mesh.shape[axis_name]
+    if x.shape[0] % nshards != 0:
+        raise ValueError(f"x rows {x.shape[0]} not divisible by mesh axis {nshards}")
+
+    def local(xs, yr):
+        return _pairwise(xs, yr, int(metric), float(metric_arg), None, None)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P()),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)(x, y)

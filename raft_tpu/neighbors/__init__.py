@@ -1,0 +1,23 @@
+"""Neighbors layer — the core product (SURVEY.md §2.9)."""
+
+from raft_tpu.neighbors import brute_force, refine as _refine_mod
+from raft_tpu.neighbors.common import (
+    BitsetFilter,
+    IndexParams,
+    NoneSampleFilter,
+    SearchParams,
+    knn_merge_parts,
+    merge_topk,
+)
+from raft_tpu.neighbors.refine import refine
+
+__all__ = [
+    "brute_force",
+    "refine",
+    "BitsetFilter",
+    "IndexParams",
+    "NoneSampleFilter",
+    "SearchParams",
+    "knn_merge_parts",
+    "merge_topk",
+]

@@ -1,0 +1,246 @@
+"""Brute-force (exact) KNN.
+
+TPU-native analog of the reference's brute_force index
+(cpp/include/raft/neighbors/brute_force.cuh,
+detail/knn_brute_force.cuh:325 ``brute_force_knn_impl``,
+detail/knn_brute_force.cuh:59 ``tiled_brute_force_knn``). The reference
+tiles the dataset, runs pairwise distance + select_k per tile, and merges
+per-tile top-ks; chunks go across a CUDA stream pool. Here the same tiling
+is a ``lax.scan`` carrying a running top-k: each step is one MXU GEMM (+
+epilogue) fused with the merge, so memory stays at n_queries × tile and XLA
+pipelines the steps. The reference's separate "fused L2 kNN" small-k path
+(spatial/knn/detail/fused_l2_knn-inl.cuh) is subsumed — the scan *is* the
+fusion of distance and selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.serialize import read_index_file, write_index_file
+from raft_tpu.distance.pairwise import _block_distance, _EXPANDED, _expanded_path
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.utils.math import round_up_to_multiple
+from raft_tpu.utils.precision import dist_dot
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class Index:
+    """Brute-force index (reference brute_force_types.hpp): the dataset plus
+    precomputed norms for expanded metrics."""
+
+    dataset: jax.Array
+    metric: DistanceType
+    metric_arg: float = 2.0
+    norms: Optional[jax.Array] = None
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+
+def build(dataset, metric="sqeuclidean", metric_arg: float = 2.0) -> Index:
+    """Build a brute-force index (reference brute_force-inl.cuh:345)."""
+    metric = resolve_metric(metric)
+    dataset = jnp.asarray(dataset)
+    norms = None
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
+        ds32 = dataset.astype(jnp.float32)
+        norms = jnp.sum(ds32 * ds32, axis=1)
+    return Index(dataset=dataset, metric=metric, metric_arg=metric_arg, norms=norms)
+
+
+def search(
+    index: Index,
+    queries,
+    k: int,
+    prefilter=None,
+    tile_n: Optional[int] = None,
+    fast: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact k-NN search (reference brute_force-inl.cuh:156 ``knn``).
+
+    Returns (distances [n_queries, k], indices [n_queries, k]), best-first.
+    ``prefilter``: optional Bitset / filter over dataset row ids
+    (reference filtered brute-force via sample_filter).
+
+    ``fast=True`` enables the TPU-first two-phase path (TPU-KNN recipe,
+    PAPERS.md): candidate generation with bf16 MXU matmuls at ~4× the
+    candidates, then exact fp32 re-ranking — recovers exact-search recall
+    at bf16 throughput. Only affects L2/IP/cosine expanded metrics.
+    """
+    queries = jnp.asarray(queries)
+    n = index.size
+    if not 0 < k <= n:
+        raise ValueError(f"k={k} out of range for dataset size {n}")
+    filt = as_filter(prefilter)
+    filter_bits = getattr(filt, "bitset", None)
+    if tile_n is None:
+        budget = (128 * 1024 * 1024) // 4
+        tile_n = min(n, max(1024, budget // max(queries.shape[0], 1)))
+        tile_n = min(tile_n, 65536)
+
+    fast_ok = fast and index.metric in (
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.CosineExpanded,
+        DistanceType.InnerProduct,
+    )
+    if fast_ok:
+        from raft_tpu.neighbors.refine import refine as _refine
+
+        k_cand = min(n, max(4 * k, k + 32))
+        _, cand = _search(
+            queries.astype(jnp.bfloat16),
+            index.dataset.astype(jnp.bfloat16),
+            index.norms,
+            None if filter_bits is None else filter_bits.bits,
+            None if filter_bits is None else filter_bits.n_bits,
+            int(k_cand),
+            int(index.metric),
+            float(index.metric_arg),
+            int(min(tile_n, n)),
+        )
+        return _refine(index.dataset, queries, cand, k, index.metric)
+
+    return _search(
+        queries,
+        index.dataset,
+        index.norms,
+        None if filter_bits is None else filter_bits.bits,
+        None if filter_bits is None else filter_bits.n_bits,
+        int(k),
+        int(index.metric),
+        float(index.metric_arg),
+        int(min(tile_n, n)),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
+def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p, tile_n):
+    metric = DistanceType(metric_val)
+    select_min = is_min_close(metric)
+    compute = jnp.promote_types(queries.dtype, jnp.float32)
+    q = queries.astype(compute)
+    n, d = dataset.shape
+    m = q.shape[0]
+    sentinel = sentinel_for(metric, compute)
+
+    if tile_n >= n:
+        dists = _dist_block(q, dataset.astype(compute), metric, p, norms)
+        if filter_bits is not None:
+            dists = _apply_filter(dists, jnp.arange(n)[None, :], filter_bits, filter_nbits, sentinel)
+        return merge_topk(dists, jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (m, n)), k, select_min)
+
+    npad = round_up_to_multiple(n, tile_n)
+    ds = jnp.pad(dataset, ((0, npad - n), (0, 0))).astype(compute)
+    tiles = ds.reshape(npad // tile_n, tile_n, d)
+    norm_tiles = None
+    if norms is not None:
+        norm_tiles = jnp.pad(norms, (0, npad - n)).reshape(npad // tile_n, tile_n)
+
+    def body(carry, inp):
+        best_d, best_i = carry
+        if norm_tiles is not None:
+            t, db_tile, nt = inp
+        else:
+            t, db_tile = inp
+            nt = None
+        dists = _dist_block(q, db_tile, metric, p, nt)
+        col = (t * tile_n + jnp.arange(tile_n, dtype=jnp.int32))[None, :]
+        dists = jnp.where(col < n, dists, sentinel)
+        if filter_bits is not None:
+            dists = _apply_filter(dists, col, filter_bits, filter_nbits, sentinel)
+        cand_d = jnp.concatenate([best_d, dists], axis=1)
+        cand_i = jnp.concatenate([best_i, jnp.broadcast_to(col, (m, tile_n))], axis=1)
+        return merge_topk(cand_d, cand_i, k, select_min), None
+
+    init = (
+        jnp.full((m, k), sentinel, compute),
+        jnp.full((m, k), -1, jnp.int32),
+    )
+    xs = (jnp.arange(npad // tile_n), tiles) if norm_tiles is None else (
+        jnp.arange(npad // tile_n), tiles, norm_tiles)
+    (best_d, best_i), _ = jax.lax.scan(body, init, xs)
+    return best_d, best_i
+
+
+def _dist_block(q, db_tile, metric: DistanceType, p: float, db_norms) -> jax.Array:
+    """Distance block with optional precomputed db norms (expanded L2)."""
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        dot = dist_dot(q, db_tile.T)
+        qn = jnp.sum(q * q, axis=1)
+        yn = db_norms if db_norms is not None else jnp.sum(db_tile * db_tile, axis=1)
+        d2 = jnp.maximum(qn[:, None] + yn[None, :] - 2.0 * dot, 0.0)
+        return jnp.sqrt(d2) if metric == DistanceType.L2SqrtExpanded else d2
+    if metric in _EXPANDED:
+        return _expanded_path(q, db_tile, metric)
+    return _block_distance(q, db_tile, metric, p)
+
+
+def _apply_filter(dists, col, filter_bits, filter_nbits, sentinel):
+    from raft_tpu.core.bitset import Bitset
+
+    ids = jnp.broadcast_to(col, dists.shape)
+    safe = jnp.clip(ids, 0, filter_nbits - 1)
+    keep = Bitset.test_bits(filter_bits, safe) & (ids < filter_nbits)
+    return jnp.where(keep, dists, sentinel)
+
+
+def knn(
+    queries,
+    dataset,
+    k: int,
+    metric="sqeuclidean",
+    metric_arg: float = 2.0,
+    prefilter=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot exact KNN (reference brute_force-inl.cuh:156 free function)."""
+    return search(build(dataset, metric, metric_arg), queries, k, prefilter=prefilter)
+
+
+def fused_l2_knn(queries, dataset, k: int, sqrt: bool = False):
+    """Reference-named alias for the fused L2 path (brute_force-inl.cuh:240)."""
+    metric = DistanceType.L2SqrtExpanded if sqrt else DistanceType.L2Expanded
+    return knn(queries, dataset, k, metric)
+
+
+# --------------------------------------------------------------------------
+# Serialization (reference brute_force_serialize)
+# --------------------------------------------------------------------------
+
+
+def save(path: str, index: Index) -> None:
+    arrays = {"dataset": np.asarray(index.dataset)}
+    if index.norms is not None:
+        arrays["norms"] = np.asarray(index.norms)
+    write_index_file(
+        path,
+        "brute_force",
+        _SERIAL_VERSION,
+        {"metric": int(index.metric), "metric_arg": index.metric_arg},
+        arrays,
+    )
+
+
+def load(path: str) -> Index:
+    _, meta, arrays = read_index_file(path, "brute_force")
+    return Index(
+        dataset=jnp.asarray(arrays["dataset"]),
+        metric=DistanceType(meta["metric"]),
+        metric_arg=meta["metric_arg"],
+        norms=jnp.asarray(arrays["norms"]) if "norms" in arrays else None,
+    )

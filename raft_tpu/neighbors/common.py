@@ -1,0 +1,121 @@
+"""Shared ANN scaffolding: param structs, sample filters, search utilities.
+
+Analog of the reference's neighbors common layer (SURVEY.md §2.9):
+ann_types.hpp (index_params/search_params bases),
+sample_filter_types.hpp (none/bitset filters), and the top-k merge used by
+multi-part searches (detail/knn_merge_parts.cuh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Base build params (reference neighbors/ann_types.hpp:32-46)."""
+
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Base search params (reference neighbors/ann_types.hpp:48)."""
+
+
+# --------------------------------------------------------------------------
+# Sample filters (reference sample_filter_types.hpp)
+# --------------------------------------------------------------------------
+
+
+class NoneSampleFilter:
+    """Accept everything (reference none_ivf_sample_filter:27)."""
+
+    def mask(self, sample_ids: jax.Array) -> jax.Array:
+        return jnp.ones(sample_ids.shape, jnp.bool_)
+
+
+class BitsetFilter:
+    """Keep samples whose bit is set (reference bitset_filter)."""
+
+    def __init__(self, bitset: Bitset):
+        self.bitset = bitset
+
+    def mask(self, sample_ids: jax.Array) -> jax.Array:
+        safe = jnp.clip(sample_ids, 0, self.bitset.n_bits - 1)
+        ok = Bitset.test_bits(self.bitset.bits, safe)
+        return ok & (sample_ids >= 0) & (sample_ids < self.bitset.n_bits)
+
+
+def as_filter(f) -> NoneSampleFilter | BitsetFilter:
+    if f is None:
+        return NoneSampleFilter()
+    if isinstance(f, Bitset):
+        return BitsetFilter(f)
+    return f
+
+
+# --------------------------------------------------------------------------
+# Sentinels and top-k merge
+# --------------------------------------------------------------------------
+
+
+def sentinel_for(metric: DistanceType, dtype=jnp.float32):
+    """Worst-possible distance for masking invalid candidates."""
+    return jnp.asarray(jnp.inf if is_min_close(metric) else -jnp.inf, dtype)
+
+
+def merge_topk(
+    dists: jax.Array,
+    idxs: jax.Array,
+    k: int,
+    select_min: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge candidate lists along the last axis into a top-k.
+
+    ``dists``/``idxs``: [..., c] with c >= k. Returns ([..., k], [..., k])
+    sorted best-first. This is the XLA analog of the reference's warp-queue
+    ``knn_merge_parts`` merge kernel (detail/knn_merge_parts.cuh:33,140).
+    """
+    if select_min:
+        vals, sel = jax.lax.top_k(-dists, k)
+        vals = -vals
+    else:
+        vals, sel = jax.lax.top_k(dists, k)
+    return vals, jnp.take_along_axis(idxs, sel, axis=-1)
+
+
+def knn_merge_parts(
+    part_dists: jax.Array,
+    part_idxs: jax.Array,
+    k: Optional[int] = None,
+    select_min: bool = True,
+    translations=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge per-part KNN results into a global top-k.
+
+    ``part_dists``/``part_idxs``: [n_parts, n_queries, k_part]. Optional
+    ``translations`` [n_parts] are added to each part's indices (the
+    reference uses them to offset shard-local ids —
+    detail/knn_merge_parts.cuh:140).
+    """
+    n_parts, n_q, k_part = part_dists.shape
+    k = k if k is not None else k_part
+    if translations is not None:
+        t = jnp.asarray(translations).reshape(n_parts, 1, 1)
+        part_idxs = part_idxs + t.astype(part_idxs.dtype)
+    flat_d = jnp.transpose(part_dists, (1, 0, 2)).reshape(n_q, n_parts * k_part)
+    flat_i = jnp.transpose(part_idxs, (1, 0, 2)).reshape(n_q, n_parts * k_part)
+    return merge_topk(flat_d, flat_i, k, select_min)

@@ -1,0 +1,104 @@
+"""Brute-force KNN vs naive oracle — analog of the reference's
+tiled_brute_force/fused_l2_knn tests (cpp/test/neighbors/)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, knn_merge_parts, refine
+from tests.oracles import eval_recall, naive_knn
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "inner_product", "cosine", "l1"])
+def test_brute_force_exact(rng, metric):
+    n, m, d, k = 700, 40, 32, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    idx = brute_force.build(x, metric)
+    dist, ind = brute_force.search(idx, q, k)
+    _, want = naive_knn(q, x, k, metric)
+    assert eval_recall(np.asarray(ind), want) > 0.99
+
+
+def test_brute_force_tiled_matches_full(rng):
+    n, m, d, k = 1000, 16, 24, 8
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    idx = brute_force.build(x, "sqeuclidean")
+    d_full, i_full = brute_force.search(idx, q, k, tile_n=1000)
+    d_tile, i_tile = brute_force.search(idx, q, k, tile_n=128)
+    np.testing.assert_array_equal(np.asarray(i_full), np.asarray(i_tile))
+    np.testing.assert_allclose(np.asarray(d_full), np.asarray(d_tile), rtol=1e-5)
+
+
+def test_brute_force_prefilter(rng):
+    n, m, d, k = 300, 10, 16, 5
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    allowed = rng.random(n) < 0.5
+    bs = Bitset.from_dense(allowed)
+    idx = brute_force.build(x, "sqeuclidean")
+    _, ind = brute_force.search(idx, q, k, prefilter=bs)
+    ind = np.asarray(ind)
+    assert allowed[ind.ravel()].all()
+    # oracle on the filtered subset
+    sub = np.where(allowed)[0]
+    _, want_sub = naive_knn(q, x[sub], k)
+    want = sub[want_sub]
+    assert eval_recall(ind, want) > 0.99
+
+
+def test_knn_one_shot_and_serialize(rng, tmp_path):
+    x = rng.standard_normal((200, 8)).astype(np.float32)
+    q = rng.standard_normal((7, 8)).astype(np.float32)
+    d1, i1 = brute_force.knn(q, x, 4)
+    p = str(tmp_path / "bf.bin")
+    brute_force.save(p, brute_force.build(x, "sqeuclidean"))
+    idx = brute_force.load(p)
+    d2, i2 = brute_force.search(idx, q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_knn_merge_parts(rng):
+    # split the dataset in 3 parts, search each, merge -> must equal global
+    n, m, d, k = 600, 12, 16, 9
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    parts = np.split(x, 3)
+    pd, pi, trans = [], [], []
+    off = 0
+    for part in parts:
+        dd, ii = brute_force.knn(q, part, k)
+        pd.append(np.asarray(dd))
+        pi.append(np.asarray(ii))
+        trans.append(off)
+        off += part.shape[0]
+    md, mi = knn_merge_parts(np.stack(pd), np.stack(pi), k, translations=np.asarray(trans))
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(mi), want) > 0.99
+
+
+def test_refine(rng):
+    n, m, d, k = 500, 20, 16, 5
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    # candidates: true top-20 shuffled + some noise, with invalid (-1) slots
+    _, cand = naive_knn(q, x, 20)
+    cand = cand.astype(np.int32)
+    cand[:, -2:] = -1
+    dist, ind = refine(x, q, cand, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(ind), want) > 0.99
+    assert (np.asarray(ind) >= 0).all()
+
+
+def test_bin_io(rng, tmp_path):
+    from raft_tpu.bench import read_bin, write_bin
+
+    arr = rng.standard_normal((10, 4)).astype(np.float32)
+    p = str(tmp_path / "x.fbin")
+    write_bin(p, arr)
+    out = read_bin(p)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    sub = read_bin(p, rows=(2, 5))
+    np.testing.assert_array_equal(np.asarray(sub), arr[2:7])
