@@ -97,9 +97,10 @@ def bench_ivfflat_sift1m():
 def main():
     try:
         from raft_tpu.neighbors import ivf_flat  # noqa: F401
-        result = bench_ivfflat_sift1m()
     except ImportError:
         result = bench_bruteforce_sift10k()
+    else:
+        result = bench_ivfflat_sift1m()
     print(json.dumps(result))
 
 

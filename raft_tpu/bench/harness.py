@@ -85,7 +85,12 @@ def run_case(
 ) -> BenchResult:
     t0 = time.perf_counter()
     index = build_fn()
-    jax.block_until_ready(getattr(index, "dataset", index))
+    # block on every array the build produced (norms, list structures, ...),
+    # not just the dataset, so build_s covers the whole build
+    leaves = [
+        v for v in vars(index).values() if isinstance(v, jax.Array)
+    ] if hasattr(index, "__dict__") else [index]
+    jax.block_until_ready(leaves)
     build_s = time.perf_counter() - t0
 
     dist, idx = search_fn(index)
@@ -120,10 +125,9 @@ def export_csv(results: List[BenchResult], path: str) -> None:
 
 def pareto_frontier(results: List[BenchResult]) -> List[BenchResult]:
     """Recall-vs-QPS Pareto frontier (raft-ann-bench plot's frontier logic)."""
-    pts = sorted(results, key=lambda r: (-r.recall, -r.qps))
     frontier: List[BenchResult] = []
     best_qps = -1.0
-    for r in sorted(pts, key=lambda r: -r.recall):
+    for r in sorted(results, key=lambda r: (-r.recall, -r.qps)):
         if r.qps > best_qps:
             frontier.append(r)
             best_qps = r.qps

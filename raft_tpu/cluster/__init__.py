@@ -1,0 +1,33 @@
+"""Clustering: Lloyd k-means, hierarchical balanced k-means, single-linkage.
+
+Reference layer: cpp/include/raft/cluster/ (SURVEY.md §2.8).
+"""
+
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import (
+    KMeansParams,
+    cluster_cost,
+    compute_new_centroids,
+    find_k,
+    fit,
+    fit_predict,
+    init_plus_plus,
+    predict,
+    transform,
+)
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+
+__all__ = [
+    "kmeans",
+    "kmeans_balanced",
+    "KMeansParams",
+    "KMeansBalancedParams",
+    "fit",
+    "predict",
+    "fit_predict",
+    "transform",
+    "cluster_cost",
+    "compute_new_centroids",
+    "init_plus_plus",
+    "find_k",
+]

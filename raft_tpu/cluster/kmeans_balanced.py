@@ -1,0 +1,230 @@
+"""Hierarchical balanced k-means — the ANN coarse quantizer trainer.
+
+TPU-native analog of the reference's ``raft::cluster::kmeans_balanced``
+(cpp/include/raft/cluster/kmeans_balanced.cuh:76,134,199; impl
+cpp/include/raft/cluster/detail/kmeans_balanced.cuh). The reference trains
+IVF coarse centroids with a two-level scheme: fit sqrt(C) "mesoclusters"
+over the trainset, partition the C fine clusters among mesoclusters
+proportionally to their size, fit each mesocluster's points into its share
+of fine clusters, then run balancing iterations over the full set with
+starved-cluster reseeding (``adjust_centers``,
+detail/kmeans_balanced.cuh:524).
+
+TPU design: predict is fused-L2-NN (MXU GEMM + argmin epilogue); center
+update is the one-hot-matmul accumulation from ``cluster.kmeans``; the
+per-mesocluster gathers are host-orchestrated (data-dependent shapes) while
+every inner loop is a single jitted program. ``adjust_centers`` is
+vectorized: starved clusters are reseeded from random data rows in one
+``where`` instead of the reference's serial host loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster.kmeans import _centers_and_sizes, _predict_labels
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.utils.precision import dist_dot
+
+
+@dataclasses.dataclass
+class KMeansBalancedParams:
+    """Aggregate params (reference kmeans_balanced_params: n_iters, metric)."""
+
+    n_clusters: int = 8
+    n_iters: int = 20
+    metric: DistanceType = DistanceType.L2Expanded
+    seed: int = 0
+
+
+def _as_f32(x) -> jax.Array:
+    return jnp.asarray(x).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _predict_metric(x, centers, metric: int, batch_rows: int = 1 << 16):
+    """Nearest-center labels under L2 or InnerProduct (reference
+    detail/kmeans_balanced.cuh:371 predict)."""
+    if metric == int(DistanceType.InnerProduct):
+        scores = dist_dot(x, centers.T)
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+    labels, _ = _predict_labels(x, centers, batch_rows)
+    return labels
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _balancing_em_iter(x, centers, key, ratio_threshold, n_clusters: int):
+    """One predict → update → adjust_centers iteration, fully jitted.
+
+    ``adjust_centers`` (reference detail/kmeans_balanced.cuh:524): clusters
+    whose size falls below ``ratio_threshold x average`` are reseeded from a
+    random data row, pulling centers out of starvation so list sizes stay
+    balanced (what "balanced" k-means means here).
+    """
+    n = x.shape[0]
+    labels, _ = _predict_labels(x, centers, min(n, 1 << 16))
+    sums, sizes = _centers_and_sizes(x, labels, None, n_clusters, min(n, 1 << 16))
+    new_centers = jnp.where(
+        sizes[:, None] > 0, sums / jnp.maximum(sizes, 1.0)[:, None], centers
+    )
+    average = jnp.float32(n) / jnp.float32(n_clusters)
+    starved = sizes < ratio_threshold * average
+    reseed_rows = jax.random.randint(key, (n_clusters,), 0, n)
+    new_centers = jnp.where(starved[:, None], x[reseed_rows], new_centers)
+    return new_centers, sizes, starved.sum()
+
+
+def build_clusters(
+    x,
+    n_clusters: int,
+    n_iters: int,
+    key,
+    metric: DistanceType = DistanceType.L2Expanded,
+    init_centers=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """EM-balanced clustering of one dataset (reference
+    detail/kmeans_balanced.cuh:705 build_clusters).
+
+    Returns (centers [C, d] f32, sizes [C] f32)."""
+    x = _as_f32(x)
+    n = x.shape[0]
+    if init_centers is None:
+        key, sub = jax.random.split(key)
+        idx = jax.random.choice(sub, n, shape=(n_clusters,), replace=n < n_clusters)
+        centers = x[idx]
+    else:
+        centers = _as_f32(init_centers)
+    # the reference decays the reseed threshold over iterations so late
+    # iterations converge; early iterations rebalance aggressively
+    sizes = jnp.zeros((n_clusters,), jnp.float32)
+    for it in range(n_iters):
+        key, sub = jax.random.split(key)
+        ratio = jnp.float32(0.25 * (1.0 - it / max(n_iters, 1)))
+        centers, sizes, _ = _balancing_em_iter(x, centers, sub, ratio, n_clusters)
+    return centers, sizes
+
+
+def _arrange_fine_clusters(
+    n_clusters: int, n_mesoclusters: int, meso_sizes: np.ndarray
+) -> np.ndarray:
+    """Partition C fine clusters among mesoclusters proportional to size
+    (reference detail/kmeans_balanced.cuh:758 arrange_fine_clusters).
+
+    Guarantees each nonempty mesocluster gets >= 1 and the counts sum to C.
+    """
+    meso_sizes = meso_sizes.astype(np.float64)
+    total = max(meso_sizes.sum(), 1.0)
+    counts = np.zeros(n_mesoclusters, np.int64)
+    remaining_c, remaining_n = n_clusters, total
+    order = np.argsort(-meso_sizes)  # largest first, like the reference
+    for i in order:
+        if remaining_c <= 0:
+            break
+        c = int(round(remaining_c * meso_sizes[i] / max(remaining_n, 1.0)))
+        c = max(1 if meso_sizes[i] > 0 else 0, min(c, remaining_c))
+        counts[i] = c
+        remaining_c -= c
+        remaining_n -= meso_sizes[i]
+    # dump any remainder on the largest mesocluster
+    if remaining_c > 0:
+        counts[order[0]] += remaining_c
+    return counts
+
+
+def build_hierarchical(
+    x,
+    n_clusters: int,
+    n_iters: int = 20,
+    metric: DistanceType = DistanceType.L2Expanded,
+    seed: int = 0,
+) -> jax.Array:
+    """Two-level balanced training (reference
+    detail/kmeans_balanced.cuh:955 build_hierarchical). Returns centers."""
+    x_np = np.asarray(x, dtype=np.float32)
+    n, d = x_np.shape
+    key = jax.random.PRNGKey(seed)
+
+    n_meso = int(math.ceil(math.sqrt(n_clusters)))
+    if n_clusters <= n_meso or n <= 4 * n_clusters:
+        centers, _ = build_clusters(x_np, n_clusters, n_iters, key, metric)
+        return centers
+
+    x_dev = jnp.asarray(x_np)
+    key, k_meso = jax.random.split(key)
+    meso_centers, _ = build_clusters(x_dev, n_meso, n_iters, k_meso, metric)
+    meso_labels = np.asarray(
+        _predict_metric(x_dev, meso_centers, int(metric), min(n, 1 << 16))
+    )
+    meso_sizes = np.bincount(meso_labels, minlength=n_meso)
+    fine_counts = _arrange_fine_clusters(n_clusters, n_meso, meso_sizes)
+
+    fine_centers = []
+    for m in range(n_meso):
+        c = int(fine_counts[m])
+        if c == 0:
+            continue
+        rows = x_np[meso_labels == m]
+        if rows.shape[0] == 0:
+            # empty mesocluster that was assigned clusters: random reseed
+            key, sub = jax.random.split(key)
+            idx = jax.random.choice(sub, n, shape=(c,))
+            fine_centers.append(x_np[np.asarray(idx)])
+            continue
+        key, sub = jax.random.split(key)
+        centers_m, _ = build_clusters(rows, c, n_iters, sub, metric)
+        fine_centers.append(np.asarray(centers_m))
+    centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
+    assert centers.shape[0] == n_clusters
+
+    # final balancing passes over the full trainset (reference runs
+    # max(n_iters/10, 2) trainset iterations after the hierarchy)
+    for it in range(max(n_iters // 10, 2)):
+        key, sub = jax.random.split(key)
+        centers, _, _ = _balancing_em_iter(
+            x_dev, centers, sub, jnp.float32(0.125), n_clusters
+        )
+    return centers
+
+
+# ---------------------------------------------------------------------------
+# public API (reference kmeans_balanced.cuh:76,134,199)
+# ---------------------------------------------------------------------------
+
+
+def fit(params: KMeansBalancedParams, x) -> jax.Array:
+    """Train balanced centers (kmeans_balanced.cuh:76). Returns [C, d]."""
+    return build_hierarchical(
+        x, params.n_clusters, params.n_iters, params.metric, params.seed
+    )
+
+
+def predict(params: KMeansBalancedParams, centers, x) -> jax.Array:
+    """Nearest-center labels (kmeans_balanced.cuh:134)."""
+    x = _as_f32(x)
+    return _predict_metric(
+        x, _as_f32(centers), int(params.metric), min(x.shape[0], 1 << 16)
+    )
+
+
+def fit_predict(params: KMeansBalancedParams, x):
+    """fit + predict (kmeans_balanced.cuh:199)."""
+    centers = fit(params, x)
+    return centers, predict(params, centers, x)
+
+
+def calc_centers_and_sizes(x, labels, n_clusters: int):
+    """Per-cluster means and sizes (reference helper
+    detail/kmeans_balanced.cuh:257). Returns (centers, sizes)."""
+    x = _as_f32(x)
+    sums, sizes = _centers_and_sizes(
+        x, jnp.asarray(labels), None, int(n_clusters), min(x.shape[0], 1 << 16)
+    )
+    centers = sums / jnp.maximum(sizes, 1.0)[:, None]
+    return centers, sizes

@@ -34,7 +34,6 @@ def reduce(x, axis_name: str, root: int = 0, op: str = "sum"):
 
 def bcast(x, axis_name: str, root: int = 0):
     """comms_iface::bcast — every rank gets root's value."""
-    ranks = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     masked = jnp.where(rank == root, x, jnp.zeros_like(x))
     return lax.psum(masked, axis_name)

@@ -103,7 +103,7 @@ def search(
         from raft_tpu.neighbors.refine import refine as _refine
 
         k_cand = min(n, max(4 * k, k + 32))
-        _, cand = _search(
+        cand_d, cand = _search(
             queries.astype(jnp.bfloat16),
             index.dataset.astype(jnp.bfloat16),
             index.norms,
@@ -114,6 +114,11 @@ def search(
             float(index.metric_arg),
             int(min(tile_n, n)),
         )
+        # candidates at the sentinel distance are padding or prefiltered-out
+        # rows; mark them invalid so refine (which runs unfiltered) cannot
+        # resurrect them into the final top-k
+        sentinel = sentinel_for(index.metric, cand_d.dtype)
+        cand = jnp.where(cand_d == sentinel, -1, cand)
         return _refine(index.dataset, queries, cand, k, index.metric)
 
     return _search(
@@ -133,20 +138,26 @@ def search(
 def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p, tile_n):
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
-    compute = jnp.promote_types(queries.dtype, jnp.float32)
-    q = queries.astype(compute)
+    if queries.dtype == jnp.bfloat16:
+        # TPU fast path: keep bf16 *operands* for single-pass MXU matmuls;
+        # dist_dot accumulates in fp32 (preferred_element_type), so distances
+        # are carried in fp32
+        mm, acc = jnp.bfloat16, jnp.float32
+    else:
+        mm = acc = jnp.promote_types(queries.dtype, jnp.float32)
+    q = queries.astype(mm)
     n, d = dataset.shape
     m = q.shape[0]
-    sentinel = sentinel_for(metric, compute)
+    sentinel = sentinel_for(metric, acc)
 
     if tile_n >= n:
-        dists = _dist_block(q, dataset.astype(compute), metric, p, norms)
+        dists = _dist_block(q, dataset.astype(mm), metric, p, norms).astype(acc)
         if filter_bits is not None:
             dists = _apply_filter(dists, jnp.arange(n)[None, :], filter_bits, filter_nbits, sentinel)
         return merge_topk(dists, jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (m, n)), k, select_min)
 
     npad = round_up_to_multiple(n, tile_n)
-    ds = jnp.pad(dataset, ((0, npad - n), (0, 0))).astype(compute)
+    ds = jnp.pad(dataset, ((0, npad - n), (0, 0))).astype(mm)
     tiles = ds.reshape(npad // tile_n, tile_n, d)
     norm_tiles = None
     if norms is not None:
@@ -159,7 +170,7 @@ def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p
         else:
             t, db_tile = inp
             nt = None
-        dists = _dist_block(q, db_tile, metric, p, nt)
+        dists = _dist_block(q, db_tile, metric, p, nt).astype(acc)
         col = (t * tile_n + jnp.arange(tile_n, dtype=jnp.int32))[None, :]
         dists = jnp.where(col < n, dists, sentinel)
         if filter_bits is not None:
@@ -169,7 +180,7 @@ def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p
         return merge_topk(cand_d, cand_i, k, select_min), None
 
     init = (
-        jnp.full((m, k), sentinel, compute),
+        jnp.full((m, k), sentinel, acc),
         jnp.full((m, k), -1, jnp.int32),
     )
     xs = (jnp.arange(npad // tile_n), tiles) if norm_tiles is None else (

@@ -102,3 +102,57 @@ def test_bin_io(rng, tmp_path):
     np.testing.assert_array_equal(np.asarray(out), arr)
     sub = read_bin(p, rows=(2, 5))
     np.testing.assert_array_equal(np.asarray(sub), arr[2:7])
+
+
+def test_fast_path_recall(rng):
+    n, m, d, k = 2000, 100, 64, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    index = brute_force.build(x, "sqeuclidean")
+    _, want = naive_knn(q, x, k)
+    _, idx = brute_force.search(index, q, k, fast=True)
+    assert eval_recall(np.asarray(idx), want) > 0.95
+
+
+def test_fast_path_respects_prefilter(rng):
+    # regression: fast=True must not resurrect prefiltered-out rows during
+    # the unfiltered refine phase
+    from raft_tpu.core.bitset import Bitset
+
+    n, m, d, k = 100, 8, 16, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    allowed = np.zeros(n, bool)
+    allowed[:15] = True  # fewer allowed rows than the candidate pool
+    bits = Bitset.from_dense(allowed)
+    index = brute_force.build(x, "sqeuclidean")
+    _, idx = brute_force.search(index, q, k, prefilter=bits, fast=True)
+    idx = np.asarray(idx)
+    valid = idx >= 0
+    assert allowed[idx[valid]].all()
+    # the 15 allowed rows must fill the first slots exactly like fast=False
+    _, idx_slow = brute_force.search(index, q, k, prefilter=bits, fast=False)
+    d2 = ((q[:, None, :] - x[None, :15, :]) ** 2).sum(-1)
+    want = np.argsort(d2, axis=1)[:, :k]
+    for r in range(m):
+        assert set(idx[r][idx[r] >= 0]) <= set(range(15))
+
+
+def test_bf16_inputs_stay_bf16(rng):
+    # regression: bf16 queries/dataset must not be silently promoted to f32
+    # before the candidate matmul
+    import jax.numpy as jnp
+    from raft_tpu.neighbors.brute_force import _search
+    from raft_tpu.distance.types import DistanceType
+
+    x = jnp.asarray(rng.standard_normal((64, 16)), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((8, 16)), jnp.bfloat16)
+    import jax
+
+    jaxpr = jax.make_jaxpr(
+        lambda q, x: _search(q, x, None, None, None, 5,
+                             int(DistanceType.L2Expanded), 2.0, 64)
+    )(q, x)
+    text = str(jaxpr)
+    # the dot_general must consume bf16 operands
+    assert "bf16" in text.split("dot_general")[1][:400]

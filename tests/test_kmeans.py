@@ -1,0 +1,177 @@
+"""Cluster-layer tests (reference test strategy: cpp/test/cluster/*,
+pylibraft test_kmeans.py — oracle = sklearn-style checks on blob data)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import (
+    KMeansBalancedParams,
+    KMeansParams,
+    cluster_cost,
+    compute_new_centroids,
+    init_plus_plus,
+    kmeans,
+    kmeans_balanced,
+)
+from raft_tpu.random.generators import make_blobs
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(42)
+    centers = rng.uniform(-10.0, 10.0, size=(8, 16)).astype(np.float32)
+    x, labels = make_blobs(
+        n_samples=2000, n_features=16, centers=centers, cluster_std=0.3, seed=42
+    )
+    return np.asarray(x), np.asarray(labels), centers
+
+
+def test_kmeans_fit_recovers_blobs(blobs):
+    x, true_labels, true_centers = blobs
+    params = KMeansParams(n_clusters=8, max_iter=50, seed=0)
+    centers, inertia, n_iter = kmeans.fit(params, x)
+    assert centers.shape == (8, 16)
+    assert int(n_iter) >= 1
+    # every true center should have a fitted center very close to it
+    d = np.linalg.norm(
+        np.asarray(centers)[None, :, :] - true_centers[:, None, :], axis=-1
+    )
+    assert d.min(axis=1).max() < 0.5
+
+    labels = np.asarray(kmeans.predict(params, centers, x))
+    # cluster assignment must agree with ground truth up to permutation:
+    # points sharing a true label share a predicted label
+    for t in range(8):
+        vals, counts = np.unique(labels[true_labels == t], return_counts=True)
+        assert counts.max() / counts.sum() > 0.95
+
+
+def test_kmeans_inertia_decreases(blobs):
+    x, _, _ = blobs
+    params = KMeansParams(n_clusters=8, max_iter=1, seed=1, init="random")
+    _, inertia1, _ = kmeans.fit(params, x)
+    params = KMeansParams(n_clusters=8, max_iter=30, seed=1, init="random")
+    _, inertia30, n_iter = kmeans.fit(params, x)
+    # random init on 8 blobs must take multiple Lloyd iterations — guards
+    # against the convergence test tripping on the first iteration
+    assert int(n_iter) > 1
+    assert float(inertia30) < float(inertia1) * 0.99
+
+
+def test_cluster_cost_matches_oracle(blobs):
+    x, _, _ = blobs
+    centers = x[:8]
+    d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    expected = d2.min(axis=1).sum()
+    got = float(cluster_cost(x, centers))
+    np.testing.assert_allclose(got, expected, rtol=1e-4)
+
+
+def test_compute_new_centroids_oracle(blobs):
+    x, _, _ = blobs
+    centers = x[:8].astype(np.float32)
+    d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+    labels = d2.argmin(axis=1)
+    expected = np.stack(
+        [x[labels == c].mean(axis=0) if (labels == c).any() else centers[c]
+         for c in range(8)]
+    )
+    got = np.asarray(compute_new_centroids(x, centers))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_init_plus_plus_spreads_centers(blobs):
+    x, _, true_centers = blobs
+    centers = np.asarray(init_plus_plus(x, 8, seed=3))
+    assert centers.shape == (8, 16)
+    # k-means++ on tight blobs should hit most distinct blobs; a sampled
+    # point sits ~cluster_std*sqrt(d) ~= 1.2 from its blob center
+    d = np.linalg.norm(centers[None, :, :] - true_centers[:, None, :], axis=-1)
+    hit = (d.min(axis=1) < 3.0).sum()
+    assert hit >= 6
+
+
+def test_kmeans_weighted(blobs):
+    x, _, _ = blobs
+    w = np.ones(x.shape[0], np.float32)
+    params = KMeansParams(n_clusters=8, max_iter=20, seed=0)
+    c1, i1, _ = kmeans.fit(params, x)
+    c2, i2, _ = kmeans.fit(params, x, sample_weights=w)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), rtol=1e-4, atol=1e-4)
+
+
+def test_balanced_fit_balances_sizes(blobs):
+    x, _, _ = blobs
+    params = KMeansBalancedParams(n_clusters=16, n_iters=20, seed=0)
+    centers = kmeans_balanced.fit(params, x)
+    assert centers.shape == (16, 16)
+    labels = np.asarray(kmeans_balanced.predict(params, centers, x))
+    sizes = np.bincount(labels, minlength=16)
+    assert sizes.min() > 0  # no starved clusters
+    # balanced trainer: no cluster hogs the data
+    assert sizes.max() < x.shape[0] * 0.5
+
+
+def test_balanced_hierarchical_path():
+    # n_clusters big enough to trigger the meso/fine hierarchy
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((5000, 8)).astype(np.float32)
+    params = KMeansBalancedParams(n_clusters=64, n_iters=10, seed=0)
+    centers, labels = kmeans_balanced.fit_predict(params, x)
+    assert centers.shape == (64, 8)
+    sizes = np.bincount(np.asarray(labels), minlength=64)
+    assert (sizes > 0).sum() >= 60  # nearly all clusters populated
+    assert sizes.max() < 0.1 * x.shape[0]
+
+
+def test_balanced_predict_inner_product():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    centers = rng.standard_normal((4, 8)).astype(np.float32)
+    from raft_tpu.distance.types import DistanceType
+
+    params = KMeansBalancedParams(n_clusters=4, metric=DistanceType.InnerProduct)
+    labels = np.asarray(kmeans_balanced.predict(params, centers, x))
+    expected = (x @ centers.T).argmax(axis=1)
+    np.testing.assert_array_equal(labels, expected)
+
+
+def test_calc_centers_and_sizes():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((100, 4)).astype(np.float32)
+    labels = rng.integers(0, 5, 100).astype(np.int32)
+    centers, sizes = kmeans_balanced.calc_centers_and_sizes(x, labels, 5)
+    np.testing.assert_array_equal(np.asarray(sizes), np.bincount(labels, minlength=5))
+    for c in range(5):
+        if (labels == c).any():
+            np.testing.assert_allclose(
+                np.asarray(centers)[c], x[labels == c].mean(0), rtol=1e-4, atol=1e-4
+            )
+
+
+def test_kmeans_cosine_metric():
+    # regression: KMeansParams.metric must be honored by fit/predict
+    from raft_tpu.distance.types import DistanceType
+
+    rng = np.random.default_rng(5)
+    # two directional clusters on the unit sphere with different magnitudes
+    a = rng.standard_normal((200, 8)) * 0.1 + np.eye(8)[0] * 1.0
+    b = rng.standard_normal((200, 8)) * 0.1 + np.eye(8)[1] * 5.0
+    x = np.concatenate([a, b]).astype(np.float32)
+    params = KMeansParams(
+        n_clusters=2, max_iter=30, seed=0, metric=DistanceType.CosineExpanded
+    )
+    centers, inertia, _ = kmeans.fit(params, x)
+    labels = np.asarray(kmeans.predict(params, centers, x))
+    assert len(np.unique(labels[:200])) == 1
+    assert len(np.unique(labels[200:])) == 1
+    assert labels[0] != labels[200]
+
+
+def test_kmeans_rejects_unsupported_metric():
+    from raft_tpu.distance.types import DistanceType
+
+    x = np.zeros((10, 3), np.float32)
+    with pytest.raises(ValueError):
+        kmeans.fit(KMeansParams(n_clusters=2, metric=DistanceType.InnerProduct), x)
