@@ -146,49 +146,68 @@ def build_hierarchical(
     seed: int = 0,
 ) -> jax.Array:
     """Two-level balanced training (reference
-    detail/kmeans_balanced.cuh:955 build_hierarchical). Returns centers."""
+    detail/kmeans_balanced.cuh:955 build_hierarchical). Returns centers.
+
+    TPU adaptation: the reference runs full per-mesocluster fine fits; here
+    the hierarchy only *initializes* the centers — meso fit and per-meso
+    fine fits run on fixed-size subsamples (so every fine fit shares one
+    compiled shape instead of jit-recompiling per mesocluster), then the
+    real work happens in full-dataset balancing EM iterations, which are a
+    single compiled program. On TPU the full predict GEMM is cheap enough
+    that the hierarchy's FLOP savings don't matter; compile time does.
+    """
     x_np = np.asarray(x, dtype=np.float32)
     n, d = x_np.shape
     key = jax.random.PRNGKey(seed)
+    rng = np.random.default_rng(seed)
 
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     if n_clusters <= n_meso or n <= 4 * n_clusters:
         centers, _ = build_clusters(x_np, n_clusters, n_iters, key, metric)
         return centers
 
-    x_dev = jnp.asarray(x_np)
+    # --- meso pass on a bounded subsample --------------------------------
+    meso_sample = min(n, max(64 * n_meso, 1 << 14))
+    sel = rng.choice(n, meso_sample, replace=False)
     key, k_meso = jax.random.split(key)
-    meso_centers, _ = build_clusters(x_dev, n_meso, n_iters, k_meso, metric)
+    meso_centers, _ = build_clusters(
+        x_np[sel], n_meso, max(n_iters // 2, 4), k_meso, metric
+    )
     meso_labels = np.asarray(
-        _predict_metric(x_dev, meso_centers, int(metric), min(n, 1 << 16))
+        _predict_metric(jnp.asarray(x_np[sel]), meso_centers, int(metric),
+                        min(meso_sample, 1 << 16))
     )
     meso_sizes = np.bincount(meso_labels, minlength=n_meso)
     fine_counts = _arrange_fine_clusters(n_clusters, n_meso, meso_sizes)
 
+    # --- fine init: fixed-size subsample per mesocluster -----------------
+    c_max = int(fine_counts.max())
+    S = max(32 * c_max, 256)  # one shared shape for all fine fits
     fine_centers = []
     for m in range(n_meso):
         c = int(fine_counts[m])
         if c == 0:
             continue
-        rows = x_np[meso_labels == m]
-        if rows.shape[0] == 0:
-            # empty mesocluster that was assigned clusters: random reseed
-            key, sub = jax.random.split(key)
-            idx = jax.random.choice(sub, n, shape=(c,))
-            fine_centers.append(x_np[np.asarray(idx)])
+        members = np.nonzero(meso_labels == m)[0]
+        if members.size == 0:
+            fine_centers.append(x_np[rng.choice(n, c, replace=n < c)])
             continue
+        rows = x_np[sel[rng.choice(members, S, replace=members.size < S)]]
         key, sub = jax.random.split(key)
-        centers_m, _ = build_clusters(rows, c, n_iters, sub, metric)
-        fine_centers.append(np.asarray(centers_m))
+        # few iterations — this is only an init for the balancing phase
+        centers_m, _ = build_clusters(rows, c_max, 4, sub, metric)
+        fine_centers.append(np.asarray(centers_m[:c]))
     centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
     assert centers.shape[0] == n_clusters
 
-    # final balancing passes over the full trainset (reference runs
-    # max(n_iters/10, 2) trainset iterations after the hierarchy)
-    for it in range(max(n_iters // 10, 2)):
+    # --- full-dataset balancing EM (the real training) -------------------
+    x_dev = jnp.asarray(x_np)
+    iters = max(n_iters // 2, 2)
+    for it in range(iters):
         key, sub = jax.random.split(key)
+        ratio = jnp.float32(0.25 * (1.0 - it / max(iters, 1)))
         centers, _, _ = _balancing_em_iter(
-            x_dev, centers, sub, jnp.float32(0.125), n_clusters
+            x_dev, centers, sub, ratio, n_clusters
         )
     return centers
 

@@ -1,6 +1,6 @@
 """Neighbors layer — the core product (SURVEY.md §2.9)."""
 
-from raft_tpu.neighbors import brute_force, refine as _refine_mod
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine as _refine_mod
 from raft_tpu.neighbors.common import (
     BitsetFilter,
     IndexParams,
@@ -13,6 +13,8 @@ from raft_tpu.neighbors.refine import refine
 
 __all__ = [
     "brute_force",
+    "ivf_flat",
+    "ivf_pq",
     "refine",
     "BitsetFilter",
     "IndexParams",

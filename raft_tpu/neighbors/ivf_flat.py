@@ -1,0 +1,538 @@
+"""IVF-Flat: inverted-file index with uncompressed vectors.
+
+TPU-native analog of the reference's ivf_flat
+(cpp/include/raft/neighbors/ivf_flat.cuh; types ivf_flat_types.hpp:49-84;
+build detail/ivf_flat_build.cuh:343; search detail/ivf_flat_search-inl.cuh:38
++ the fused interleaved-scan kernel
+detail/ivf_flat_interleaved_scan-inl.cuh:663).
+
+Design — idiomatic TPU, not a port (SURVEY.md §7):
+
+* **Storage**: the reference interleaves each list in groups of 32 vectors
+  for warp-coalesced loads (ivf_flat_types.hpp:154-176). TPU vector lanes
+  are fed by contiguous (8,128) tiles, so interleaving is pointless; lists
+  live in a dense padded block ``[n_lists, cap, dim]`` (cap = longest list,
+  tile-aligned) built by sort-by-label + scatter — no atomics
+  (the reference's build_index_kernel, ivf_flat_build.cuh:115).
+
+* **Search**: the reference launches one CTA per (query, probe) to scan a
+  list with a warp-level priority queue. The TPU analog inverts the
+  parallelism: all (query, probe) pairs are grouped **by list** so each
+  step is a dense ``[G, d] x [d, cap]`` MXU matmul between a group of
+  queries and one list block, followed by a local top-k; a final
+  ``select_k`` merges each query's n_probes x k candidates (same merge the
+  reference does at ivf_flat_search-inl.cuh:194). Grouping, bucketing and
+  un-bucketing are all static-shape sort/cumsum/scatter — jit-compatible.
+
+The per-list query groups are what make this fast: with balanced lists,
+m x n_probes / n_lists queries share every list block, so the MXU runs at
+high utilization instead of doing per-query gathers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core.serialize import read_index_file, write_index_file
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.utils.math import round_up_to_multiple
+from raft_tpu.utils.precision import dist_dot
+
+_SERIAL_VERSION = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Build params (reference ivf_flat_types.hpp:49-78)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    add_data_on_build: bool = True
+    conservative_memory_allocation: bool = False  # API parity; no-op here
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Search params (reference ivf_flat_types.hpp:81-84)."""
+
+    n_probes: int = 20
+    # TPU tuning knobs (no reference analog): queries per list-group matmul
+    # and list blocks processed per scan step
+    query_group: int = 256
+    bucket_batch: int = 8
+    # matmul operand dtype: "bf16" = single-pass MXU (distances still
+    # accumulate in f32), "f32" = exact 6-pass. The reference's analog is
+    # its fp16/fp8 LUT ladder (ivf_pq_types.hpp lut_dtype).
+    compute_dtype: str = "bf16"
+    # recall target for the per-list approx top-k (lax.approx_min_k);
+    # >= 1.0 switches to exact sort-based selection
+    local_recall_target: float = 0.95
+
+
+@dataclasses.dataclass
+class Index:
+    """IVF-Flat index (reference ivf_flat_types.hpp:127+).
+
+    ``storage`` [n_lists, cap, dim] — padded list blocks (source dtype);
+    ``indices`` [n_lists, cap] — source row ids, -1 in padding;
+    ``list_sizes`` [n_lists]; ``centers`` [n_lists, dim] f32;
+    ``data_norms`` — per-point squared norms for expanded-L2/cosine search.
+    """
+
+    centers: jax.Array
+    storage: jax.Array
+    indices: jax.Array
+    list_sizes: jax.Array
+    metric: DistanceType
+    metric_arg: float = 2.0
+    adaptive_centers: bool = False
+    data_norms: Optional[jax.Array] = None
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(self.list_sizes.sum())
+
+
+def _needs_norms(metric: DistanceType) -> bool:
+    return metric in (
+        DistanceType.L2Expanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.CosineExpanded,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _pack_lists(data, labels, row_ids, n_lists: int, cap: int):
+    """Scatter rows into padded list blocks (sort-by-label, no atomics)."""
+    n, d = data.shape
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    counts = jnp.bincount(labels, length=n_lists)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n) - starts[sorted_labels]
+    slot = sorted_labels * cap + pos
+    storage = (
+        jnp.zeros((n_lists * cap, d), data.dtype).at[slot].set(data[order])
+    ).reshape(n_lists, cap, d)
+    indices = (
+        jnp.full((n_lists * cap,), -1, jnp.int32).at[slot].set(
+            row_ids[order].astype(jnp.int32))
+    ).reshape(n_lists, cap)
+    return storage, indices, counts.astype(jnp.int32)
+
+
+def build(params: IndexParams, dataset, row_ids=None) -> Index:
+    """Build the index (reference ivf_flat-inl.cuh:65 → build.cuh:343):
+    subsample a trainset, balanced-kmeans the coarse centers, label every
+    row, and scatter rows into padded lists."""
+    dataset = jnp.asarray(dataset)
+    n, d = dataset.shape
+    n_lists = int(params.n_lists)
+
+    # 1. trainset subsample + balanced kmeans (ivf_flat_build.cuh:384)
+    frac = float(params.kmeans_trainset_fraction)
+    if 0 < frac < 1.0 and int(n * frac) >= n_lists:
+        step = max(int(1.0 / frac), 1)
+        trainset = dataset[::step]
+    else:
+        trainset = dataset
+    kb = KMeansBalancedParams(
+        n_clusters=n_lists,
+        n_iters=int(params.kmeans_n_iters),
+        metric=(
+            DistanceType.L2Expanded
+            if params.metric != DistanceType.InnerProduct
+            else DistanceType.InnerProduct
+        ),
+    )
+    centers = kmeans_balanced.fit(kb, trainset)
+
+    index = Index(
+        centers=centers,
+        storage=jnp.zeros((n_lists, 0, d), dataset.dtype),
+        indices=jnp.full((n_lists, 0), -1, jnp.int32),
+        list_sizes=jnp.zeros((n_lists,), jnp.int32),
+        metric=params.metric,
+        metric_arg=params.metric_arg,
+        adaptive_centers=bool(params.adaptive_centers),
+    )
+    if not params.add_data_on_build:
+        return index
+    if row_ids is None:
+        row_ids = jnp.arange(n, dtype=jnp.int32)
+    return extend(index, dataset, jnp.asarray(row_ids))
+
+
+def extend(index: Index, new_vectors, new_ids=None) -> Index:
+    """Add vectors (reference ivf_flat_build.cuh:162 extend): label new rows,
+    repack all lists at the new capacity, optionally adapt centers."""
+    new_vectors = jnp.asarray(new_vectors)
+    n_new = new_vectors.shape[0]
+    if new_ids is None:
+        new_ids = jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
+    new_ids = jnp.asarray(new_ids).astype(jnp.int32)
+
+    kb = KMeansBalancedParams(
+        n_clusters=index.n_lists,
+        metric=(
+            DistanceType.InnerProduct
+            if index.metric == DistanceType.InnerProduct
+            else DistanceType.L2Expanded
+        ),
+    )
+    new_labels = kmeans_balanced.predict(kb, index.centers, new_vectors)
+
+    # flatten existing lists back to (rows, labels, ids) and append
+    old_cap = index.storage.shape[1]
+    if old_cap > 0 and index.size > 0:
+        flat = np.asarray(index.storage).reshape(-1, index.dim)
+        flat_ids = np.asarray(index.indices).reshape(-1)
+        flat_labels = np.repeat(np.arange(index.n_lists, dtype=np.int32), old_cap)
+        valid = flat_ids >= 0
+        data = jnp.asarray(
+            np.concatenate([flat[valid], np.asarray(new_vectors)], axis=0)
+        )
+        labels = jnp.asarray(
+            np.concatenate([flat_labels[valid], np.asarray(new_labels)])
+        )
+        ids = jnp.asarray(
+            np.concatenate([flat_ids[valid], np.asarray(new_ids)])
+        )
+    else:
+        data, labels, ids = new_vectors, new_labels, new_ids
+
+    counts = np.bincount(np.asarray(labels), minlength=index.n_lists)
+    cap = max(8, round_up_to_multiple(int(counts.max()), 8))
+    storage, indices, list_sizes = _pack_lists(
+        data, labels, ids, index.n_lists, cap
+    )
+
+    centers = index.centers
+    if index.adaptive_centers:
+        # recompute centers as the mean of their lists
+        # (ivf_flat_build.cuh extend with adaptive_centers=true)
+        centers, _ = kmeans_balanced.calc_centers_and_sizes(
+            data, labels, index.n_lists
+        )
+
+    norms = None
+    if _needs_norms(index.metric):
+        s32 = storage.astype(jnp.float32)
+        norms = jnp.sum(s32 * s32, axis=2)  # [n_lists, cap]
+
+    return dataclasses.replace(
+        index,
+        centers=centers,
+        storage=storage,
+        indices=indices,
+        list_sizes=list_sizes,
+        data_norms=norms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def bucketize_pairs(
+    probes, m: int, n_probes: int, C: int, group: int, bucket_batch: int
+):
+    """Group (query, probed-list) pairs into fixed-size per-list buckets.
+
+    The core of the TPU IVF search layout (shared by IVF-Flat and IVF-PQ):
+    sort pairs by list id, split each list's pair run into buckets of
+    ``group`` queries, and scatter into dense [n_buckets, group] tables.
+    ``n_buckets`` has the static bound total/group + C (each list wastes at
+    most one partial bucket), so everything jits with static shapes.
+
+    Returns (bucket_list [nb], bucket_q [nb, group] (-1 = empty slot),
+    pair_bucket [total], pair_pos [total], order [total] (the sort
+    permutation), total, nb).
+    """
+    total = m * n_probes
+    pair_q = jnp.repeat(jnp.arange(m, dtype=jnp.int32), n_probes)
+    pair_l = probes.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(pair_l, stable=True)
+    sl = pair_l[order]
+    sq = pair_q[order]
+    counts = jnp.bincount(pair_l, length=C)
+    starts = jnp.cumsum(counts) - counts
+    rank_in_list = jnp.arange(total) - starts[sl]
+    nb_per_list = -(-counts // group)  # ceil
+    bucket_start = jnp.cumsum(nb_per_list) - nb_per_list
+    pair_bucket = bucket_start[sl] + rank_in_list // group
+    pair_pos = rank_in_list % group
+
+    n_buckets = total // group + C + 1  # static upper bound on used buckets
+    nb_pad = round_up_to_multiple(n_buckets, bucket_batch)
+    bucket_list = jnp.zeros((nb_pad,), jnp.int32).at[pair_bucket].set(sl)
+    bucket_q = (
+        jnp.full((nb_pad * group,), -1, jnp.int32)
+        .at[pair_bucket * group + pair_pos]
+        .set(sq)
+    ).reshape(nb_pad, group)
+    return bucket_list, bucket_q, pair_bucket, pair_pos, order, total, nb_pad
+
+
+def unbucketize_merge(
+    cand_d, cand_i, pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
+    select_min, sentinel,
+):
+    """Map per-bucket top-kl candidates back to query-major order and merge
+    each query's n_probes x kl candidates into the final top-k."""
+    group = cand_d.shape[1]
+    flat_slot = pair_bucket * group + pair_pos
+    sd = cand_d.reshape(-1, kl)[flat_slot]
+    si = cand_i.reshape(-1, kl)[flat_slot]
+    pd = jnp.full((total, kl), sentinel, sd.dtype).at[order].set(sd)
+    pi = jnp.full((total, kl), -1, si.dtype).at[order].set(si)
+    return merge_topk(
+        pd.reshape(m, n_probes * kl), pi.reshape(m, n_probes * kl), k, select_min
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _ivf_search(
+    queries,
+    centers,
+    storage,
+    indices,
+    list_sizes,
+    k: int,
+    n_probes: int,
+    metric_val: int,
+    group: int,
+    bucket_batch: int,
+    filter_nbits: int,
+    compute_dtype: str = "bf16",
+    local_recall_target: float = 0.95,
+    data_norms=None,
+    filter_bits=None,
+):
+    metric = DistanceType(metric_val)
+    select_min = is_min_close(metric)
+    C, cap, d = storage.shape
+    q32 = queries.astype(jnp.float32)
+    m = q32.shape[0]
+    sentinel = sentinel_for(metric, jnp.float32)
+
+    # ---- coarse phase: queries x centers GEMM + select n_probes ----------
+    # (reference ivf_flat_search-inl.cuh:90-130)
+    cdot = dist_dot(q32, centers.T)
+    if metric == DistanceType.InnerProduct:
+        coarse = cdot
+    elif metric == DistanceType.CosineExpanded:
+        qn = jnp.linalg.norm(q32, axis=1, keepdims=True)
+        cn = jnp.linalg.norm(centers, axis=1)
+        coarse = 1.0 - cdot / jnp.maximum(qn * cn[None, :], 1e-30)
+    else:
+        qn2 = jnp.sum(q32 * q32, axis=1, keepdims=True)
+        cn2 = jnp.sum(centers * centers, axis=1)
+        coarse = qn2 + cn2[None, :] - 2.0 * cdot
+    _, probes = select_k(coarse, n_probes, select_min=select_min)  # [m, np]
+
+    # ---- bucketize (query, probe) pairs by list --------------------------
+    (bucket_list, bucket_q, pair_bucket, pair_pos, order, total, nb_pad) = (
+        bucketize_pairs(probes, m, n_probes, C, group, bucket_batch)
+    )
+
+    # ---- scan list blocks: one MXU matmul per (group x list) -------------
+    # per-list top-k cannot exceed the list capacity; the final merge over
+    # n_probes lists restores k (requires n_probes * cap >= k)
+    kl = min(k, cap)
+    qnorm = jnp.sum(q32 * q32, axis=1)
+    qlen = jnp.sqrt(qnorm)
+
+    mm = jnp.bfloat16 if compute_dtype == "bf16" else jnp.float32
+
+    def body(_, inp):
+        bl, bq = inp  # [bb], [bb, group]
+        block = storage[bl].astype(mm)  # [bb, cap, d] contiguous
+        ids = indices[bl]  # [bb, cap]
+        sizes = list_sizes[bl]  # [bb]
+        qsafe = jnp.maximum(bq, 0)
+        qv = q32[qsafe].astype(mm)  # [bb, group, d]
+        dots = jnp.einsum(
+            "bgd,bcd->bgc", qv, block,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if metric == DistanceType.InnerProduct:
+            dist = dots
+        elif metric == DistanceType.CosineExpanded:
+            pn = jnp.sqrt(jnp.maximum(
+                data_norms[bl] if data_norms is not None
+                else jnp.sum(block * block, axis=2), 1e-30))
+            dist = 1.0 - dots / jnp.maximum(
+                qlen[qsafe][:, :, None] * pn[:, None, :], 1e-30)
+        else:
+            pn2 = (data_norms[bl] if data_norms is not None
+                   else jnp.sum(block * block, axis=2))
+            dist = jnp.maximum(
+                qnorm[qsafe][:, :, None] + pn2[:, None, :] - 2.0 * dots, 0.0)
+
+        col_ok = (jnp.arange(cap)[None, :] < sizes[:, None])[:, None, :]
+        valid = col_ok & (bq >= 0)[:, :, None]
+        if filter_bits is not None:
+            from raft_tpu.core.bitset import Bitset
+
+            safe_ids = jnp.clip(ids, 0, filter_nbits - 1)
+            keep = Bitset.test_bits(filter_bits, safe_ids) & (ids >= 0) & (
+                ids < filter_nbits)
+            valid = valid & keep[:, None, :]
+        dist = jnp.where(valid, dist, sentinel)
+        ld, lsel = merge_topk(
+            dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,
+            approx=local_recall_target < 1.0,
+            recall_target=local_recall_target,
+        )  # [bb, group, kl]
+        return None, (ld, lsel)
+
+    xs = (
+        bucket_list.reshape(-1, bucket_batch),
+        bucket_q.reshape(-1, bucket_batch, group),
+    )
+    _, (cand_d, cand_i) = jax.lax.scan(body, None, xs)
+    cand_d = cand_d.reshape(nb_pad, group, kl)
+    cand_i = cand_i.reshape(nb_pad, group, kl)
+
+    # ---- un-bucketize + final merge (search-inl.cuh:194) -----------------
+    out_d, out_i = unbucketize_merge(
+        cand_d, cand_i, pair_bucket, pair_pos, order, total, m, n_probes,
+        kl, k, select_min, sentinel,
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+def search(
+    search_params: SearchParams,
+    index: Index,
+    queries,
+    k: int,
+    prefilter=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN search (reference ivf_flat-inl.cuh:516).
+
+    Returns (distances [m, k], source ids [m, k]); ids are -1 where fewer
+    than k valid candidates were found in the probed lists.
+    """
+    queries = jnp.asarray(queries)
+    n_probes = int(min(search_params.n_probes, index.n_lists))
+    cap = index.storage.shape[1]
+    if cap == 0:
+        raise ValueError("index is empty — build with add_data_on_build or extend")
+    if k > n_probes * cap:
+        raise ValueError(
+            f"k={k} exceeds n_probes*list_capacity={n_probes * cap}"
+        )
+    filt = as_filter(prefilter)
+    bits = getattr(filt, "bitset", None)
+    return _ivf_search(
+        queries,
+        index.centers,
+        index.storage,
+        index.indices,
+        index.list_sizes,
+        int(k),
+        n_probes,
+        int(index.metric),
+        int(search_params.query_group),
+        int(search_params.bucket_batch),
+        0 if bits is None else int(bits.n_bits),
+        str(search_params.compute_dtype),
+        float(search_params.local_recall_target),
+        index.data_norms,
+        None if bits is None else bits.bits,
+    )
+
+
+# ---------------------------------------------------------------------------
+# helpers (reference ivf_flat_helpers.cuh / codepacker)
+# ---------------------------------------------------------------------------
+
+
+def get_list_data(index: Index, label: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Unpack one list's (vectors, source ids) — codepacker analog."""
+    size = int(index.list_sizes[label])
+    vecs = np.asarray(index.storage[label, :size])
+    ids = np.asarray(index.indices[label, :size])
+    return vecs, ids
+
+
+def reconstruct_dataset(index: Index) -> Tuple[np.ndarray, np.ndarray]:
+    """All (vectors, source ids) in storage order."""
+    flat = np.asarray(index.storage).reshape(-1, index.dim)
+    ids = np.asarray(index.indices).reshape(-1)
+    valid = ids >= 0
+    return flat[valid], ids[valid]
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference ivf_flat_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+
+def save(path: str, index: Index) -> None:
+    arrays = {
+        "centers": np.asarray(index.centers),
+        "storage": np.asarray(index.storage),
+        "indices": np.asarray(index.indices),
+        "list_sizes": np.asarray(index.list_sizes),
+    }
+    if index.data_norms is not None:
+        arrays["data_norms"] = np.asarray(index.data_norms)
+    write_index_file(
+        path,
+        "ivf_flat",
+        _SERIAL_VERSION,
+        {
+            "metric": int(index.metric),
+            "metric_arg": index.metric_arg,
+            "adaptive_centers": index.adaptive_centers,
+        },
+        arrays,
+    )
+
+
+def load(path: str) -> Index:
+    _, meta, arrays = read_index_file(path, "ivf_flat")
+    return Index(
+        centers=jnp.asarray(arrays["centers"]),
+        storage=jnp.asarray(arrays["storage"]),
+        indices=jnp.asarray(arrays["indices"]),
+        list_sizes=jnp.asarray(arrays["list_sizes"]),
+        metric=DistanceType(meta["metric"]),
+        metric_arg=meta["metric_arg"],
+        adaptive_centers=bool(meta["adaptive_centers"]),
+        data_norms=(
+            jnp.asarray(arrays["data_norms"]) if "data_norms" in arrays else None
+        ),
+    )

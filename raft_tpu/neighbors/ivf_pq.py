@@ -1,0 +1,553 @@
+"""IVF-PQ: inverted-file index with product-quantized residuals.
+
+TPU-native analog of the reference's ivf_pq
+(cpp/include/raft/neighbors/ivf_pq.cuh; types ivf_pq_types.hpp:48-146; build
+detail/ivf_pq_build.cuh:1753; search detail/ivf_pq_search.cuh:732 + LUT
+similarity kernel detail/ivf_pq_compute_similarity-inl.cuh).
+
+Build mirrors the reference pipeline: balanced-kmeans coarse centers, an
+orthogonal rotation (QR of a random matrix, make_rotation_matrix:122),
+per-subspace or per-cluster PQ codebooks trained on residuals
+(train_per_subset:395 / train_per_cluster:472), then codes packed into
+padded list blocks (process_and_fill_codes:1322).
+
+Search is re-designed for the MXU rather than ported (SURVEY.md §7 "hard
+parts" #1): the reference builds a per-(query,probe) LUT in shared memory
+and gathers LUT entries per code. TPUs have no fast per-lane gather, so we
+**decode-then-matmul**: reconstruct each probed list block from its codes
+(a small codebook gather), then score a whole query group against the block
+with one ``[G, rot_dim] x [rot_dim, cap]`` MXU contraction — identical
+shape to the IVF-Flat scan, with ``||recon||^2`` precomputed at build. The
+index stays PQ-compressed in HBM (codes + 1 f32 norm per vector), which is
+what buys billion-scale capacity; decode cost is amortized over the whole
+query group sharing the list.
+
+Uses the same bucketize-by-list machinery as ivf_flat (bucketize_pairs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams, build_clusters
+from raft_tpu.core.serialize import read_index_file, write_index_file
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.neighbors.ivf_flat import (
+    _pack_lists,
+    bucketize_pairs,
+    unbucketize_merge,
+)
+from raft_tpu.utils.math import round_up_to_multiple
+from raft_tpu.utils.precision import dist_dot
+
+_SERIAL_VERSION = 1
+
+
+class codebook_gen:
+    """Codebook training mode (reference ivf_pq_types.hpp:48)."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """Build params (reference ivf_pq_types.hpp:48-97)."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8
+    pq_dim: int = 0  # 0 → auto: dim/4 rounded to a multiple of 8 (reference heuristic)
+    codebook_kind: int = codebook_gen.PER_SUBSPACE
+    force_random_rotation: bool = False
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if not 4 <= self.pq_bits <= 8:
+            raise ValueError(f"pq_bits must be in [4, 8], got {self.pq_bits}")
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """Search params (reference ivf_pq_types.hpp:110-146)."""
+
+    n_probes: int = 20
+    lut_dtype: object = jnp.float32  # decode dtype: f32 | bf16 (fp8 analog)
+    internal_distance_dtype: object = jnp.float32
+    # TPU tuning knobs (same role as in ivf_flat.SearchParams)
+    query_group: int = 256
+    bucket_batch: int = 8
+    compute_dtype: str = "bf16"        # matmul operand dtype (f32 accumulate)
+    local_recall_target: float = 0.95  # per-list approx top-k; >=1.0 exact
+
+
+@dataclasses.dataclass
+class Index:
+    """IVF-PQ index (reference ivf_pq_types.hpp:199+).
+
+    ``codes`` [n_lists, cap, pq_dim] uint8; ``rec_norms`` [n_lists, cap] f32
+    (``||reconstructed residual + center||``-independent part, see search);
+    ``pq_centers``: [pq_dim, K, pq_len] (PER_SUBSPACE) or
+    [n_lists, K, pq_len] (PER_CLUSTER); ``rotation`` [rot_dim, dim].
+    """
+
+    centers: jax.Array          # [n_lists, dim] f32
+    centers_rot: jax.Array      # [n_lists, rot_dim] f32
+    rotation: jax.Array         # [rot_dim, dim] f32
+    pq_centers: jax.Array
+    codes: jax.Array            # [n_lists, cap, pq_dim] uint8
+    indices: jax.Array          # [n_lists, cap] int32
+    list_sizes: jax.Array       # [n_lists] int32
+    rec_norms: jax.Array        # [n_lists, cap] f32
+    metric: DistanceType
+    metric_arg: float = 2.0
+    codebook_kind: int = codebook_gen.PER_SUBSPACE
+    pq_bits: int = 8
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.rotation.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.rot_dim // self.pq_dim
+
+    @property
+    def pq_book_size(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def size(self) -> int:
+        return int(self.list_sizes.sum())
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def make_rotation_matrix(
+    rot_dim: int, dim: int, force_random: bool, key
+) -> jax.Array:
+    """Orthogonal rotation (reference ivf_pq_build.cuh:122): identity-padded
+    unless forced random or rot_dim != dim, in which case QR of a Gaussian."""
+    if not force_random and rot_dim == dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    g = jax.random.normal(key, (max(rot_dim, dim), max(rot_dim, dim)), jnp.float32)
+    q, _ = jnp.linalg.qr(g)
+    return q[:rot_dim, :dim]
+
+
+def _auto_pq_dim(dim: int) -> int:
+    # reference heuristic: dim/4 rounded down to a multiple of 8, >= 8
+    v = max(8, (dim // 4) // 8 * 8)
+    return min(v, dim)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _encode_subspace(residuals, pq_centers, K: int):
+    """codes[n, p] = argmin_j ||residuals[n,p,:] - pq_centers[p,j,:]||^2."""
+    dots = jnp.einsum(
+        "npl,pkl->npk", residuals, pq_centers,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    rn = jnp.sum(residuals * residuals, axis=2)[:, :, None]
+    cn = jnp.sum(pq_centers * pq_centers, axis=2)[None, :, :]
+    d = rn - 2.0 * dots + cn
+    return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+
+def _decode_gather(codes, pq_centers, codebook_kind: int, list_ids=None):
+    """Reconstruct rotated residuals from codes: one flat row-gather.
+
+    codes [..., pq_dim] uint8 → [..., rot_dim] f32.
+    PER_SUBSPACE: pq_centers [p, K, len], row index = s*K + code;
+    PER_CLUSTER: pq_centers [C, K, len], row index = list*K + code with
+    ``list_ids`` broadcastable to codes[..., 0]."""
+    c32 = codes.astype(jnp.int32)
+    K = pq_centers.shape[1]
+    if codebook_kind == codebook_gen.PER_SUBSPACE:
+        p = pq_centers.shape[0]
+        flat_idx = c32 + (jnp.arange(p, dtype=jnp.int32) * K)  # [..., p]
+    else:
+        flat_idx = c32 + (jnp.asarray(list_ids, jnp.int32) * K)[..., None]
+    table = pq_centers.reshape(-1, pq_centers.shape[-1])  # [p*K | C*K, len]
+    recon = jnp.take(table, flat_idx, axis=0)  # [..., p, len]
+    return recon.reshape(*codes.shape[:-1], -1)
+
+
+def build(params: IndexParams, dataset) -> Index:
+    """Build the index (reference ivf_pq_build.cuh:1753)."""
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    n_lists = int(params.n_lists)
+    pq_dim = int(params.pq_dim) or _auto_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+    rot_dim = pq_dim * pq_len
+    K = 1 << int(params.pq_bits)
+    key = jax.random.PRNGKey(0)
+
+    # 1. coarse centers on a trainset (build.cuh: build_clusters)
+    frac = float(params.kmeans_trainset_fraction)
+    if 0 < frac < 1.0 and int(n * frac) >= n_lists:
+        trainset = dataset[:: max(int(1.0 / frac), 1)]
+    else:
+        trainset = dataset
+    kb = KMeansBalancedParams(
+        n_clusters=n_lists, n_iters=int(params.kmeans_n_iters)
+    )
+    centers = kmeans_balanced.fit(kb, trainset)
+
+    # 2. rotation (build.cuh:122 make_rotation_matrix)
+    key, kr = jax.random.split(key)
+    rotation = make_rotation_matrix(
+        rot_dim, dim, bool(params.force_random_rotation), kr
+    )
+    centers_rot = dist_dot(centers, rotation.T)  # [C, rot_dim]
+
+    # 3. residuals of the trainset (build.cuh:166 select_residuals)
+    t32 = trainset.astype(jnp.float32)
+    t_labels = kmeans_balanced.predict(kb, centers, trainset)
+    t_rot = dist_dot(t32, rotation.T)
+    t_res = (t_rot - centers_rot[t_labels]).reshape(-1, pq_dim, pq_len)
+
+    # 4. PQ codebooks (train_per_subset:395 / train_per_cluster:472)
+    if params.codebook_kind == codebook_gen.PER_SUBSPACE:
+        books = []
+        for s in range(pq_dim):
+            key, ks = jax.random.split(key)
+            cb, _ = build_clusters(t_res[:, s, :], K, 10, ks)
+            books.append(cb)
+        pq_centers = jnp.stack(books)  # [p, K, len]
+    else:
+        books = []
+        t_labels_np = np.asarray(t_labels)
+        res_np = np.asarray(t_res)
+        for l in range(n_lists):
+            rows = res_np[t_labels_np == l].reshape(-1, pq_len)
+            key, ks = jax.random.split(key)
+            if rows.shape[0] < K:
+                rows = res_np.reshape(-1, pq_len)[: max(K * 4, 1024)]
+            cb, _ = build_clusters(rows, K, 10, ks)
+            books.append(np.asarray(cb))
+        pq_centers = jnp.asarray(np.stack(books))  # [C, K, len]
+
+    index = Index(
+        centers=centers,
+        centers_rot=centers_rot,
+        rotation=rotation,
+        pq_centers=pq_centers,
+        codes=jnp.zeros((n_lists, 0, pq_dim), jnp.uint8),
+        indices=jnp.full((n_lists, 0), -1, jnp.int32),
+        list_sizes=jnp.zeros((n_lists,), jnp.int32),
+        rec_norms=jnp.zeros((n_lists, 0), jnp.float32),
+        metric=params.metric,
+        metric_arg=params.metric_arg,
+        codebook_kind=int(params.codebook_kind),
+        pq_bits=int(params.pq_bits),
+    )
+    if not params.add_data_on_build:
+        return index
+    return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+
+
+def extend(index: Index, new_vectors, new_ids=None) -> Index:
+    """Encode + add vectors (reference ivf_pq_build.cuh extend /
+    process_and_fill_codes:1322)."""
+    new_vectors = jnp.asarray(new_vectors)
+    n_new = new_vectors.shape[0]
+    if new_ids is None:
+        new_ids = jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
+    new_ids = jnp.asarray(new_ids).astype(jnp.int32)
+
+    kb = KMeansBalancedParams(n_clusters=index.n_lists)
+    labels = kmeans_balanced.predict(kb, index.centers, new_vectors)
+
+    # encode: rotated residual → per-subspace nearest codebook entry
+    x32 = new_vectors.astype(jnp.float32)
+    x_rot = dist_dot(x32, index.rotation.T)
+    res = (x_rot - index.centers_rot[labels]).reshape(
+        -1, index.pq_dim, index.pq_len
+    )
+    if index.codebook_kind == codebook_gen.PER_SUBSPACE:
+        new_codes = _encode_subspace(res, index.pq_centers, index.pq_book_size)
+    else:
+        books = index.pq_centers[labels]  # [n, K, len]
+        dots = jnp.einsum(
+            "npl,nkl->npk", res, books,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rn = jnp.sum(res * res, axis=2)[:, :, None]
+        cn = jnp.sum(books * books, axis=2)[:, None, :]
+        new_codes = jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
+
+    # merge with existing lists and repack
+    if index.codes.shape[1] > 0 and index.size > 0:
+        old_codes = np.asarray(index.codes).reshape(-1, index.pq_dim)
+        old_ids = np.asarray(index.indices).reshape(-1)
+        old_labels = np.repeat(
+            np.arange(index.n_lists, dtype=np.int32), index.codes.shape[1]
+        )
+        valid = old_ids >= 0
+        codes_all = jnp.asarray(
+            np.concatenate([old_codes[valid], np.asarray(new_codes)], axis=0)
+        )
+        labels_all = jnp.asarray(
+            np.concatenate([old_labels[valid], np.asarray(labels)])
+        )
+        ids_all = jnp.asarray(np.concatenate([old_ids[valid], np.asarray(new_ids)]))
+    else:
+        codes_all, labels_all, ids_all = new_codes, labels, new_ids
+
+    counts = np.bincount(np.asarray(labels_all), minlength=index.n_lists)
+    cap = max(8, round_up_to_multiple(int(counts.max()), 8))
+    codes_packed, indices, list_sizes = _pack_lists(
+        codes_all, labels_all, ids_all, index.n_lists, cap
+    )
+
+    # precompute reconstruction norms ||recon||^2 per stored vector
+    if index.codebook_kind == codebook_gen.PER_SUBSPACE:
+        recon = _decode_gather(
+            codes_packed, index.pq_centers, index.codebook_kind
+        )  # [C, cap, rot_dim]
+    else:
+        recon = _decode_gather(
+            codes_packed, index.pq_centers, index.codebook_kind,
+            jnp.arange(index.n_lists)[:, None],
+        )
+    rec_norms = jnp.sum(recon * recon, axis=-1)
+
+    return dataclasses.replace(
+        index,
+        codes=codes_packed,
+        indices=indices,
+        list_sizes=list_sizes,
+        rec_norms=rec_norms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _pq_search(
+    arrays,
+    k: int,
+    n_probes: int,
+    metric_val: int,
+    group: int,
+    bucket_batch: int,
+    codebook_kind: int,
+    filter_nbits: int,
+    compute_dtype: str = "bf16",
+    local_recall_target: float = 0.95,
+):
+    (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
+     list_sizes, rec_norms, filter_bits) = arrays
+    metric = DistanceType(metric_val)
+    select_min = is_min_close(metric)
+    C, cap, p = codes.shape
+    rot_dim = rotation.shape[0]
+    q32 = queries.astype(jnp.float32)
+    m = q32.shape[0]
+    sentinel = sentinel_for(metric, jnp.float32)
+
+    # coarse phase (ivf_pq_search.cuh:70 select_clusters)
+    cdot = dist_dot(q32, centers.T)
+    if metric == DistanceType.InnerProduct:
+        coarse = cdot
+    else:
+        qn2 = jnp.sum(q32 * q32, axis=1, keepdims=True)
+        cn2 = jnp.sum(centers * centers, axis=1)
+        coarse = qn2 + cn2[None, :] - 2.0 * cdot
+    _, probes = select_k(coarse, n_probes, select_min=select_min)
+
+    (bucket_list, bucket_q, pair_bucket, pair_pos, order, total, nb_pad) = (
+        bucketize_pairs(probes, m, n_probes, C, group, bucket_batch)
+    )
+
+    kl = min(k, cap)
+    q_rot = dist_dot(q32, rotation.T)  # [m, rot_dim]
+    mm = jnp.bfloat16 if compute_dtype == "bf16" else jnp.float32
+
+    def body(_, inp):
+        bl, bq = inp  # [bb], [bb, group]
+        blk_codes = codes[bl]            # [bb, cap, p]
+        ids = indices[bl]
+        sizes = list_sizes[bl]
+        rn = rec_norms[bl]               # [bb, cap]
+        if codebook_kind == codebook_gen.PER_SUBSPACE:
+            recon = _decode_gather(blk_codes, pq_centers, codebook_kind)
+        else:
+            recon = _decode_gather(
+                blk_codes, pq_centers, codebook_kind, bl[:, None]
+            )                            # [bb, cap, rot_dim]
+        recon = recon.astype(mm)
+        qsafe = jnp.maximum(bq, 0)
+        q_res = q_rot[qsafe] - centers_rot[bl][:, None, :]  # [bb, g, rot_dim]
+        dots = jnp.einsum(
+            "bgd,bcd->bgc", q_res.astype(mm), recon,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if metric == DistanceType.InnerProduct:
+            # q·x ≈ q·c_l + q_rot·recon (rotation is orthogonal)
+            qc = jnp.einsum(
+                "bgd,bd->bg", q_rot[qsafe], centers_rot[bl],
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            qdots = jnp.einsum(
+                "bgd,bcd->bgc", q_rot[qsafe].astype(mm), recon,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            dist = qc[:, :, None] + qdots
+        else:
+            qrn = jnp.sum(q_res * q_res, axis=2)  # [bb, g]
+            dist = jnp.maximum(
+                qrn[:, :, None] - 2.0 * dots + rn[:, None, :], 0.0
+            )
+        col_ok = (jnp.arange(cap)[None, :] < sizes[:, None])[:, None, :]
+        valid = col_ok & (bq >= 0)[:, :, None]
+        if filter_bits is not None:
+            from raft_tpu.core.bitset import Bitset
+
+            safe_ids = jnp.clip(ids, 0, filter_nbits - 1)
+            keep = Bitset.test_bits(filter_bits, safe_ids) & (ids >= 0) & (
+                ids < filter_nbits)
+            valid = valid & keep[:, None, :]
+        dist = jnp.where(valid, dist, sentinel)
+        return None, merge_topk(
+            dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,
+            approx=local_recall_target < 1.0,
+            recall_target=local_recall_target,
+        )
+
+    xs = (
+        bucket_list.reshape(-1, bucket_batch),
+        bucket_q.reshape(-1, bucket_batch, group),
+    )
+    _, (cand_d, cand_i) = jax.lax.scan(body, None, xs)
+    out_d, out_i = unbucketize_merge(
+        cand_d.reshape(nb_pad, group, kl),
+        cand_i.reshape(nb_pad, group, kl),
+        pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
+        select_min, sentinel,
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+def search(
+    search_params: SearchParams,
+    index: Index,
+    queries,
+    k: int,
+    prefilter=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate k-NN search (reference ivf_pq-inl.cuh:480). Distances are
+    PQ approximations — pair with ``neighbors.refine`` for exact re-ranking
+    (the reference benchmarks do the same)."""
+    queries = jnp.asarray(queries)
+    n_probes = int(min(search_params.n_probes, index.n_lists))
+    cap = index.codes.shape[1]
+    if cap == 0:
+        raise ValueError("index is empty — build with add_data_on_build or extend")
+    if k > n_probes * cap:
+        raise ValueError(f"k={k} exceeds n_probes*list_capacity={n_probes * cap}")
+    filt = as_filter(prefilter)
+    bits = getattr(filt, "bitset", None)
+    arrays = (
+        queries, index.centers, index.centers_rot, index.rotation,
+        index.pq_centers, index.codes, index.indices, index.list_sizes,
+        index.rec_norms, None if bits is None else bits.bits,
+    )
+    return _pq_search(
+        arrays,
+        int(k),
+        n_probes,
+        int(index.metric),
+        int(search_params.query_group),
+        int(search_params.bucket_batch),
+        int(index.codebook_kind),
+        0 if bits is None else int(bits.n_bits),
+        str(search_params.compute_dtype),
+        float(search_params.local_recall_target),
+    )
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference detail/ivf_pq_serialize.cuh)
+# ---------------------------------------------------------------------------
+
+
+def save(path: str, index: Index) -> None:
+    arrays = {
+        "centers": np.asarray(index.centers),
+        "centers_rot": np.asarray(index.centers_rot),
+        "rotation": np.asarray(index.rotation),
+        "pq_centers": np.asarray(index.pq_centers),
+        "codes": np.asarray(index.codes),
+        "indices": np.asarray(index.indices),
+        "list_sizes": np.asarray(index.list_sizes),
+        "rec_norms": np.asarray(index.rec_norms),
+    }
+    write_index_file(
+        path, "ivf_pq", _SERIAL_VERSION,
+        {
+            "metric": int(index.metric),
+            "metric_arg": index.metric_arg,
+            "codebook_kind": index.codebook_kind,
+            "pq_bits": index.pq_bits,
+        },
+        arrays,
+    )
+
+
+def load(path: str) -> Index:
+    _, meta, arrays = read_index_file(path, "ivf_pq")
+    return Index(
+        centers=jnp.asarray(arrays["centers"]),
+        centers_rot=jnp.asarray(arrays["centers_rot"]),
+        rotation=jnp.asarray(arrays["rotation"]),
+        pq_centers=jnp.asarray(arrays["pq_centers"]),
+        codes=jnp.asarray(arrays["codes"]),
+        indices=jnp.asarray(arrays["indices"]),
+        list_sizes=jnp.asarray(arrays["list_sizes"]),
+        rec_norms=jnp.asarray(arrays["rec_norms"]),
+        metric=DistanceType(meta["metric"]),
+        metric_arg=meta["metric_arg"],
+        codebook_kind=int(meta["codebook_kind"]),
+        pq_bits=int(meta["pq_bits"]),
+    )

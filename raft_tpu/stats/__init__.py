@@ -1,0 +1,41 @@
+"""Stats layer: summary statistics + model-evaluation metrics.
+
+Reference: cpp/include/raft/stats/ (SURVEY.md §2.10).
+"""
+
+from raft_tpu.stats.moments import (
+    cov,
+    histogram,
+    mean,
+    mean_center,
+    meanvar,
+    minmax,
+    stddev,
+    weighted_mean,
+)
+from raft_tpu.stats.metrics import (
+    accuracy,
+    adjusted_rand_index,
+    completeness_score,
+    entropy,
+    homogeneity_score,
+    information_criterion,
+    mutual_info_score,
+    neighborhood_recall,
+    r2_score,
+    rand_index,
+    regression_metrics,
+    silhouette_score,
+    trustworthiness_score,
+    v_measure,
+)
+
+__all__ = [
+    "mean", "stddev", "cov", "minmax", "meanvar", "histogram",
+    "weighted_mean", "mean_center",
+    "accuracy", "r2_score", "regression_metrics",
+    "adjusted_rand_index", "rand_index", "silhouette_score", "v_measure",
+    "mutual_info_score", "entropy", "homogeneity_score",
+    "completeness_score", "information_criterion",
+    "neighborhood_recall", "trustworthiness_score",
+]

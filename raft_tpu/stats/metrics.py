@@ -1,0 +1,235 @@
+"""Model-evaluation metrics (reference raft/stats/: accuracy, r2_score,
+regression_metrics, adjusted_rand_index, mutual_info, entropy,
+homogeneity/completeness/v_measure, silhouette_score,
+information_criterion, trustworthiness, and the ANN-evaluation
+``neighborhood_recall`` — stats/neighborhood_recall.cuh:86,171)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.precision import dist_dot
+
+
+# ---------------------------------------------------------------------------
+# regression / classification
+# ---------------------------------------------------------------------------
+
+
+def accuracy(predictions, labels) -> jax.Array:
+    """Fraction of exact matches (reference stats/accuracy.cuh)."""
+    predictions = jnp.asarray(predictions)
+    labels = jnp.asarray(labels)
+    return jnp.mean((predictions == labels).astype(jnp.float32))
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """Coefficient of determination (reference stats/r2_score.cuh)."""
+    y = jnp.asarray(y).astype(jnp.float32)
+    y_hat = jnp.asarray(y_hat).astype(jnp.float32)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-30)
+
+
+def regression_metrics(predictions, ref) -> dict:
+    """MAE / MSE / median-AE (reference stats/regression_metrics.cuh)."""
+    p = jnp.asarray(predictions).astype(jnp.float32)
+    r = jnp.asarray(ref).astype(jnp.float32)
+    abs_diff = jnp.abs(p - r)
+    return {
+        "mean_abs_error": jnp.mean(abs_diff),
+        "mean_squared_error": jnp.mean((p - r) ** 2),
+        "median_abs_error": jnp.median(abs_diff),
+    }
+
+
+# ---------------------------------------------------------------------------
+# clustering metrics
+# ---------------------------------------------------------------------------
+
+
+def _contingency(a, b, n_classes_a: int, n_classes_b: int) -> jax.Array:
+    a = jnp.asarray(a).astype(jnp.int32)
+    b = jnp.asarray(b).astype(jnp.int32)
+    oh_a = (a[:, None] == jnp.arange(n_classes_a)[None, :]).astype(jnp.float32)
+    oh_b = (b[:, None] == jnp.arange(n_classes_b)[None, :]).astype(jnp.float32)
+    return dist_dot(oh_a.T, oh_b)  # [Ca, Cb]
+
+
+def _n_classes(x) -> int:
+    import numpy as np
+
+    return int(np.asarray(x).max()) + 1
+
+
+def rand_index(a, b) -> jax.Array:
+    """Unadjusted Rand index (reference stats/rand_index.cuh)."""
+    ca, cb = _n_classes(a), _n_classes(b)
+    m = _contingency(a, b, ca, cb)
+    n = jnp.asarray(a).shape[0]
+    sum_comb = jnp.sum(m * (m - 1) / 2)
+    sum_a = jnp.sum(m.sum(1) * (m.sum(1) - 1) / 2)
+    sum_b = jnp.sum(m.sum(0) * (m.sum(0) - 1) / 2)
+    total = n * (n - 1) / 2
+    return (total + 2 * sum_comb - sum_a - sum_b) / total
+
+
+def adjusted_rand_index(a, b) -> jax.Array:
+    """ARI (reference stats/adjusted_rand_index.cuh)."""
+    ca, cb = _n_classes(a), _n_classes(b)
+    m = _contingency(a, b, ca, cb)
+    n = jnp.asarray(a).shape[0]
+    sum_comb = jnp.sum(m * (m - 1) / 2)
+    sum_a = jnp.sum(m.sum(1) * (m.sum(1) - 1) / 2)
+    sum_b = jnp.sum(m.sum(0) * (m.sum(0) - 1) / 2)
+    total = n * (n - 1) / 2
+    expected = sum_a * sum_b / jnp.maximum(total, 1e-30)
+    max_index = (sum_a + sum_b) / 2
+    return (sum_comb - expected) / jnp.maximum(max_index - expected, 1e-30)
+
+
+def entropy(labels, n_classes: Optional[int] = None) -> jax.Array:
+    """Shannon entropy of a labeling (reference stats/entropy.cuh)."""
+    labels = jnp.asarray(labels)
+    c = n_classes if n_classes is not None else _n_classes(labels)
+    counts = jnp.bincount(labels.astype(jnp.int32), length=c).astype(jnp.float32)
+    p = counts / jnp.maximum(counts.sum(), 1e-30)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def mutual_info_score(a, b) -> jax.Array:
+    """Mutual information (reference stats/mutual_info_score.cuh)."""
+    ca, cb = _n_classes(a), _n_classes(b)
+    m = _contingency(a, b, ca, cb)
+    n = jnp.maximum(m.sum(), 1e-30)
+    pij = m / n
+    pi = pij.sum(1, keepdims=True)
+    pj = pij.sum(0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-30)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(ratio), 0.0))
+
+
+def homogeneity_score(truth, pred) -> jax.Array:
+    """(reference stats/homogeneity_score.cuh)."""
+    mi = mutual_info_score(truth, pred)
+    h = entropy(truth)
+    return jnp.where(h > 0, mi / h, 1.0)
+
+
+def completeness_score(truth, pred) -> jax.Array:
+    """(reference stats/completeness_score.cuh)."""
+    return homogeneity_score(pred, truth)
+
+
+def v_measure(truth, pred, beta: float = 1.0) -> jax.Array:
+    """(reference stats/v_measure.cuh)."""
+    h = homogeneity_score(truth, pred)
+    c = completeness_score(truth, pred)
+    return (1 + beta) * h * c / jnp.maximum(beta * h + c, 1e-30)
+
+
+def silhouette_score(x, labels, n_classes: Optional[int] = None) -> jax.Array:
+    """Mean silhouette coefficient (reference stats/silhouette_score.cuh).
+
+    Computed from the full pairwise-distance matrix — suitable for the same
+    sample sizes the reference's batched variant targets."""
+    x = jnp.asarray(x).astype(jnp.float32)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    c = n_classes if n_classes is not None else _n_classes(labels)
+    n = x.shape[0]
+    xn = jnp.sum(x * x, axis=1)
+    d = jnp.sqrt(jnp.maximum(
+        xn[:, None] + xn[None, :] - 2.0 * dist_dot(x, x.T), 0.0))
+    one_hot = (labels[:, None] == jnp.arange(c)[None, :]).astype(jnp.float32)
+    # mean distance of sample i to every cluster: [n, c]
+    sums = dist_dot(d, one_hot)
+    counts = one_hot.sum(0)[None, :]
+    own = one_hot.astype(bool)
+    # a(i): mean dist to own cluster, excluding self
+    own_count = jnp.take_along_axis(
+        jnp.broadcast_to(counts, (n, c)), labels[:, None], 1)[:, 0]
+    a = jnp.take_along_axis(sums, labels[:, None], 1)[:, 0] / jnp.maximum(
+        own_count - 1, 1)
+    # b(i): min over other clusters of mean dist
+    means = sums / jnp.maximum(counts, 1)
+    means = jnp.where(own, jnp.inf, means)
+    b = jnp.min(means, axis=1)
+    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
+    # singleton clusters contribute 0
+    s = jnp.where(own_count > 1, s, 0.0)
+    return jnp.mean(s)
+
+
+def information_criterion(
+    log_likelihood, n_params: int, n_samples: int, kind: str = "aic"
+):
+    """AIC / AICc / BIC (reference stats/information_criterion.cuh)."""
+    ll = jnp.asarray(log_likelihood)
+    if kind == "aic":
+        return -2.0 * ll + 2.0 * n_params
+    if kind == "aicc":
+        corr = 2.0 * n_params * (n_params + 1) / max(n_samples - n_params - 1, 1)
+        return -2.0 * ll + 2.0 * n_params + corr
+    if kind == "bic":
+        return -2.0 * ll + n_params * math.log(max(n_samples, 1))
+    raise ValueError(f"unknown criterion {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# neighborhood metrics (ANN evaluation)
+# ---------------------------------------------------------------------------
+
+
+def neighborhood_recall(
+    indices, ref_indices, distances=None, ref_distances=None, eps: float = 1e-3
+) -> jax.Array:
+    """Recall of ANN results vs ground truth with distance-tie tolerance
+    (reference stats/neighborhood_recall.cuh:86). [m, k] each."""
+    indices = jnp.asarray(indices)
+    ref_indices = jnp.asarray(ref_indices)
+    match = (indices[:, :, None] == ref_indices[:, None, :]).any(-1)
+    if distances is not None and ref_distances is not None:
+        distances = jnp.asarray(distances)
+        ref_distances = jnp.asarray(ref_distances)
+        # a miss whose distance ties the reference counts as a hit
+        tie = (
+            jnp.abs(distances[:, :, None] - ref_distances[:, None, :]) <= eps
+        ).any(-1)
+        match = match | tie
+    return jnp.mean(match.astype(jnp.float32))
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int = 5) -> jax.Array:
+    """Embedding trustworthiness (reference stats/trustworthiness_score.cuh).
+
+    T(k) = 1 - 2/(n k (2n - 3k - 1)) * sum_i sum_{j in kNN_emb(i) \\ kNN_x(i)}
+    (rank_x(i, j) - k)."""
+    x = jnp.asarray(x).astype(jnp.float32)
+    e = jnp.asarray(x_embedded).astype(jnp.float32)
+    n = x.shape[0]
+    k = n_neighbors
+
+    def sqdist(a):
+        an = jnp.sum(a * a, axis=1)
+        d = an[:, None] + an[None, :] - 2.0 * dist_dot(a, a.T)
+        return d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+
+    dx = sqdist(x)
+    de = sqdist(e)
+    # rank of each point in x-space per row (0 = nearest)
+    order_x = jnp.argsort(dx, axis=1)
+    ranks_x = jnp.zeros((n, n), jnp.int32)
+    ranks_x = jax.vmap(
+        lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32))
+    )(ranks_x, order_x)
+    # k nearest in embedding space
+    knn_e = jnp.argsort(de, axis=1)[:, :k]
+    r = jnp.take_along_axis(ranks_x, knn_e, axis=1)  # [n, k]
+    penalty = jnp.sum(jnp.maximum(r - k + 1, 0).astype(jnp.float32))
+    denom = n * k * (2.0 * n - 3.0 * k - 1.0)
+    return 1.0 - 2.0 / denom * penalty

@@ -1,0 +1,92 @@
+"""Summary statistics (reference raft/stats/{mean,stddev,cov,minmax,meanvar,
+histogram,weighted_mean}.cuh). All are thin jit-compatible reductions — the
+reference needs custom CUDA kernels for these; XLA fuses them for free."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mean(data, along_rows: bool = True) -> jax.Array:
+    """Column means (reference stats/mean.cuh). ``along_rows=True`` averages
+    over rows (the reference's rowMajor sample-major convention)."""
+    return jnp.mean(jnp.asarray(data), axis=0 if along_rows else 1)
+
+
+def stddev(data, mu=None, sample: bool = False) -> jax.Array:
+    """Column standard deviations (reference stats/stddev.cuh)."""
+    data = jnp.asarray(data)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    var = jnp.mean((data - mu[None, :]) ** 2, axis=0)
+    if sample:
+        n = data.shape[0]
+        var = var * n / jnp.maximum(n - 1, 1)
+    return jnp.sqrt(var)
+
+
+def meanvar(data, sample: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Fused mean+variance (reference stats/meanvar.cuh)."""
+    data = jnp.asarray(data)
+    mu = jnp.mean(data, axis=0)
+    var = jnp.mean((data - mu[None, :]) ** 2, axis=0)
+    if sample:
+        n = data.shape[0]
+        var = var * n / jnp.maximum(n - 1, 1)
+    return mu, var
+
+
+def mean_center(data, mu=None) -> jax.Array:
+    """Subtract column means (reference stats/mean_center.cuh)."""
+    data = jnp.asarray(data)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    return data - mu[None, :]
+
+
+def cov(data, mu=None, sample: bool = True) -> jax.Array:
+    """Covariance matrix (reference stats/cov.cuh): centered gram / (n-1)."""
+    data = jnp.asarray(data).astype(jnp.float32)
+    n = data.shape[0]
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    c = data - mu[None, :]
+    denom = jnp.maximum(n - 1, 1) if sample else n
+    return jnp.dot(
+        c.T, c, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ) / denom
+
+
+def minmax(data) -> Tuple[jax.Array, jax.Array]:
+    """Column-wise (min, max) (reference stats/minmax.cuh)."""
+    data = jnp.asarray(data)
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)
+
+
+def weighted_mean(data, weights, along_rows: bool = True) -> jax.Array:
+    """Weighted mean (reference stats/weighted_mean.cuh)."""
+    data = jnp.asarray(data).astype(jnp.float32)
+    w = jnp.asarray(weights).astype(jnp.float32)
+    axis = 0 if along_rows else 1
+    ws = w[:, None] if axis == 0 else w[None, :]
+    return (data * ws).sum(axis) / jnp.maximum(w.sum(), 1e-30)
+
+
+def histogram(data, n_bins: int, lo=None, hi=None) -> Tuple[jax.Array, jax.Array]:
+    """Per-column histogram (reference stats/histogram.cuh).
+
+    Returns (counts [n_bins, n_cols], edges [n_bins+1])."""
+    data = jnp.asarray(data)
+    if data.ndim == 1:
+        data = data[:, None]
+    lo = jnp.min(data) if lo is None else lo
+    hi = jnp.max(data) if hi is None else hi
+    edges = jnp.linspace(lo, hi, n_bins + 1)
+    scaled = (data - lo) / jnp.maximum(hi - lo, 1e-30) * n_bins
+    bins = jnp.clip(scaled.astype(jnp.int32), 0, n_bins - 1)
+    one_hot = bins[:, :, None] == jnp.arange(n_bins)[None, None, :]
+    return one_hot.sum(axis=0).T.astype(jnp.int32), edges

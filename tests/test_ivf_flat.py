@@ -1,0 +1,167 @@
+"""IVF-Flat tests — reference pattern (cpp/test/neighbors/ann_ivf_flat.cuh):
+oracle = naive KNN, assertion = recall >= n_probes/n_lists-derived bound;
+plus build-structure, extend, filter and serialization round-trips."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import ivf_flat
+from tests.oracles import eval_recall, naive_knn
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(0)
+    centers = rng.uniform(-5, 5, (32, 24)).astype(np.float32)
+    x = (centers[rng.integers(0, 32, 8000)]
+         + 0.8 * rng.standard_normal((8000, 24))).astype(np.float32)
+    q = (centers[rng.integers(0, 32, 200)]
+         + 0.8 * rng.standard_normal((200, 24))).astype(np.float32)
+    return x, q
+
+
+def _build(x, n_lists=32, metric="sqeuclidean", **kw):
+    params = ivf_flat.IndexParams(n_lists=n_lists, metric=metric,
+                                  kmeans_n_iters=10, **kw)
+    return ivf_flat.build(params, x)
+
+
+def test_build_structure(dataset):
+    x, _ = dataset
+    index = _build(x)
+    assert index.n_lists == 32
+    assert index.size == x.shape[0]
+    sizes = np.asarray(index.list_sizes)
+    assert sizes.sum() == x.shape[0]
+    assert sizes.min() > 0
+    # every row lands in exactly one list with its own id
+    _, ids = ivf_flat.reconstruct_dataset(index)
+    assert sorted(ids.tolist()) == list(range(x.shape[0]))
+    # stored vectors must match the source rows
+    vecs, ids0 = ivf_flat.get_list_data(index, 0)
+    np.testing.assert_array_equal(vecs, x[ids0])
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "inner_product"])
+def test_search_recall_high_probes(dataset, metric):
+    x, q = dataset
+    k = 10
+    index = _build(x, metric=metric)
+    # probing every list == exact search
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64, bucket_batch=4,
+                               compute_dtype="f32", local_recall_target=1.0)
+    dist, idx = ivf_flat.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k, metric)
+    assert eval_recall(np.asarray(idx), want) > 0.99
+
+
+def test_search_recall_partial_probes(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x)
+    sp = ivf_flat.SearchParams(n_probes=8, query_group=64, bucket_batch=4)
+    _, idx = ivf_flat.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    # reference bound: recall >= ~n_probes/n_lists-derived; clustered data
+    # with 8/32 probes lands well above 0.8
+    assert eval_recall(np.asarray(idx), want) > 0.8
+
+
+def test_search_distances_match_oracle(dataset):
+    x, q = dataset
+    k = 5
+    index = _build(x)
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64,
+                               compute_dtype="f32", local_recall_target=1.0)
+    dist, idx = ivf_flat.search(sp, index, q, k)
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.sort(d2, axis=1)[:, :k]
+    np.testing.assert_allclose(np.asarray(dist), want, rtol=1e-3, atol=1e-2)
+
+
+def test_extend(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x[:4000])
+    assert index.size == 4000
+    index = ivf_flat.extend(index, x[4000:])
+    assert index.size == 8000
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64,
+                               compute_dtype="f32", local_recall_target=1.0)
+    _, idx = ivf_flat.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.99
+
+
+def test_prefilter(dataset):
+    x, q = dataset
+    k = 10
+    n = x.shape[0]
+    index = _build(x)
+    allowed = np.zeros(n, bool)
+    allowed[: n // 4] = True
+    bits = Bitset.from_dense(allowed)
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64,
+                               compute_dtype="f32", local_recall_target=1.0)
+    _, idx = ivf_flat.search(sp, index, q, k, prefilter=bits)
+    idx = np.asarray(idx)
+    assert (idx < n // 4).all() or ((idx == -1) | (idx < n // 4)).all()
+    _, want = naive_knn(q, x[: n // 4], k)
+    assert eval_recall(idx, want) > 0.99
+
+
+def test_small_k_exceeding_list(dataset):
+    x, q = dataset
+    index = _build(x, n_lists=32)
+    cap = index.storage.shape[1]
+    # k bigger than any single list but within n_probes * cap
+    k = min(2 * cap, 512)
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64,
+                               compute_dtype="f32", local_recall_target=1.0)
+    _, idx = ivf_flat.search(sp, index, q[:20], k)
+    _, want = naive_knn(q[:20], x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.99
+
+
+def test_serialize_roundtrip(dataset, tmp_path):
+    x, q = dataset
+    index = _build(x)
+    p = str(tmp_path / "ivf.idx")
+    ivf_flat.save(p, index)
+    loaded = ivf_flat.load(p)
+    sp = ivf_flat.SearchParams(n_probes=8, query_group=64)
+    d1, i1 = ivf_flat.search(sp, index, q, 10)
+    d2, i2 = ivf_flat.search(sp, loaded, q, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+def test_build_without_data_then_extend(dataset):
+    x, q = dataset
+    params = ivf_flat.IndexParams(n_lists=32, kmeans_n_iters=10,
+                                  add_data_on_build=False)
+    index = ivf_flat.build(params, x)
+    assert index.size == 0
+    with pytest.raises(ValueError):
+        ivf_flat.search(ivf_flat.SearchParams(n_probes=4), index, q, 5)
+    index = ivf_flat.extend(index, x)
+    assert index.size == x.shape[0]
+    _, idx = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=32, query_group=64,
+                              compute_dtype="f32", local_recall_target=1.0),
+        index, q, 10)
+    _, want = naive_knn(q, x, 10)
+    assert eval_recall(np.asarray(idx), want) > 0.99
+
+
+def test_search_fast_defaults(dataset):
+    # default fast path: bf16 matmuls + approx per-list top-k — still high
+    # recall when probing everything
+    x, q = dataset
+    k = 10
+    index = _build(x)
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64, bucket_batch=4)
+    _, idx = ivf_flat.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.9

@@ -1,0 +1,154 @@
+"""IVF-PQ tests — reference pattern (cpp/test/neighbors/ann_ivf_pq/,
+pylibraft test_ivf_pq.py): recall vs exact oracle with PQ-appropriate
+bounds, refine recovery, codebook modes, serialization."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import ivf_pq, refine
+from tests.oracles import eval_recall, naive_knn
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    centers = rng.uniform(-5, 5, (32, 32)).astype(np.float32)
+    x = (centers[rng.integers(0, 32, 6000)]
+         + 0.5 * rng.standard_normal((6000, 32))).astype(np.float32)
+    q = (centers[rng.integers(0, 32, 150)]
+         + 0.5 * rng.standard_normal((150, 32))).astype(np.float32)
+    return x, q
+
+
+def _build(x, n_lists=16, pq_dim=16, pq_bits=8, **kw):
+    params = ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=pq_dim, pq_bits=pq_bits,
+        kmeans_n_iters=10, **kw)
+    return ivf_pq.build(params, x)
+
+
+def test_build_structure(dataset):
+    x, _ = dataset
+    index = _build(x)
+    assert index.size == x.shape[0]
+    assert index.pq_dim == 16
+    assert index.pq_len == 2
+    assert index.rot_dim == 32
+    assert index.codes.dtype == np.uint8
+    assert index.pq_centers.shape == (16, 256, 2)
+    # rotation must have orthonormal columns
+    R = np.asarray(index.rotation)
+    np.testing.assert_allclose(R.T @ R, np.eye(32), atol=1e-4)
+
+
+def test_search_recall(dataset):
+    x, q = dataset
+    k = 10
+    # pq_dim=16 → 2x compression; quantization-limited recall ~0.73 here
+    # (measured: 0.44/0.73/0.96 for pq_dim 8/16/32 — scales as expected)
+    index = _build(x)
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, idx = ivf_pq.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.65
+
+
+def test_search_with_refine(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x)
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, cand = ivf_pq.search(sp, index, q, 8 * k)
+    _, idx = refine(x, q, cand, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.95
+
+
+def test_per_cluster_codebooks(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x, codebook_kind=ivf_pq.codebook_gen.PER_CLUSTER)
+    assert index.pq_centers.shape[0] == index.n_lists
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, idx = ivf_pq.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.7
+
+
+def test_pq_bits_4(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x, pq_bits=4)
+    assert index.pq_book_size == 16
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, cand = ivf_pq.search(sp, index, q, 10 * k)
+    _, idx = refine(x, q, cand, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.8
+
+
+def test_inner_product(dataset):
+    x, q = dataset
+    k = 10
+    index = _build(x, metric="inner_product")
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, idx = ivf_pq.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k, "inner_product")
+    assert eval_recall(np.asarray(idx), want) > 0.55
+
+
+def test_prefilter(dataset):
+    x, q = dataset
+    k = 10
+    n = x.shape[0]
+    index = _build(x)
+    allowed = np.zeros(n, bool)
+    allowed[: n // 4] = True
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, idx = ivf_pq.search(sp, index, q, k, prefilter=Bitset.from_dense(allowed))
+    idx = np.asarray(idx)
+    assert ((idx == -1) | (idx < n // 4)).all()
+
+
+def test_extend(dataset):
+    x, q = dataset
+    index = _build(x[:3000])
+    index = ivf_pq.extend(index, x[3000:])
+    assert index.size == x.shape[0]
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, cand = ivf_pq.search(sp, index, q, 80)
+    _, idx = refine(x, q, cand, 10)
+    _, want = naive_knn(q, x, 10)
+    assert eval_recall(np.asarray(idx), want) > 0.9
+
+
+def test_serialize_roundtrip(dataset, tmp_path):
+    x, q = dataset
+    index = _build(x)
+    p = str(tmp_path / "pq.idx")
+    ivf_pq.save(p, index)
+    loaded = ivf_pq.load(p)
+    sp = ivf_pq.SearchParams(n_probes=8, query_group=64, bucket_batch=4)
+    d1, i1 = ivf_pq.search(sp, index, q, 10)
+    d2, i2 = ivf_pq.search(sp, loaded, q, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_decode_roundtrip():
+    # encoding then decoding must land on the nearest codebook entries
+    rng = np.random.default_rng(3)
+    import jax.numpy as jnp
+    from raft_tpu.neighbors.ivf_pq import _decode_gather, _encode_subspace
+
+    p, K, ln = 4, 16, 2
+    cb = jnp.asarray(rng.standard_normal((p, K, ln)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((50, p, ln)), jnp.float32)
+    codes = _encode_subspace(res, cb, K)
+    recon = _decode_gather(codes, cb, ivf_pq.codebook_gen.PER_SUBSPACE)
+    recon = np.asarray(recon).reshape(50, p, ln)
+    # each reconstructed subvector is the argmin codebook entry
+    d = ((np.asarray(res)[:, :, None, :] - np.asarray(cb)[None]) ** 2).sum(-1)
+    want = d.argmin(-1)
+    np.testing.assert_array_equal(np.asarray(codes), want)
+    np.testing.assert_allclose(recon, np.asarray(cb)[np.arange(p), want], rtol=1e-6)

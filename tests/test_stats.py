@@ -1,0 +1,136 @@
+"""Stats tests — oracle = numpy/sklearn-style formulas (reference
+cpp/test/stats/*)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((200, 8)).astype(np.float32)
+
+
+def test_moments(data):
+    np.testing.assert_allclose(np.asarray(stats.mean(data)), data.mean(0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.stddev(data)), data.std(0),
+                               rtol=1e-4, atol=1e-5)
+    mu, var = stats.meanvar(data, sample=True)
+    np.testing.assert_allclose(np.asarray(var), data.var(0, ddof=1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats.cov(data)),
+                               np.cov(data, rowvar=False),
+                               rtol=1e-3, atol=1e-4)
+    lo, hi = stats.minmax(data)
+    np.testing.assert_allclose(np.asarray(lo), data.min(0))
+    np.testing.assert_allclose(np.asarray(hi), data.max(0))
+
+
+def test_weighted_mean(data):
+    w = np.abs(np.random.default_rng(1).standard_normal(200)).astype(np.float32)
+    want = (data * w[:, None]).sum(0) / w.sum()
+    np.testing.assert_allclose(np.asarray(stats.weighted_mean(data, w)), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_histogram():
+    x = np.array([0.0, 0.1, 0.5, 0.9, 1.0], np.float32)[:, None]
+    counts, edges = stats.histogram(x, 2, lo=0.0, hi=1.0)
+    assert counts.sum() == 5
+    # matches np.histogram: 0.5 lands in the upper bin, 1.0 clips into it
+    np.testing.assert_array_equal(np.asarray(counts)[:, 0], [2, 3])
+
+
+def test_accuracy_r2():
+    assert float(stats.accuracy([1, 2, 3, 4], [1, 2, 0, 4])) == 0.75
+    y = np.array([1.0, 2.0, 3.0, 4.0])
+    assert abs(float(stats.r2_score(y, y)) - 1.0) < 1e-6
+    m = stats.regression_metrics([1.0, 2.0], [1.5, 2.5])
+    assert abs(float(m["mean_abs_error"]) - 0.5) < 1e-6
+
+
+def test_rand_indices():
+    a = np.array([0, 0, 1, 1, 2, 2])
+    assert abs(float(stats.adjusted_rand_index(a, a)) - 1.0) < 1e-5
+    assert abs(float(stats.rand_index(a, a)) - 1.0) < 1e-5
+    # permuted labels are still a perfect clustering
+    b = np.array([2, 2, 0, 0, 1, 1])
+    assert abs(float(stats.adjusted_rand_index(a, b)) - 1.0) < 1e-5
+
+
+def test_ari_vs_sklearn_formula():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 4, 100)
+    b = rng.integers(0, 3, 100)
+    try:
+        from sklearn.metrics import adjusted_rand_score
+        want = adjusted_rand_score(a, b)
+        got = float(stats.adjusted_rand_index(a, b))
+        assert abs(got - want) < 1e-4
+    except ImportError:
+        pytest.skip("sklearn unavailable")
+
+
+def test_entropy_mutual_info():
+    a = np.array([0, 0, 1, 1])
+    assert abs(float(stats.entropy(a)) - np.log(2)) < 1e-5
+    # identical labelings: MI == entropy
+    assert abs(float(stats.mutual_info_score(a, a)) - np.log(2)) < 1e-5
+    assert abs(float(stats.v_measure(a, a)) - 1.0) < 1e-5
+    assert abs(float(stats.homogeneity_score(a, a)) - 1.0) < 1e-5
+
+
+def test_silhouette():
+    # two tight, well-separated blobs -> silhouette near 1
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((50, 4)) * 0.01
+    b = rng.standard_normal((50, 4)) * 0.01 + 10.0
+    x = np.concatenate([a, b]).astype(np.float32)
+    labels = np.array([0] * 50 + [1] * 50)
+    s = float(stats.silhouette_score(x, labels))
+    assert s > 0.95
+    try:
+        from sklearn.metrics import silhouette_score as sk
+        assert abs(s - sk(x, labels)) < 1e-2
+    except ImportError:
+        pass
+
+
+def test_information_criterion():
+    aic = float(stats.information_criterion(-10.0, 3, 100, "aic"))
+    assert abs(aic - 26.0) < 1e-6
+    bic = float(stats.information_criterion(-10.0, 3, 100, "bic"))
+    assert abs(bic - (20 + 3 * np.log(100))) < 1e-5
+
+
+def test_neighborhood_recall():
+    idx = np.array([[0, 1, 2], [3, 4, 5]])
+    ref = np.array([[0, 1, 9], [3, 4, 5]])
+    r = float(stats.neighborhood_recall(idx, ref))
+    assert abs(r - 5 / 6) < 1e-6
+    # distance ties rescue the miss
+    d = np.array([[0.0, 1.0, 2.0], [0.0, 1.0, 2.0]])
+    rd = np.array([[0.0, 1.0, 2.0], [0.0, 1.0, 2.0]])
+    r2 = float(stats.neighborhood_recall(idx, ref, d, rd))
+    assert abs(r2 - 1.0) < 1e-6
+
+
+def test_trustworthiness():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((60, 5)).astype(np.float32)
+    # identity embedding is perfectly trustworthy
+    t = float(stats.trustworthiness_score(x, x.copy(), n_neighbors=5))
+    assert abs(t - 1.0) < 1e-5
+    # random embedding is much worse
+    y = rng.standard_normal((60, 2)).astype(np.float32)
+    t2 = float(stats.trustworthiness_score(x, y, n_neighbors=5))
+    assert t2 < t
+    try:
+        from sklearn.manifold import trustworthiness as sk_t
+        want = sk_t(x, y, n_neighbors=5)
+        assert abs(t2 - want) < 5e-2
+    except ImportError:
+        pass
