@@ -51,16 +51,28 @@ def _as_f32(x) -> jax.Array:
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _predict_metric(x, centers, metric: int, batch_rows: int = 1 << 16):
     """Nearest-center labels under L2 or InnerProduct (reference
-    detail/kmeans_balanced.cuh:371 predict)."""
+    detail/kmeans_balanced.cuh:371 predict). Row-batched so peak memory
+    stays at batch_rows x n_clusters."""
     if metric == int(DistanceType.InnerProduct):
-        scores = dist_dot(x, centers.T)
-        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+        from raft_tpu.cluster.kmeans import _row_batches
+
+        xb, _, n = _row_batches(x.astype(jnp.float32), batch_rows)
+
+        def body(_, batch):
+            scores = dist_dot(batch, centers.T)
+            return None, jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+        _, labels = jax.lax.scan(body, None, xb)
+        return labels.reshape(-1)[:n]
     labels, _ = _predict_labels(x, centers, batch_rows)
     return labels
 
 
-@functools.partial(jax.jit, static_argnums=(4,))
-def _balancing_em_iter(x, centers, key, ratio_threshold, n_clusters: int):
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _balancing_em_iter(
+    x, centers, key, ratio_threshold, n_clusters: int,
+    metric: int = int(DistanceType.L2Expanded),
+):
     """One predict → update → adjust_centers iteration, fully jitted.
 
     ``adjust_centers`` (reference detail/kmeans_balanced.cuh:524): clusters
@@ -69,7 +81,7 @@ def _balancing_em_iter(x, centers, key, ratio_threshold, n_clusters: int):
     balanced (what "balanced" k-means means here).
     """
     n = x.shape[0]
-    labels, _ = _predict_labels(x, centers, min(n, 1 << 16))
+    labels = _predict_metric(x, centers, metric, min(n, 1 << 16))
     sums, sizes = _centers_and_sizes(x, labels, None, n_clusters, min(n, 1 << 16))
     new_centers = jnp.where(
         sizes[:, None] > 0, sums / jnp.maximum(sizes, 1.0)[:, None], centers
@@ -107,7 +119,9 @@ def build_clusters(
     for it in range(n_iters):
         key, sub = jax.random.split(key)
         ratio = jnp.float32(0.25 * (1.0 - it / max(n_iters, 1)))
-        centers, sizes, _ = _balancing_em_iter(x, centers, sub, ratio, n_clusters)
+        centers, sizes, _ = _balancing_em_iter(
+            x, centers, sub, ratio, n_clusters, int(metric)
+        )
     return centers, sizes
 
 
@@ -207,7 +221,7 @@ def build_hierarchical(
         key, sub = jax.random.split(key)
         ratio = jnp.float32(0.25 * (1.0 - it / max(iters, 1)))
         centers, _, _ = _balancing_em_iter(
-            x_dev, centers, sub, ratio, n_clusters
+            x_dev, centers, sub, ratio, n_clusters, int(metric)
         )
     return centers
 

@@ -67,6 +67,20 @@ def as_filter(f) -> NoneSampleFilter | BitsetFilter:
     return f
 
 
+def filter_keep(filter_bits, filter_nbits: int, sample_ids):
+    """Jit-safe keep-mask for a raw bitset: True where the sample id is in
+    range and its bit is set. The single implementation behind BitsetFilter
+    and the IVF scan kernels."""
+    import jax.numpy as _jnp
+
+    safe = _jnp.clip(sample_ids, 0, filter_nbits - 1)
+    return (
+        Bitset.test_bits(filter_bits, safe)
+        & (sample_ids >= 0)
+        & (sample_ids < filter_nbits)
+    )
+
+
 # --------------------------------------------------------------------------
 # Sentinels and top-k merge
 # --------------------------------------------------------------------------

@@ -43,12 +43,28 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
-from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.neighbors.common import (
+    as_filter,
+    filter_keep,
+    merge_topk,
+    sentinel_for,
+)
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.utils.math import round_up_to_multiple
 from raft_tpu.utils.precision import dist_dot
 
 _SERIAL_VERSION = 1
+
+
+# metrics the list-scan kernel implements; anything else would silently be
+# scored as expanded L2
+_SUPPORTED_METRICS = frozenset({
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded,
+    DistanceType.InnerProduct,
+    DistanceType.CosineExpanded,
+})
 
 
 @dataclasses.dataclass
@@ -66,6 +82,11 @@ class IndexParams:
 
     def __post_init__(self):
         self.metric = resolve_metric(self.metric)
+        if self.metric not in _SUPPORTED_METRICS:
+            raise ValueError(
+                f"ivf_flat supports {sorted(m.name for m in _SUPPORTED_METRICS)}, "
+                f"got {self.metric!r}"
+            )
 
 
 @dataclasses.dataclass
@@ -122,6 +143,7 @@ def _needs_norms(metric: DistanceType) -> bool:
     return metric in (
         DistanceType.L2Expanded,
         DistanceType.L2SqrtExpanded,
+        DistanceType.L2Unexpanded,
         DistanceType.CosineExpanded,
     )
 
@@ -400,12 +422,7 @@ def _ivf_search(
         col_ok = (jnp.arange(cap)[None, :] < sizes[:, None])[:, None, :]
         valid = col_ok & (bq >= 0)[:, :, None]
         if filter_bits is not None:
-            from raft_tpu.core.bitset import Bitset
-
-            safe_ids = jnp.clip(ids, 0, filter_nbits - 1)
-            keep = Bitset.test_bits(filter_bits, safe_ids) & (ids >= 0) & (
-                ids < filter_nbits)
-            valid = valid & keep[:, None, :]
+            valid = valid & filter_keep(filter_bits, filter_nbits, ids)[:, None, :]
         dist = jnp.where(valid, dist, sentinel)
         ld, lsel = merge_topk(
             dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,

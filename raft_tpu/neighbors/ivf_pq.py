@@ -40,7 +40,12 @@ from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams, build_cluster
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
-from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.neighbors.common import (
+    as_filter,
+    filter_keep,
+    merge_topk,
+    sentinel_for,
+)
 from raft_tpu.neighbors.ivf_flat import (
     _pack_lists,
     bucketize_pairs,
@@ -57,6 +62,16 @@ class codebook_gen:
 
     PER_SUBSPACE = 0
     PER_CLUSTER = 1
+
+
+# metrics the PQ residual scoring path implements; anything else would be
+# silently mis-scored as L2 (reference ivf_pq has the same L2/IP restriction)
+_SUPPORTED_METRICS = frozenset({
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded,
+    DistanceType.InnerProduct,
+})
 
 
 @dataclasses.dataclass
@@ -76,6 +91,11 @@ class IndexParams:
 
     def __post_init__(self):
         self.metric = resolve_metric(self.metric)
+        if self.metric not in _SUPPORTED_METRICS:
+            raise ValueError(
+                f"ivf_pq supports {sorted(m.name for m in _SUPPORTED_METRICS)}, "
+                f"got {self.metric!r}"
+            )
         if not 4 <= self.pq_bits <= 8:
             raise ValueError(f"pq_bits must be in [4, 8], got {self.pq_bits}")
 
@@ -220,7 +240,13 @@ def build(params: IndexParams, dataset) -> Index:
     else:
         trainset = dataset
     kb = KMeansBalancedParams(
-        n_clusters=n_lists, n_iters=int(params.kmeans_n_iters)
+        n_clusters=n_lists,
+        n_iters=int(params.kmeans_n_iters),
+        metric=(
+            DistanceType.InnerProduct
+            if params.metric == DistanceType.InnerProduct
+            else DistanceType.L2Expanded
+        ),
     )
     centers = kmeans_balanced.fit(kb, trainset)
 
@@ -286,7 +312,14 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         new_ids = jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
     new_ids = jnp.asarray(new_ids).astype(jnp.int32)
 
-    kb = KMeansBalancedParams(n_clusters=index.n_lists)
+    kb = KMeansBalancedParams(
+        n_clusters=index.n_lists,
+        metric=(
+            DistanceType.InnerProduct
+            if index.metric == DistanceType.InnerProduct
+            else DistanceType.L2Expanded
+        ),
+    )
     labels = kmeans_balanced.predict(kb, index.centers, new_vectors)
 
     # encode: rotated residual → per-subspace nearest codebook entry
@@ -440,12 +473,7 @@ def _pq_search(
         col_ok = (jnp.arange(cap)[None, :] < sizes[:, None])[:, None, :]
         valid = col_ok & (bq >= 0)[:, :, None]
         if filter_bits is not None:
-            from raft_tpu.core.bitset import Bitset
-
-            safe_ids = jnp.clip(ids, 0, filter_nbits - 1)
-            keep = Bitset.test_bits(filter_bits, safe_ids) & (ids >= 0) & (
-                ids < filter_nbits)
-            valid = valid & keep[:, None, :]
+            valid = valid & filter_keep(filter_bits, filter_nbits, ids)[:, None, :]
         dist = jnp.where(valid, dist, sentinel)
         return None, merge_topk(
             dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,

@@ -155,9 +155,10 @@ def silhouette_score(x, labels, n_classes: Optional[int] = None) -> jax.Array:
         jnp.broadcast_to(counts, (n, c)), labels[:, None], 1)[:, 0]
     a = jnp.take_along_axis(sums, labels[:, None], 1)[:, 0] / jnp.maximum(
         own_count - 1, 1)
-    # b(i): min over other clusters of mean dist
+    # b(i): min over other *non-empty* clusters of mean dist (an empty
+    # class id would otherwise contribute a spurious 0)
     means = sums / jnp.maximum(counts, 1)
-    means = jnp.where(own, jnp.inf, means)
+    means = jnp.where(own | (counts == 0), jnp.inf, means)
     b = jnp.min(means, axis=1)
     s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
     # singleton clusters contribute 0

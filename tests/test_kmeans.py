@@ -175,3 +175,18 @@ def test_kmeans_rejects_unsupported_metric():
     x = np.zeros((10, 3), np.float32)
     with pytest.raises(ValueError):
         kmeans.fit(KMeansParams(n_clusters=2, metric=DistanceType.InnerProduct), x)
+
+
+def test_balanced_fit_inner_product_metric():
+    # regression: metric must reach the balancing EM (was silently L2)
+    from raft_tpu.distance.types import DistanceType
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((600, 8)).astype(np.float32)
+    params = KMeansBalancedParams(
+        n_clusters=8, n_iters=10, metric=DistanceType.InnerProduct, seed=0)
+    centers = kmeans_balanced.fit(params, x)
+    labels = np.asarray(kmeans_balanced.predict(params, centers, x))
+    # assignment must be by max inner product
+    expected = (x @ np.asarray(centers).T).argmax(1)
+    np.testing.assert_array_equal(labels, expected)

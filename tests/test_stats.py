@@ -134,3 +134,14 @@ def test_trustworthiness():
         assert abs(t2 - want) < 5e-2
     except ImportError:
         pass
+
+
+def test_silhouette_empty_class_id():
+    # regression: a class id with zero members must not poison b(i)
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((30, 4)) * 0.01
+    b = rng.standard_normal((30, 4)) * 0.01 + 10.0
+    x = np.concatenate([a, b]).astype(np.float32)
+    labels = np.array([0] * 30 + [2] * 30)  # class 1 empty
+    s = float(stats.silhouette_score(x, labels))
+    assert s > 0.95
