@@ -75,6 +75,7 @@ def bench_ivfflat_sift1m():
 
     params = ivf_flat.IndexParams(n_lists=1024, metric="sqeuclidean")
     index = ivf_flat.build(params, x)
+    # scan_impl="auto" dispatches to the fused Pallas scan kernel on TPU
     sp = ivf_flat.SearchParams(n_probes=64)
     dist, idx = ivf_flat.search(sp, index, q, k)
     jax.block_until_ready(idx)

@@ -259,6 +259,12 @@ def fit(
     Mirrors pylibraft ``cluster.kmeans.fit`` (kmeans.pyx:482). ``params`` may
     be a KMeansParams or a bare n_clusters int.
     """
+    return _fit_impl(params, x, centroids, sample_weights)[:3]
+
+
+def _fit_impl(params, x, centroids=None, sample_weights=None):
+    """fit() that also returns the final-iteration labels (used by find_k
+    to avoid a second full predict pass)."""
     if not isinstance(params, KMeansParams):
         params = KMeansParams(n_clusters=int(params))
     metric = _check_metric(params.metric)
@@ -279,12 +285,12 @@ def fit(
             init_c = init_random(x, params.n_clusters, k_init)
         else:
             init_c = _init_plus_plus(x, params.n_clusters, k_init)
-        centers, inertia, n_iter, _ = _fit_loop(
+        centers, inertia, n_iter, labels = _fit_loop(
             x, init_c, w, params.max_iter, params.tol, params.batch_rows,
             int(metric),
         )
         if best is None or float(inertia) < float(best[1]):
-            best = (centers, inertia, n_iter)
+            best = (centers, inertia, n_iter, labels)
     return best
 
 
@@ -357,29 +363,48 @@ def find_k(
     tol: float = 1e-2,
     seed: int = 0,
 ) -> Tuple[int, jax.Array, jax.Array]:
-    """Auto-find-k via bisection on inertia elbow (reference
-    cluster/detail/kmeans_auto_find_k.cuh). Returns (k, inertia, n_iter)."""
+    """Auto-find-k by maximizing the Calinski-Harabasz-style objective
+    ``(n-k)/(k-1) * cluster_dispersion(k) / inertia(k)`` with a bisection
+    on its slope — the reference's dispersion-based method
+    (cluster/detail/kmeans_auto_find_k.cuh: compute_dispersion + the
+    objective[0/1] slope test). Returns (k, inertia, n_iter)."""
+    from raft_tpu.stats.moments import cluster_dispersion
+
     x = jnp.asarray(x)
+    n = x.shape[0]
+    cache = {}
 
-    def cost_at(k: int):
-        c, inertia, n_iter = fit(
-            KMeansParams(n_clusters=k, max_iter=max_iter, seed=seed), x
-        )
-        return float(inertia), n_iter
+    def eval_k(k: int):
+        if k not in cache:
+            centers, inertia, n_iter, labels = _fit_impl(
+                KMeansParams(n_clusters=k, max_iter=max_iter, tol=tol, seed=seed),
+                x,
+            )
+            sizes = jnp.bincount(labels, length=k)
+            disp = float(cluster_dispersion(centers, sizes, n))
+            ch = (n - k) / max(k - 1, 1) * disp / max(float(inertia), 1e-30)
+            cache[k] = (ch, float(inertia), n_iter)
+        return cache[k]
 
-    lo, hi = int(kmin), int(kmax)
-    cost_lo, _ = cost_at(lo)
-    cost_hi, it_hi = cost_at(hi)
-    best_k, best_cost, best_it = hi, cost_hi, it_hi
-    while hi - lo > 1:
-        mid = (lo + hi) // 2
-        cost_mid, it_mid = cost_at(mid)
-        # relative improvement from halving k; keep shrinking while the
-        # elbow criterion holds (reference uses the same bisection idea)
-        if cost_mid <= cost_lo * tol or (cost_lo - cost_mid) / max(cost_lo, 1e-30) > tol:
-            best_k, best_cost, best_it = mid, cost_mid, it_mid
-            hi = mid
+    left, right = max(2, int(kmin)), int(kmax)
+    if right <= left:
+        _, inertia, n_iter = eval_k(max(left, 2))
+        return max(left, 2), jnp.float32(inertia), n_iter
+    eval_k(left)
+    eval_k(right)
+    while left < right - 1:
+        mid = (left + right) // 2
+        slope_l = (eval_k(mid)[0] - eval_k(left)[0]) / (mid - left)
+        if slope_l <= 0:
+            right = mid  # CH already falling: peak is at or left of mid
+            continue
+        slope_r = (eval_k(right)[0] - eval_k(mid)[0]) / (right - mid)
+        if slope_r < 0:
+            right = mid  # interior peak, left side
         else:
-            lo = mid
-            cost_lo = cost_mid
-    return best_k, jnp.float32(best_cost), best_it
+            left = mid
+    # every evaluated k is a candidate — the bracket walk can step past
+    # the peak when the curve is noisy
+    best_k = max(cache, key=lambda k: cache[k][0])
+    _, inertia, n_iter = eval_k(best_k)
+    return best_k, jnp.float32(inertia), n_iter

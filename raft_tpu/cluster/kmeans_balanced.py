@@ -10,12 +10,12 @@ of fine clusters, then run balancing iterations over the full set with
 starved-cluster reseeding (``adjust_centers``,
 detail/kmeans_balanced.cuh:524).
 
-TPU design: predict is fused-L2-NN (MXU GEMM + argmin epilogue); center
-update is the one-hot-matmul accumulation from ``cluster.kmeans``; the
-per-mesocluster gathers are host-orchestrated (data-dependent shapes) while
-every inner loop is a single jitted program. ``adjust_centers`` is
-vectorized: starved clusters are reseeded from random data rows in one
-``where`` instead of the reference's serial host loop.
+TPU design: predict is an MXU GEMM + argmin epilogue (the ||x||^2 term is
+dropped — it never changes the argmin); center update is a one-hot-matmul
+accumulation; the per-mesocluster gathers are host-orchestrated
+(data-dependent shapes) while every inner loop is a single jitted program.
+``adjust_centers`` is vectorized: all starved clusters blend onto sampled
+large clusters in one ``where`` instead of the reference's per-warp loop.
 """
 
 from __future__ import annotations
@@ -29,68 +29,227 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.cluster.kmeans import _centers_and_sizes, _predict_labels
+from raft_tpu.cluster.kmeans import _centers_and_sizes
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.utils.precision import dist_dot
 
 
 @dataclasses.dataclass
 class KMeansBalancedParams:
-    """Aggregate params (reference kmeans_balanced_params: n_iters, metric)."""
+    """Aggregate params (reference kmeans_balanced_params: n_iters, metric).
+
+    ``compute_dtype``: matmul operand dtype for predict/update GEMMs.
+    "f32" (default) runs them at HIGH precision (bf16x3 passes) — needed
+    when clusters are tight relative to coordinate magnitudes; "bf16"
+    single-pass is ~3x faster and fine for coarse ANN quantizers on
+    natural data.
+    """
 
     n_clusters: int = 8
     n_iters: int = 20
     metric: DistanceType = DistanceType.L2Expanded
     seed: int = 0
+    compute_dtype: str = "f32"
+
+
+# reference constants (detail/kmeans_balanced.cuh)
+_ADJUST_CENTERS_WEIGHT = 7.0   # kAdjustCentersWeight (:61)
+_BALANCING_THRESHOLD = 0.25    # build_clusters default (:755)
+_BALANCING_PULLBACK = 2        # build_clusters default (:754)
 
 
 def _as_f32(x) -> jax.Array:
     return jnp.asarray(x).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _predict_metric(x, centers, metric: int, batch_rows: int = 1 << 16):
-    """Nearest-center labels under L2 or InnerProduct (reference
-    detail/kmeans_balanced.cuh:371 predict). Row-batched so peak memory
-    stays at batch_rows x n_clusters."""
-    if metric == int(DistanceType.InnerProduct):
-        from raft_tpu.cluster.kmeans import _row_batches
-
-        xb, _, n = _row_batches(x.astype(jnp.float32), batch_rows)
-
-        def body(_, batch):
-            scores = dist_dot(batch, centers.T)
-            return None, jnp.argmax(scores, axis=1).astype(jnp.int32)
-
-        _, labels = jax.lax.scan(body, None, xb)
-        return labels.reshape(-1)[:n]
-    labels, _ = _predict_labels(x, centers, batch_rows)
-    return labels
+def _mm_dtype(compute_dtype: str):
+    return jnp.bfloat16 if compute_dtype == "bf16" else jnp.float32
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5))
-def _balancing_em_iter(
-    x, centers, key, ratio_threshold, n_clusters: int,
-    metric: int = int(DistanceType.L2Expanded),
+def _mm_precision(compute_dtype: str):
+    # f32 operands at DEFAULT precision would still run one bf16 pass on
+    # the MXU; HIGH (bf16x3) recovers near-f32 distances at 1/2 the cost
+    # of HIGHEST. bf16 operands: precision is moot, pass DEFAULT.
+    return (
+        jax.lax.Precision.DEFAULT if compute_dtype == "bf16"
+        else jax.lax.Precision.HIGH
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _predict_metric(
+    x, centers, metric: int, batch_rows: int = 1 << 16,
+    compute_dtype: str = "bf16",
 ):
-    """One predict → update → adjust_centers iteration, fully jitted.
+    """Nearest-center labels under L2, InnerProduct or Cosine (reference
+    detail/kmeans_balanced.cuh:371 predict). Row-batched so peak memory
+    stays at batch_rows x n_clusters.
 
-    ``adjust_centers`` (reference detail/kmeans_balanced.cuh:524): clusters
-    whose size falls below ``ratio_threshold x average`` are reseeded from a
-    random data row, pulling centers out of starvation so list sizes stay
-    balanced (what "balanced" k-means means here).
+    TPU formulation: the per-row term ||x||^2 never changes the argmin, so
+    L2 predict is ``argmin(||c||^2 - 2 x·c)`` — one bf16 MXU pass per batch
+    plus an f32 center-norm correction. Cosine = max normalized dot (the
+    query norm is constant per row, so only centers need normalizing).
     """
+    from raft_tpu.cluster.kmeans import _row_batches
+
+    mm = _mm_dtype(compute_dtype)
+    c32 = centers.astype(jnp.float32)
+    if metric == int(DistanceType.CosineExpanded):
+        c32 = c32 / jnp.maximum(
+            jnp.linalg.norm(c32, axis=1, keepdims=True), 1e-30
+        )
+    cT = c32.astype(mm).T
+    ip_like = metric in (
+        int(DistanceType.InnerProduct), int(DistanceType.CosineExpanded)
+    )
+    cn2 = None if ip_like else jnp.sum(c32 * c32, axis=1)
+
+    xb, _, n = _row_batches(x.astype(mm), batch_rows)
+
+    prec = _mm_precision(compute_dtype)
+
+    def body(_, batch):
+        dots = jnp.dot(batch, cT, preferred_element_type=jnp.float32,
+                       precision=prec)
+        if ip_like:
+            return None, jnp.argmax(dots, axis=1).astype(jnp.int32)
+        return None, jnp.argmin(cn2[None, :] - 2.0 * dots, axis=1).astype(
+            jnp.int32
+        )
+
+    _, labels = jax.lax.scan(body, None, xb)
+    return labels.reshape(-1)[:n]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _update_centers(x, labels, n_clusters: int, batch_rows: int,
+                    compute_dtype: str = "bf16"):
+    """Per-cluster sums/sizes via batched one-hot MXU matmuls (the
+    reference's calc_centers_and_sizes, detail/kmeans_balanced.cuh:257,
+    without atomics). One-hot entries are exact in bf16; sums accumulate
+    in f32."""
+    from raft_tpu.cluster.kmeans import _row_batches
+
+    mm = _mm_dtype(compute_dtype)
+    xb, valid, n = _row_batches(x.astype(mm), batch_rows)
+    nb, b, d = xb.shape
+    lp = jnp.pad(labels, (0, nb * b - n), constant_values=-1).reshape(nb, b)
+
+    prec = _mm_precision(compute_dtype)
+
+    def body(carry, inp):
+        sums, sizes = carry
+        batch, lab = inp
+        one_hot = (lab[:, None] == jnp.arange(n_clusters)[None, :]).astype(mm)
+        sums = sums + jnp.dot(one_hot.T, batch,
+                              preferred_element_type=jnp.float32,
+                              precision=prec)
+        sizes = sizes + jnp.sum(one_hot, axis=0, dtype=jnp.float32)
+        return (sums, sizes), None
+
+    (sums, sizes), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((n_clusters, d), jnp.float32),
+         jnp.zeros((n_clusters,), jnp.float32)),
+        (xb, lp),
+    )
+    return sums, sizes
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _adjust_centers(x, labels, sizes, centers, key, n_clusters: int):
+    """Vectorized adjust_centers (reference detail/kmeans_balanced.cuh:438):
+    every starved cluster (size <= threshold x average) has its center
+    moved to a weighted blend of a *large* cluster's center and one of that
+    cluster's points — splitting oversized clusters instead of reseeding
+    into random space. All starved clusters adjust in one shot (the
+    reference does the same, one warp per cluster)."""
     n = x.shape[0]
-    labels = _predict_metric(x, centers, metric, min(n, 1 << 16))
-    sums, sizes = _centers_and_sizes(x, labels, None, n_clusters, min(n, 1 << 16))
+    average = jnp.float32(n) / jnp.float32(n_clusters)
+    starved = sizes <= _BALANCING_THRESHOLD * average
+    # candidate rows: uniform row sampling is already size-biased toward
+    # large clusters; take the best of 4 to match the reference's
+    # "size >= average" acceptance loop
+    cand = jax.random.randint(key, (n_clusters, 4), 0, n)
+    cand_sizes = sizes[labels[cand]]
+    pick = jnp.argmax(cand_sizes, axis=1)
+    i = jnp.take_along_axis(cand, pick[:, None], axis=1)[:, 0]  # [C]
+    li = labels[i]
+    wc = jnp.minimum(sizes, _ADJUST_CENTERS_WEIGHT)[:, None]
+    blend = (wc * centers[li] + x[i].astype(jnp.float32)) / (wc + 1.0)
+    centers = jnp.where(starved[:, None], blend, centers)
+    return centers, starved.sum()
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _balancing_em_iter(
+    x, centers, labels, sizes, key, n_clusters: int,
+    metric: int = int(DistanceType.L2Expanded),
+    compute_dtype: str = "bf16",
+):
+    """One adjust → normalize → predict → update iteration, fully jitted —
+    the loop body of the reference's balancing_em_iters
+    (detail/kmeans_balanced.cuh:618). ``labels``/``sizes`` are carried from
+    the previous iteration (pass None on the first — no adjustment then,
+    matching the reference's iter>0 guard). Order matters: adjustment
+    happens at the *start* so every iteration ends with a clean EM update
+    (adjusted centers are never returned raw)."""
+    n = x.shape[0]
+    br = min(n, 1 << 16)
+    n_adjusted = jnp.int32(0)
+    if labels is not None:
+        centers, n_adjusted = _adjust_centers(
+            x, labels, sizes, centers, key, n_clusters
+        )
+    if metric in (
+        int(DistanceType.InnerProduct), int(DistanceType.CosineExpanded)
+    ):
+        # the reference L2-normalizes centers every iteration for IP/Cosine
+        # (detail/kmeans_balanced.cuh:659) so the partition matches the
+        # angular probe geometry
+        norms = jnp.linalg.norm(centers, axis=1, keepdims=True)
+        centers = centers / jnp.maximum(norms, 1e-30)
+    labels = _predict_metric(x, centers, metric, br, compute_dtype)
+    sums, sizes = _update_centers(x, labels, n_clusters, br, compute_dtype)
     new_centers = jnp.where(
         sizes[:, None] > 0, sums / jnp.maximum(sizes, 1.0)[:, None], centers
     )
-    average = jnp.float32(n) / jnp.float32(n_clusters)
-    starved = sizes < ratio_threshold * average
-    reseed_rows = jax.random.randint(key, (n_clusters,), 0, n)
-    new_centers = jnp.where(starved[:, None], x[reseed_rows], new_centers)
-    return new_centers, sizes, starved.sum()
+    return new_centers, labels, sizes, n_adjusted
+
+
+def balancing_em_iters(
+    x,
+    centers,
+    n_iters: int,
+    n_clusters: int,
+    key,
+    metric: DistanceType = DistanceType.L2Expanded,
+    compute_dtype: str = "bf16",
+    labels=None,
+    sizes=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the balancing EM loop with the reference's pullback rule
+    (detail/kmeans_balanced.cuh:618 balancing_em_iters): every
+    ``_BALANCING_PULLBACK``-th iteration that actually adjusted centers
+    adds one extra iteration, so convergence iterations always follow the
+    last rebalancing. Bounded at 3x the requested count."""
+    x = jnp.asarray(x)
+    balancing_counter = _BALANCING_PULLBACK
+    it, budget, hard_cap = 0, int(n_iters), max(3 * int(n_iters), int(n_iters) + 8)
+    n_adj = 0
+    while it < budget or (n_adj > 0 and it < hard_cap):
+        key, sub = jax.random.split(key)
+        centers, labels, sizes, n_adj_dev = _balancing_em_iter(
+            x, centers, labels, sizes, sub, n_clusters, int(metric),
+            compute_dtype,
+        )
+        n_adj = int(n_adj_dev)
+        if it > 0 and n_adj > 0 and budget < hard_cap:
+            balancing_counter += 1
+            if balancing_counter >= _BALANCING_PULLBACK:
+                balancing_counter -= _BALANCING_PULLBACK
+                budget += 1
+        it += 1
+    return centers, sizes
 
 
 def build_clusters(
@@ -100,29 +259,24 @@ def build_clusters(
     key,
     metric: DistanceType = DistanceType.L2Expanded,
     init_centers=None,
+    compute_dtype: str = "bf16",
 ) -> Tuple[jax.Array, jax.Array]:
     """EM-balanced clustering of one dataset (reference
     detail/kmeans_balanced.cuh:705 build_clusters).
 
     Returns (centers [C, d] f32, sizes [C] f32)."""
-    x = _as_f32(x)
+    x = jnp.asarray(x)
     n = x.shape[0]
     if init_centers is None:
         key, sub = jax.random.split(key)
         idx = jax.random.choice(sub, n, shape=(n_clusters,), replace=n < n_clusters)
-        centers = x[idx]
+        centers = _as_f32(x[idx])
     else:
         centers = _as_f32(init_centers)
-    # the reference decays the reseed threshold over iterations so late
-    # iterations converge; early iterations rebalance aggressively
-    sizes = jnp.zeros((n_clusters,), jnp.float32)
-    for it in range(n_iters):
-        key, sub = jax.random.split(key)
-        ratio = jnp.float32(0.25 * (1.0 - it / max(n_iters, 1)))
-        centers, sizes, _ = _balancing_em_iter(
-            x, centers, sub, ratio, n_clusters, int(metric)
-        )
-    return centers, sizes
+    key, sub = jax.random.split(key)
+    return balancing_em_iters(
+        x, centers, n_iters, n_clusters, sub, metric, compute_dtype
+    )
 
 
 def _arrange_fine_clusters(
@@ -158,6 +312,7 @@ def build_hierarchical(
     n_iters: int = 20,
     metric: DistanceType = DistanceType.L2Expanded,
     seed: int = 0,
+    compute_dtype: str = "bf16",
 ) -> jax.Array:
     """Two-level balanced training (reference
     detail/kmeans_balanced.cuh:955 build_hierarchical). Returns centers.
@@ -177,7 +332,10 @@ def build_hierarchical(
 
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     if n_clusters <= n_meso or n <= 4 * n_clusters:
-        centers, _ = build_clusters(x_np, n_clusters, n_iters, key, metric)
+        centers, _ = build_clusters(
+            x_np, n_clusters, n_iters, key, metric,
+            compute_dtype=compute_dtype,
+        )
         return centers
 
     # --- meso pass on a bounded subsample --------------------------------
@@ -185,11 +343,12 @@ def build_hierarchical(
     sel = rng.choice(n, meso_sample, replace=False)
     key, k_meso = jax.random.split(key)
     meso_centers, _ = build_clusters(
-        x_np[sel], n_meso, max(n_iters // 2, 4), k_meso, metric
+        x_np[sel], n_meso, max(n_iters // 2, 4), k_meso, metric,
+        compute_dtype=compute_dtype,
     )
     meso_labels = np.asarray(
         _predict_metric(jnp.asarray(x_np[sel]), meso_centers, int(metric),
-                        min(meso_sample, 1 << 16))
+                        min(meso_sample, 1 << 16), compute_dtype)
     )
     meso_sizes = np.bincount(meso_labels, minlength=n_meso)
     fine_counts = _arrange_fine_clusters(n_clusters, n_meso, meso_sizes)
@@ -209,20 +368,19 @@ def build_hierarchical(
         rows = x_np[sel[rng.choice(members, S, replace=members.size < S)]]
         key, sub = jax.random.split(key)
         # few iterations — this is only an init for the balancing phase
-        centers_m, _ = build_clusters(rows, c_max, 4, sub, metric)
+        centers_m, _ = build_clusters(rows, c_max, 4, sub, metric,
+                                      compute_dtype=compute_dtype)
         fine_centers.append(np.asarray(centers_m[:c]))
     centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
     assert centers.shape[0] == n_clusters
 
     # --- full-dataset balancing EM (the real training) -------------------
     x_dev = jnp.asarray(x_np)
-    iters = max(n_iters // 2, 2)
-    for it in range(iters):
-        key, sub = jax.random.split(key)
-        ratio = jnp.float32(0.25 * (1.0 - it / max(iters, 1)))
-        centers, _, _ = _balancing_em_iter(
-            x_dev, centers, sub, ratio, n_clusters, int(metric)
-        )
+    key, sub = jax.random.split(key)
+    centers, _ = balancing_em_iters(
+        x_dev, centers, max(n_iters // 2, 2), n_clusters, sub, metric,
+        compute_dtype,
+    )
     return centers
 
 
@@ -234,15 +392,17 @@ def build_hierarchical(
 def fit(params: KMeansBalancedParams, x) -> jax.Array:
     """Train balanced centers (kmeans_balanced.cuh:76). Returns [C, d]."""
     return build_hierarchical(
-        x, params.n_clusters, params.n_iters, params.metric, params.seed
+        x, params.n_clusters, params.n_iters, params.metric, params.seed,
+        params.compute_dtype,
     )
 
 
 def predict(params: KMeansBalancedParams, centers, x) -> jax.Array:
     """Nearest-center labels (kmeans_balanced.cuh:134)."""
-    x = _as_f32(x)
+    x = jnp.asarray(x)
     return _predict_metric(
-        x, _as_f32(centers), int(params.metric), min(x.shape[0], 1 << 16)
+        x, _as_f32(centers), int(params.metric), min(x.shape[0], 1 << 16),
+        params.compute_dtype,
     )
 
 

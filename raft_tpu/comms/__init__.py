@@ -26,7 +26,11 @@ from raft_tpu.comms.ops import (
     reduce,
     reducescatter,
 )
-from raft_tpu.comms.sharded import sharded_knn, sharded_pairwise_distance
+from raft_tpu.comms.sharded import (
+    sharded_ivf_search,
+    sharded_knn,
+    sharded_pairwise_distance,
+)
 
 __all__ = [
     "Comms",
@@ -42,6 +46,7 @@ __all__ = [
     "reducescatter",
     "device_sendrecv",
     "device_multicast_sendrecv",
+    "sharded_ivf_search",
     "sharded_knn",
     "sharded_pairwise_distance",
 ]

@@ -71,6 +71,78 @@ def sharded_knn(
     return jax.jit(fn)(queries, dataset)
 
 
+def sharded_ivf_search(
+    search_params,
+    index,
+    queries,
+    k: int,
+    mesh: Mesh,
+    axis_name: str = "shard",
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate KNN with the IVF index's *lists* sharded over the mesh.
+
+    The reference's large-index multi-GPU model: each rank owns an index
+    shard and runs the same search; per-rank top-ks are merged
+    (raft-dask + detail/knn_merge_parts.cuh:140). Here each device holds
+    ``n_lists / n_shards`` lists (centers, storage blocks, norms all
+    sharded on the list axis), probes ``n_probes / n_shards`` of them, and
+    the per-shard top-ks are all-gathered + merged over ICI.
+
+    Stored ids are global dataset row ids, so no rank offset is needed.
+    """
+    from raft_tpu.neighbors import ivf_flat
+
+    queries = jnp.asarray(queries)
+    C = index.n_lists
+    nshards = mesh.shape[axis_name]
+    if C % nshards != 0:
+        raise ValueError(f"n_lists {C} not divisible by mesh axis {nshards}")
+    local_lists = C // nshards
+    n_probes = max(1, min(int(search_params.n_probes) // nshards, local_lists))
+    cap = index.storage.shape[1]
+    if k > n_probes * cap:
+        raise ValueError(
+            f"k={k} exceeds the per-shard candidate pool "
+            f"(n_probes/shard={n_probes} x cap={cap}); raise n_probes to at "
+            f"least {nshards * -(-k // max(cap, 1))} for a {nshards}-way mesh"
+        )
+    select_min = is_min_close(index.metric)
+    metric = int(index.metric)
+    group = int(search_params.query_group)
+    bucket_batch = int(search_params.bucket_batch)
+
+    has_norms = index.data_norms is not None
+
+    def local(q, centers, storage, indices, list_sizes, *rest):
+        norms = rest[0] if has_norms else None
+        d, i = ivf_flat._ivf_search(
+            q, centers, storage, indices, list_sizes,
+            int(k), n_probes, metric, group, bucket_batch, 0,
+            str(search_params.compute_dtype),
+            float(search_params.local_recall_target),
+            norms, None,
+        )
+        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)  # [m, S*k]
+        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
+        return merge_topk(gd, gi, k, select_min)
+
+    args = [queries, index.centers, index.storage, index.indices, index.list_sizes]
+    in_specs = [P(), P(axis_name, None), P(axis_name, None, None),
+                P(axis_name, None), P(axis_name)]
+    if has_norms:
+        args.append(index.data_norms)
+        in_specs.append(P(axis_name, None))
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)(*args)
+
+
 def sharded_pairwise_distance(
     x,
     y,

@@ -6,12 +6,12 @@ TPU-native analog of the reference's ``raft::matrix::select_k``
 learned heuristic (matrix/detail/select_k-inl.cuh:51-79). On TPU, XLA's
 ``lax.top_k`` lowers to the hardware sort unit and is already near-optimal
 for the k ranges the reference covers; the "dispatch" concept survives as a
-single entry point that (a) maps select-min onto top_k by negation, (b)
-carries pass-through source indices (the reference's ``in_idx``), and (c)
-exposes an optional O(n) two-pass threshold path for very large k where a
-full top_k sort would be wasteful.
-
-Pallas fused distance+select variants live in raft_tpu.ops (SURVEY §7).
+single entry point that (a) maps select-min onto top_k by negation and (b)
+carries pass-through source indices (the reference's ``in_idx``). A
+two-pass histogram-threshold variant (the radix-select analog) is exposed
+as ``select_k_threshold``; it is not auto-dispatched because without
+candidate compaction it cannot beat the hardware top_k (see note in
+``select_k``).
 """
 
 from __future__ import annotations
@@ -48,6 +48,13 @@ def select_k(
     batch, n = in_val.shape
     if not 0 < k <= n:
         raise ValueError(f"k={k} out of range for row length {n}")
+    # Dispatch note (the reference's learned heuristic,
+    # select_k-inl.cuh:51-79): on TPU a single lax.top_k lowers to the
+    # hardware sort unit for every (k, n) the reference covers, and the
+    # histogram-threshold path as implemented still ends in a full-row
+    # top_k over the masked copy — so dispatching to it only adds passes.
+    # It stays available as select_k_threshold for callers that want the
+    # two-pass structure; revisit if a compacting implementation lands.
     vals, idxs = _select_k(in_val, int(k), bool(select_min))
     if in_idx is not None:
         in_idx = jnp.asarray(in_idx)

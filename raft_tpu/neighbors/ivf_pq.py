@@ -88,6 +88,8 @@ class IndexParams:
     codebook_kind: int = codebook_gen.PER_SUBSPACE
     force_random_rotation: bool = False
     add_data_on_build: bool = True
+    # coarse-quantizer training GEMM dtype ("f32" | "bf16", see ivf_flat)
+    kmeans_compute_dtype: str = "f32"
 
     def __post_init__(self):
         self.metric = resolve_metric(self.metric)
@@ -105,11 +107,18 @@ class SearchParams:
     """Search params (reference ivf_pq_types.hpp:110-146)."""
 
     n_probes: int = 20
-    lut_dtype: object = jnp.float32  # decode dtype: f32 | bf16 (fp8 analog)
-    internal_distance_dtype: object = jnp.float32
+    # Decode/scoring operand dtype ladder (the reference's LUT dtype ladder,
+    # ivf_pq_types.hpp lut_dtype fp32/fp16/fp8): "f32" | "bf16" | "f8"
+    # (float8_e4m3 decode, matmul still runs in the compute dtype). jnp
+    # dtypes are accepted. "f8"/"bf16" here lowers the decode precision even
+    # when compute_dtype is "f32".
+    lut_dtype: object = "f32"
+    # Distance accumulation/report dtype: "f32" | "bf16" (the reference's
+    # internal_distance_dtype fp32/fp16 analog).
+    internal_distance_dtype: object = "f32"
     # TPU tuning knobs (same role as in ivf_flat.SearchParams)
     query_group: int = 256
-    bucket_batch: int = 8
+    bucket_batch: int = 32
     compute_dtype: str = "bf16"        # matmul operand dtype (f32 accumulate)
     local_recall_target: float = 0.95  # per-list approx top-k; >=1.0 exact
 
@@ -247,6 +256,7 @@ def build(params: IndexParams, dataset) -> Index:
             if params.metric == DistanceType.InnerProduct
             else DistanceType.L2Expanded
         ),
+        compute_dtype=str(params.kmeans_compute_dtype),
     )
     centers = kmeans_balanced.fit(kb, trainset)
 
@@ -341,28 +351,33 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         cn = jnp.sum(books * books, axis=2)[:, None, :]
         new_codes = jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
 
-    # merge with existing lists and repack
-    if index.codes.shape[1] > 0 and index.size > 0:
-        old_codes = np.asarray(index.codes).reshape(-1, index.pq_dim)
-        old_ids = np.asarray(index.indices).reshape(-1)
-        old_labels = np.repeat(
-            np.arange(index.n_lists, dtype=np.int32), index.codes.shape[1]
+    # merge with existing lists and repack, all on device: old padding rows
+    # get the out-of-range label n_lists so _pack_lists drops them (no
+    # host round-trip)
+    C = index.n_lists
+    old_cap = index.codes.shape[1]
+    if old_cap > 0 and index.size > 0:
+        old_codes = index.codes.reshape(-1, index.pq_dim)
+        old_ids = index.indices.reshape(-1)
+        old_labels = jnp.where(
+            old_ids >= 0,
+            jnp.repeat(jnp.arange(C, dtype=jnp.int32), old_cap),
+            jnp.int32(C),
         )
-        valid = old_ids >= 0
-        codes_all = jnp.asarray(
-            np.concatenate([old_codes[valid], np.asarray(new_codes)], axis=0)
-        )
-        labels_all = jnp.asarray(
-            np.concatenate([old_labels[valid], np.asarray(labels)])
-        )
-        ids_all = jnp.asarray(np.concatenate([old_ids[valid], np.asarray(new_ids)]))
+        codes_all = jnp.concatenate([old_codes, new_codes], axis=0)
+        labels_all = jnp.concatenate([old_labels, labels])
+        ids_all = jnp.concatenate([old_ids, new_ids])
     else:
         codes_all, labels_all, ids_all = new_codes, labels, new_ids
 
-    counts = np.bincount(np.asarray(labels_all), minlength=index.n_lists)
-    cap = max(8, round_up_to_multiple(int(counts.max()), 8))
+    counts = np.asarray(index.list_sizes) + np.bincount(
+        np.asarray(labels), minlength=C
+    )
+    from raft_tpu.neighbors.ivf_flat import _aligned_cap
+
+    cap = _aligned_cap(int(counts.max()))
     codes_packed, indices, list_sizes = _pack_lists(
-        codes_all, labels_all, ids_all, index.n_lists, cap
+        codes_all, labels_all, ids_all, C, cap
     )
 
     # precompute reconstruction norms ||recon||^2 per stored vector
@@ -391,7 +406,7 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _pq_search(
     arrays,
     k: int,
@@ -403,6 +418,8 @@ def _pq_search(
     filter_nbits: int,
     compute_dtype: str = "bf16",
     local_recall_target: float = 0.95,
+    lut_dtype: str = "f32",
+    internal_dtype: str = "f32",
 ):
     (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
      list_sizes, rec_norms, filter_bits) = arrays
@@ -431,6 +448,11 @@ def _pq_search(
     kl = min(k, cap)
     q_rot = dist_dot(q32, rotation.T)  # [m, rot_dim]
     mm = jnp.bfloat16 if compute_dtype == "bf16" else jnp.float32
+    # lut_dtype lowers the decode precision below the compute dtype —
+    # the reference's fp16/fp8 LUT ladder (detail/ivf_pq_fp_8bit.cuh)
+    if lut_dtype == "bf16" and mm is jnp.float32:
+        mm = jnp.bfloat16
+    decode_via_f8 = lut_dtype == "f8"
 
     def body(_, inp):
         bl, bq = inp  # [bb], [bb, group]
@@ -444,6 +466,15 @@ def _pq_search(
             recon = _decode_gather(
                 blk_codes, pq_centers, codebook_kind, bl[:, None]
             )                            # [bb, cap, rot_dim]
+        if decode_via_f8:
+            # scaled round-trip through e4m3 (the reference's fp8 LUT
+            # stores a shared exponent bias, ivf_pq_fp_8bit.cuh) —
+            # unscaled values beyond ±448 would become NaN
+            f8_scale = jnp.maximum(jnp.max(jnp.abs(recon)), 1e-30) / 240.0
+            recon = (
+                (recon / f8_scale).astype(jnp.float8_e4m3fn).astype(jnp.float32)
+                * f8_scale
+            )
         recon = recon.astype(mm)
         qsafe = jnp.maximum(bq, 0)
         q_res = q_rot[qsafe] - centers_rot[bl][:, None, :]  # [bb, g, rot_dim]
@@ -475,6 +506,9 @@ def _pq_search(
         if filter_bits is not None:
             valid = valid & filter_keep(filter_bits, filter_nbits, ids)[:, None, :]
         dist = jnp.where(valid, dist, sentinel)
+        if internal_dtype == "bf16":
+            # lower-precision internal distances (reference fp16 analog)
+            dist = dist.astype(jnp.bfloat16).astype(jnp.float32)
         return None, merge_topk(
             dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,
             approx=local_recall_target < 1.0,
@@ -492,6 +526,9 @@ def _pq_search(
         pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
         select_min, sentinel,
     )
+    # fewer than k valid candidates: id must be -1 (documented contract);
+    # otherwise refine re-scores filtered-out ids back into the top-k
+    out_i = jnp.where(out_d == sentinel, -1, out_i)
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
     return out_d, out_i
@@ -532,7 +569,31 @@ def search(
         0 if bits is None else int(bits.n_bits),
         str(search_params.compute_dtype),
         float(search_params.local_recall_target),
+        _norm_dtype_knob(search_params.lut_dtype),
+        _norm_dtype_knob(search_params.internal_distance_dtype),
     )
+
+
+def _norm_dtype_knob(v) -> str:
+    """Normalize a lut/internal dtype knob (string or jnp dtype) to
+    'f32' | 'bf16' | 'f8'."""
+    if isinstance(v, str):
+        s = v.lower()
+        if s in ("f32", "float32", "fp32"):
+            return "f32"
+        if s in ("bf16", "bfloat16", "f16", "fp16", "float16"):
+            return "bf16"
+        if s in ("f8", "fp8", "float8", "float8_e4m3fn", "e4m3"):
+            return "f8"
+        raise ValueError(f"unknown dtype knob {v!r}")
+    dt = jnp.dtype(v)
+    if dt == jnp.dtype(jnp.float32):
+        return "f32"
+    if dt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return "bf16"
+    if "float8" in dt.name:
+        return "f8"
+    raise ValueError(f"unknown dtype knob {v!r}")
 
 
 # ---------------------------------------------------------------------------

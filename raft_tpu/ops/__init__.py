@@ -1,0 +1,12 @@
+"""Pallas TPU kernels — the fused hot ops.
+
+The reference's performance lives in hand-fused CUDA kernels
+(ivf_flat_interleaved_scan-inl.cuh, select_warpsort.cuh); this package is
+their TPU-native counterpart: Mosaic/Pallas kernels that fuse MXU
+contractions with on-chip epilogues and k-selection so distances never
+round-trip through HBM.
+"""
+
+from raft_tpu.ops.ivf_scan import fused_list_scan_topk
+
+__all__ = ["fused_list_scan_topk"]

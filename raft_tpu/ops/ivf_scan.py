@@ -1,0 +1,195 @@
+"""Fused IVF list-scan + top-k Pallas kernel.
+
+TPU-native analog of the reference's fused interleaved-scan kernel
+(cpp/include/raft/neighbors/detail/ivf_flat_interleaved_scan-inl.cuh:663):
+one grid step scans one bucketized (query-group x list) pair — the list
+block is DMA'd from HBM by a scalar-prefetch index map (no gather
+materialization), distances come off the MXU into VMEM, and the per-list
+top-k is extracted on-chip, so the [group x cap] distance tile never
+touches HBM. The reference's warp-queue (select_warpsort.cuh:100) becomes
+a k-pass vectorized min-extraction; its approx mode mirrors
+lax.approx_min_k's lane-binning (one candidate per 128-lane bin, then
+extract from bins — collision loss ~C(k,2)/128 per list).
+
+Inputs are produced by ``ivf_flat.bucketize_pairs``: ``bucket_list`` maps
+grid step -> list id, ``qv`` holds the pre-gathered query group per step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# metric_kind values (static kernel variants)
+L2 = 0        # dist = ||q||^2 + ||x||^2 - 2 q.x   (needs norms + qaux=||q||^2)
+IP = 1        # dist = -q.x  (caller negates back; select-min internally)
+COSINE = 2    # dist = 1 - q.x / (||q|| ||x||)     (needs norms=||x||^2, qaux=||q||)
+
+
+def _extract_topk(dist, col, k: int, cap: int, outd_ref, outp_ref):
+    """k-pass min extraction over [G, cap]; writes [k, G] rows."""
+    for j in range(k):
+        m = jnp.min(dist, axis=1)                              # [G]
+        eq = dist == m[:, None]
+        pos = jnp.min(jnp.where(eq, col, cap), axis=1)         # [G]
+        outd_ref[0, j, :] = m
+        outp_ref[0, j, :] = pos
+        if j + 1 < k:
+            dist = jnp.where(col == pos[:, None], jnp.inf, dist)
+
+
+def _extract_topk_binned(dist, k: int, cap: int, outd_ref, outp_ref):
+    """Lane-binned approximate extraction: fold [G, cap] into 128 bins
+    (bin b holds min over columns == b mod 128), then extract k from the
+    bins. One top-k candidate is lost per same-bin collision among the
+    true top-k (expected C(k,2)/128 items)."""
+    G = dist.shape[0]
+    nch = cap // 128
+    lane = jax.lax.broadcasted_iota(jnp.int32, (G, 128), 1)
+    binmin = jnp.full((G, 128), jnp.inf, jnp.float32)
+    binpos = jnp.zeros((G, 128), jnp.int32)
+    for c in range(nch):
+        chunk = dist[:, c * 128:(c + 1) * 128]
+        better = chunk < binmin
+        binmin = jnp.where(better, chunk, binmin)
+        binpos = jnp.where(better, lane + c * 128, binpos)
+    for j in range(k):
+        m = jnp.min(binmin, axis=1)
+        eq = binmin == m[:, None]
+        pos = jnp.min(jnp.where(eq, binpos, cap), axis=1)
+        outd_ref[0, j, :] = m
+        outp_ref[0, j, :] = pos
+        if j + 1 < k:
+            binmin = jnp.where(binpos == pos[:, None], jnp.inf, binmin)
+
+
+def _scan_kernel(
+    bl_ref, ls_ref, *refs,
+    k: int, metric_kind: int, approx: bool, has_norms: bool, has_filter: bool,
+):
+    refs = list(refs)
+    storage_ref = refs.pop(0)
+    norms_ref = refs.pop(0) if has_norms else None
+    keep_ref = refs.pop(0) if has_filter else None
+    qv_ref = refs.pop(0)
+    qaux_ref = refs.pop(0) if metric_kind != IP else None
+    outd_ref, outp_ref = refs
+
+    i = pl.program_id(0)
+    size = ls_ref[bl_ref[i]]
+    qv = qv_ref[0]                                      # [G, d] mm dtype
+    blk = storage_ref[0].astype(qv.dtype)               # [cap, d]
+    dots = jax.lax.dot_general(
+        qv, blk,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                   # [G, cap]
+    G, cap = dots.shape
+    if metric_kind == L2:
+        dist = jnp.maximum(
+            qaux_ref[0, 0][:, None] + norms_ref[0, 0][None, :] - 2.0 * dots,
+            0.0,
+        )
+    elif metric_kind == IP:
+        dist = -dots
+    else:  # COSINE
+        plen = jnp.sqrt(jnp.maximum(norms_ref[0, 0], 1e-30))
+        dist = 1.0 - dots / jnp.maximum(
+            qaux_ref[0, 0][:, None] * plen[None, :], 1e-30
+        )
+    col = jax.lax.broadcasted_iota(jnp.int32, (G, cap), 1)
+    valid = col < size
+    if has_filter:
+        valid = valid & (keep_ref[0, 0][None, :] > 0)
+    dist = jnp.where(valid, dist, jnp.inf)
+    if approx and cap % 128 == 0 and cap > 128 and k <= 64:
+        _extract_topk_binned(dist, k, cap, outd_ref, outp_ref)
+    else:
+        _extract_topk(dist, col, k, cap, outd_ref, outp_ref)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric_kind", "approx", "interpret"),
+)
+def fused_list_scan_topk(
+    storage,        # [C, cap, d] source dtype
+    list_sizes,     # [C] int32
+    bucket_list,    # [nb] int32
+    qv,             # [nb, G, d] bf16 (pre-gathered query groups)
+    qaux=None,      # [nb, G] f32: ||q||^2 (L2) or ||q|| (cosine); None for IP
+    norms=None,     # [C, cap] f32: ||x||^2; None for IP
+    keep=None,      # [C, cap] int32 filter keep-mask; None = no filter
+    *,
+    k: int,
+    metric_kind: int,
+    approx: bool = True,
+    interpret: bool = False,
+):
+    """Scan each bucket's list block against its query group and return the
+    per-pair top-k in min-space.
+
+    Returns (out_d [nb, G, k] f32, out_pos [nb, G, k] int32) where out_pos
+    is the *column* within the list (caller maps to stored ids). For IP the
+    distances are negated scores — negate back after the merge. Invalid
+    tail entries (list shorter than k after filtering) come back as +inf
+    with an arbitrary position — mask on inf.
+    """
+    C, cap, d = storage.shape
+    nb, G, _ = qv.shape
+    has_norms = norms is not None
+    has_filter = keep is not None
+
+    # 2-D per-row arrays are lifted to [*, 1, n] so each block equals the
+    # full trailing dims (the Mosaic block rule: last two dims divisible by
+    # (8, 128) or equal to the array's)
+    inputs = [storage]
+    in_specs = [
+        pl.BlockSpec((1, cap, d), lambda i, bl, ls: (bl[i], 0, 0)),
+    ]
+    if has_norms:
+        inputs.append(norms.reshape(C, 1, cap))
+        in_specs.append(
+            pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0))
+        )
+    if has_filter:
+        inputs.append(keep.reshape(C, 1, cap))
+        in_specs.append(
+            pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0))
+        )
+    inputs.append(qv)
+    in_specs.append(pl.BlockSpec((1, G, d), lambda i, bl, ls: (i, 0, 0)))
+    if metric_kind != IP:
+        inputs.append(qaux.reshape(nb, 1, G))
+        in_specs.append(
+            pl.BlockSpec((1, 1, G), lambda i, bl, ls: (i, 0, 0))
+        )
+
+    kernel = functools.partial(
+        _scan_kernel,
+        k=k, metric_kind=metric_kind, approx=approx,
+        has_norms=has_norms, has_filter=has_filter,
+    )
+    out_d, out_p = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nb,),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, k, G), lambda i, bl, ls: (i, 0, 0)),
+                pl.BlockSpec((1, k, G), lambda i, bl, ls: (i, 0, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, k, G), jnp.float32),
+            jax.ShapeDtypeStruct((nb, k, G), jnp.int32),
+        ],
+        interpret=interpret,
+    )(bucket_list, list_sizes, *inputs)
+    # [nb, k, G] -> [nb, G, k]
+    return out_d.transpose(0, 2, 1), out_p.transpose(0, 2, 1)

@@ -4,6 +4,7 @@ Reference: cpp/include/raft/stats/ (SURVEY.md §2.10).
 """
 
 from raft_tpu.stats.moments import (
+    cluster_dispersion,
     cov,
     histogram,
     mean,
@@ -32,7 +33,7 @@ from raft_tpu.stats.metrics import (
 
 __all__ = [
     "mean", "stddev", "cov", "minmax", "meanvar", "histogram",
-    "weighted_mean", "mean_center",
+    "weighted_mean", "mean_center", "cluster_dispersion",
     "accuracy", "r2_score", "regression_metrics",
     "adjusted_rand_index", "rand_index", "silhouette_score", "v_measure",
     "mutual_info_score", "entropy", "homogeneity_score",

@@ -90,3 +90,17 @@ def histogram(data, n_bins: int, lo=None, hi=None) -> Tuple[jax.Array, jax.Array
     bins = jnp.clip(scaled.astype(jnp.int32), 0, n_bins - 1)
     one_hot = bins[:, :, None] == jnp.arange(n_bins)[None, None, :]
     return one_hot.sum(axis=0).T.astype(jnp.int32), edges
+
+
+def cluster_dispersion(
+    centroids, cluster_sizes, n_points: Optional[int] = None
+) -> jax.Array:
+    """Between-cluster dispersion (reference stats/dispersion.cuh:84):
+    sqrt(sum_i sizes_i * ||c_i - mu||^2) with mu the size-weighted centroid
+    mean over n_points."""
+    centroids = jnp.asarray(centroids, jnp.float32)
+    sizes = jnp.asarray(cluster_sizes, jnp.float32)
+    n = jnp.float32(n_points) if n_points is not None else sizes.sum()
+    mu = (sizes[:, None] * centroids).sum(axis=0) / jnp.maximum(n, 1.0)
+    diff = centroids - mu[None, :]
+    return jnp.sqrt(jnp.sum(sizes * jnp.sum(diff * diff, axis=1)))

@@ -1,0 +1,165 @@
+"""Profile the IVF-Flat search pipeline component-by-component on the
+real chip. Round-2 perf work: find where the 3053-QPS round-1 number went.
+
+Run: python scripts/profile_ivf.py [n] [nq]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+from bench import _sift_like as sift_like  # same workload the bench measures
+from raft_tpu.bench.harness import time_fn
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    return time_fn(lambda: fn(*args), iters=iters, warmup=warmup)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    nq = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    d, k, n_lists, n_probes = 128, 10, 1024, 64
+
+    print(f"devices: {jax.devices()}", flush=True)
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.matrix.select_k import select_k
+
+    x = jax.device_put(sift_like(n, d, seed=1))
+    q = jax.device_put(sift_like(nq, d, seed=2))
+
+    t0 = time.perf_counter()
+    params = ivf_flat.IndexParams(n_lists=n_lists, metric="sqeuclidean")
+    index = ivf_flat.build(params, x)
+    jax.block_until_ready(index.storage)
+    print(f"build: {time.perf_counter()-t0:.1f}s  cap={index.storage.shape[1]}",
+          flush=True)
+
+    C, cap, _ = index.storage.shape
+    sizes = np.asarray(index.list_sizes)
+    print(f"list sizes: min={sizes.min()} max={sizes.max()} mean={sizes.mean():.0f}",
+          flush=True)
+
+    # --- raw MXU reference: what would brute force cost? ------------------
+    xb = index.storage.reshape(-1, d).astype(jnp.bfloat16)
+
+    @jax.jit
+    def bf_dots(q):
+        return (q.astype(jnp.bfloat16) @ xb.T).sum(axis=1)  # avoid materializing topk
+
+    t = timeit(bf_dots, q, iters=3, warmup=1)
+    flops = 2.0 * nq * (C * cap) * d
+    print(f"brute dots: {t*1e3:.1f} ms  ({flops/t/1e12:.1f} TFLOP/s)", flush=True)
+
+    # --- full current search ---------------------------------------------
+    for bb, grp, lrt, cd in [(8, 256, 0.95, "bf16"),
+                             (32, 256, 0.95, "bf16"),
+                             (64, 256, 1.0, "bf16"),
+                             (32, 512, 0.95, "bf16")]:
+        sp = ivf_flat.SearchParams(n_probes=n_probes, bucket_batch=bb,
+                                   query_group=grp, local_recall_target=lrt,
+                                   compute_dtype=cd)
+        try:
+            t = timeit(lambda: ivf_flat.search(sp, index, q, k)[1], iters=3,
+                       warmup=1)
+            print(f"search bb={bb} grp={grp} lrt={lrt} {cd}: "
+                  f"{t*1e3:.1f} ms  ({nq/t:.0f} QPS)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"search bb={bb} grp={grp}: FAILED {type(e).__name__}: {e}",
+                  flush=True)
+
+    # --- components -------------------------------------------------------
+    q32 = q.astype(jnp.float32)
+
+    @jax.jit
+    def coarse(q32):
+        cdot = q32 @ index.centers.T
+        qn2 = jnp.sum(q32 * q32, axis=1, keepdims=True)
+        cn2 = jnp.sum(index.centers * index.centers, axis=1)
+        return select_k(qn2 + cn2[None, :] - 2.0 * cdot, n_probes)[1]
+
+    t = timeit(coarse, q32)
+    print(f"coarse+select: {t*1e3:.1f} ms", flush=True)
+
+    probes = coarse(q32)
+
+    from raft_tpu.neighbors.ivf_flat import bucketize_pairs
+
+    bk = jax.jit(lambda p: bucketize_pairs(p, nq, n_probes, C, 256, 8)[:2])
+    t = timeit(bk, probes)
+    print(f"bucketize: {t*1e3:.1f} ms", flush=True)
+
+    bl, bq = bk(probes)
+    nb = bl.shape[0]
+    print(f"n_buckets(padded)={nb}", flush=True)
+
+    # gather cost alone
+    @jax.jit
+    def gather_blocks(bl):
+        def body(c, blc):
+            blk = index.storage[blc]  # [bb, cap, d]
+            return c + blk.sum(), None
+        c, _ = jax.lax.scan(body, 0.0, bl.reshape(-1, 8))
+        return c
+
+    t = timeit(gather_blocks, bl, iters=3, warmup=1)
+    print(f"scan gather-only (bb=8): {t*1e3:.1f} ms", flush=True)
+
+    # gather + matmul, no select
+    qg = q32[jnp.maximum(bq, 0)]  # [nb, grp, d] pre-gathered queries
+
+    @jax.jit
+    def scan_matmul(bl, qg):
+        def body(c, inp):
+            blc, qv = inp
+            blk = index.storage[blc].astype(jnp.bfloat16)
+            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
+                              preferred_element_type=jnp.float32)
+            return c + dots.sum(), None
+        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
+        return c
+
+    t = timeit(scan_matmul, bl, qg, iters=3, warmup=1)
+    print(f"scan gather+matmul (bb=8): {t*1e3:.1f} ms", flush=True)
+
+    # matmul + approx topk
+    @jax.jit
+    def scan_matmul_topk(bl, qg):
+        def body(c, inp):
+            blc, qv = inp
+            blk = index.storage[blc].astype(jnp.bfloat16)
+            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
+                              preferred_element_type=jnp.float32)
+            v, i = jax.lax.approx_min_k(dots, k, recall_target=0.95)
+            return c + v.sum(), None
+        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
+        return c
+
+    t = timeit(scan_matmul_topk, bl, qg, iters=3, warmup=1)
+    print(f"scan gather+matmul+approxtopk (bb=8): {t*1e3:.1f} ms", flush=True)
+
+    @jax.jit
+    def scan_matmul_exact_topk(bl, qg):
+        def body(c, inp):
+            blc, qv = inp
+            blk = index.storage[blc].astype(jnp.bfloat16)
+            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
+                              preferred_element_type=jnp.float32)
+            v, i = jax.lax.top_k(-dots, k)
+            return c + v.sum(), None
+        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
+        return c
+
+    t = timeit(scan_matmul_exact_topk, bl, qg, iters=3, warmup=1)
+    print(f"scan gather+matmul+exact topk (bb=8): {t*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

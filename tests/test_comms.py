@@ -78,6 +78,26 @@ def test_sharded_knn(rng, eight_device_mesh):
     assert eval_recall(np.asarray(idx), want) > 0.99
 
 
+def test_sharded_ivf_search(rng, eight_device_mesh):
+    from raft_tpu.comms import sharded_ivf_search
+    from raft_tpu.neighbors import ivf_flat
+
+    n, m, d, k = 2000, 24, 32, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    params = ivf_flat.IndexParams(
+        n_lists=16, kmeans_n_iters=5, kmeans_trainset_fraction=1.0
+    )
+    index = ivf_flat.build(params, x)
+    # full probe across shards -> exact up to list assignment: recall ~1
+    sp = ivf_flat.SearchParams(
+        n_probes=16, query_group=8, local_recall_target=1.0
+    )
+    dist, idx = sharded_ivf_search(sp, index, q, k, eight_device_mesh)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.99
+
+
 def test_sharded_pairwise(rng, eight_device_mesh):
     x = rng.standard_normal((64, 16)).astype(np.float32)
     y = rng.standard_normal((40, 16)).astype(np.float32)

@@ -54,6 +54,22 @@ def test_search_recall(dataset):
     assert eval_recall(np.asarray(idx), want) > 0.65
 
 
+@pytest.mark.parametrize("lut,internal", [("bf16", "f32"), ("f8", "bf16")])
+def test_lut_dtype_ladder(dataset, lut, internal):
+    """lut_dtype / internal_distance_dtype are functional (ADVICE r1):
+    lower-precision ladders trade a little recall, not correctness."""
+    x, q = dataset
+    k = 10
+    index = _build(x)
+    sp = ivf_pq.SearchParams(
+        n_probes=16, query_group=64, bucket_batch=4,
+        lut_dtype=lut, internal_distance_dtype=internal,
+    )
+    _, idx = ivf_pq.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.55
+
+
 def test_search_with_refine(dataset):
     x, q = dataset
     k = 10

@@ -131,7 +131,11 @@ def test_balanced_predict_inner_product():
     centers = rng.standard_normal((4, 8)).astype(np.float32)
     from raft_tpu.distance.types import DistanceType
 
-    params = KMeansBalancedParams(n_clusters=4, metric=DistanceType.InnerProduct)
+    # exact-match contract needs the f32 compute path (default is bf16,
+    # which may flip near-tied argmaxes)
+    params = KMeansBalancedParams(
+        n_clusters=4, metric=DistanceType.InnerProduct, compute_dtype="f32"
+    )
     labels = np.asarray(kmeans_balanced.predict(params, centers, x))
     expected = (x @ centers.T).argmax(axis=1)
     np.testing.assert_array_equal(labels, expected)
@@ -167,6 +171,15 @@ def test_kmeans_cosine_metric():
     assert len(np.unique(labels[:200])) == 1
     assert len(np.unique(labels[200:])) == 1
     assert labels[0] != labels[200]
+
+
+def test_find_k_recovers_cluster_count(blobs):
+    """CH-objective bisection lands on (or next to) the true k=8 for
+    well-separated blobs (reference kmeans_auto_find_k.cuh semantics)."""
+    x, _, _ = blobs
+    k, inertia, _ = kmeans.find_k(x, kmax=16, kmin=2, max_iter=30, seed=0)
+    assert 7 <= k <= 9
+    assert float(inertia) > 0
 
 
 def test_kmeans_rejects_unsupported_metric():
