@@ -412,6 +412,55 @@ def fit_predict(params: KMeansBalancedParams, x):
     return centers, predict(params, centers, x)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def build_clusters_batched(xs, n_clusters: int, n_iters: int, key):
+    """Train B independent codebooks in one compiled program — the batched
+    replacement for the reference's per-subspace / per-cluster
+    ``build_clusters`` loops (detail/ivf_pq_build.cuh:395 train_per_subset,
+    :472 train_per_cluster, which launch one trainer per book).
+
+    ``xs`` [B, n, d] -> centers [B, K, d]. Sequential scan over B (one
+    compile, bounded memory); each book runs ``n_iters`` Lloyd iterations
+    with starved-cluster reseeding from random rows.
+    """
+    B, n, d = xs.shape
+
+    def one_book(_, inp):
+        x, key = inp
+        k_init, k_iters = jax.random.split(key)
+        idx = jax.random.randint(k_init, (n_clusters,), 0, n)
+        centers = x[idx]
+
+        def iter_body(centers, kk):
+            cn2 = jnp.sum(centers * centers, axis=1)
+            dots = jnp.dot(x, centers.T, preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGH)
+            labels = jnp.argmin(cn2[None, :] - 2.0 * dots, axis=1)
+            one_hot = (
+                labels[:, None] == jnp.arange(n_clusters)[None, :]
+            ).astype(jnp.float32)
+            sums = jnp.dot(one_hot.T, x, preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGH)
+            sizes = one_hot.sum(axis=0)
+            reseed = x[jax.random.randint(kk, (n_clusters,), 0, n)]
+            centers = jnp.where(
+                sizes[:, None] > 0,
+                sums / jnp.maximum(sizes, 1.0)[:, None],
+                reseed,
+            )
+            return centers, None
+
+        centers, _ = jax.lax.scan(
+            iter_body, centers, jax.random.split(k_iters, n_iters)
+        )
+        return None, centers
+
+    _, books = jax.lax.scan(
+        one_book, None, (xs.astype(jnp.float32), jax.random.split(key, B))
+    )
+    return books
+
+
 def calc_centers_and_sizes(x, labels, n_clusters: int):
     """Per-cluster means and sizes (reference helper
     detail/kmeans_balanced.cuh:257). Returns (centers, sizes)."""

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
-from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams, build_clusters
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
@@ -54,7 +54,7 @@ from raft_tpu.neighbors.ivf_flat import (
 from raft_tpu.utils.math import round_up_to_multiple
 from raft_tpu.utils.precision import dist_dot
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # v2: bit-packed uint32 code words + pq_dim in meta
 
 
 class codebook_gen:
@@ -127,8 +127,12 @@ class SearchParams:
 class Index:
     """IVF-PQ index (reference ivf_pq_types.hpp:199+).
 
-    ``codes`` [n_lists, cap, pq_dim] uint8; ``rec_norms`` [n_lists, cap] f32
-    (``||reconstructed residual + center||``-independent part, see search);
+    ``codes`` [n_lists, cap, n_words] uint32 — **bit-packed** PQ codes:
+    ``32 // pq_bits`` codes per word (the reference packs a dense byte
+    bitfield, ivf_pq_types.hpp:172-187; the word layout here avoids
+    word-straddling codes, wasting <= 4 bits/word for pq_bits in {5,6,7}
+    and nothing for 4/8 — shift+mask decode stays a pure VPU op).
+    ``rec_norms`` [n_lists, cap] f32 (``||reconstructed residual||^2``);
     ``pq_centers``: [pq_dim, K, pq_len] (PER_SUBSPACE) or
     [n_lists, K, pq_len] (PER_CLUSTER); ``rotation`` [rot_dim, dim].
     """
@@ -137,11 +141,12 @@ class Index:
     centers_rot: jax.Array      # [n_lists, rot_dim] f32
     rotation: jax.Array         # [rot_dim, dim] f32
     pq_centers: jax.Array
-    codes: jax.Array            # [n_lists, cap, pq_dim] uint8
+    codes: jax.Array            # [n_lists, cap, n_words] uint32 (packed)
     indices: jax.Array          # [n_lists, cap] int32
     list_sizes: jax.Array       # [n_lists] int32
     rec_norms: jax.Array        # [n_lists, cap] f32
     metric: DistanceType
+    pq_dim_: int
     metric_arg: float = 2.0
     codebook_kind: int = codebook_gen.PER_SUBSPACE
     pq_bits: int = 8
@@ -160,7 +165,7 @@ class Index:
 
     @property
     def pq_dim(self) -> int:
-        return self.codes.shape[2]
+        return self.pq_dim_
 
     @property
     def pq_len(self) -> int:
@@ -173,6 +178,45 @@ class Index:
     @property
     def size(self) -> int:
         return int(self.list_sizes.sum())
+
+
+# ---------------------------------------------------------------------------
+# bit-packed code words (reference ivf_pq_types.hpp:172-187 bitfield)
+# ---------------------------------------------------------------------------
+
+
+def codes_per_word(pq_bits: int) -> int:
+    return 32 // pq_bits
+
+
+def packed_words(pq_dim: int, pq_bits: int) -> int:
+    return -(-pq_dim // codes_per_word(pq_bits))
+
+
+def pack_codes(codes, pq_bits: int) -> jax.Array:
+    """[..., pq_dim] uint8 -> [..., n_words] uint32 (no straddling)."""
+    cpw = codes_per_word(pq_bits)
+    p = codes.shape[-1]
+    nw = packed_words(p, pq_bits)
+    pad = nw * cpw - p
+    c = jnp.asarray(codes).astype(jnp.uint32)
+    if pad:
+        c = jnp.concatenate(
+            [c, jnp.zeros((*c.shape[:-1], pad), jnp.uint32)], axis=-1
+        )
+    c = c.reshape(*c.shape[:-1], nw, cpw)
+    shifts = (jnp.arange(cpw, dtype=jnp.uint32) * pq_bits)
+    return jnp.sum(c << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_codes(packed, pq_dim: int, pq_bits: int) -> jax.Array:
+    """[..., n_words] uint32 -> [..., pq_dim] int32."""
+    cpw = codes_per_word(pq_bits)
+    j = jnp.arange(pq_dim)
+    words = jnp.take(packed, j // cpw, axis=-1)          # [..., p]
+    shifts = ((j % cpw) * pq_bits).astype(jnp.uint32)
+    mask = jnp.uint32((1 << pq_bits) - 1)
+    return ((words >> shifts) & mask).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -231,9 +275,19 @@ def _decode_gather(codes, pq_centers, codebook_kind: int, list_ids=None):
     return recon.reshape(*codes.shape[:-1], -1)
 
 
-def build(params: IndexParams, dataset) -> Index:
-    """Build the index (reference ivf_pq_build.cuh:1753)."""
-    dataset = jnp.asarray(dataset)
+def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Index:
+    """Build the index (reference ivf_pq_build.cuh:1753).
+
+    ``batch_size`` streams an out-of-core host dataset through the encoder
+    in fixed-size device batches (the reference's batch_load_iterator
+    pipeline, spatial/knn/detail/ann_utils.cuh:397) — only the trainset,
+    the per-batch slab, and the compressed codes ever live in HBM.
+    """
+    stream = batch_size is not None
+    if stream:
+        dataset = np.asarray(dataset)
+    else:
+        dataset = jnp.asarray(dataset)
     n, dim = dataset.shape
     n_lists = int(params.n_lists)
     pq_dim = int(params.pq_dim) or _auto_pq_dim(dim)
@@ -245,9 +299,9 @@ def build(params: IndexParams, dataset) -> Index:
     # 1. coarse centers on a trainset (build.cuh: build_clusters)
     frac = float(params.kmeans_trainset_fraction)
     if 0 < frac < 1.0 and int(n * frac) >= n_lists:
-        trainset = dataset[:: max(int(1.0 / frac), 1)]
+        trainset = jnp.asarray(dataset[:: max(int(1.0 / frac), 1)])
     else:
-        trainset = dataset
+        trainset = jnp.asarray(dataset)
     kb = KMeansBalancedParams(
         n_clusters=n_lists,
         n_iters=int(params.kmeans_n_iters),
@@ -273,44 +327,128 @@ def build(params: IndexParams, dataset) -> Index:
     t_rot = dist_dot(t32, rotation.T)
     t_res = (t_rot - centers_rot[t_labels]).reshape(-1, pq_dim, pq_len)
 
-    # 4. PQ codebooks (train_per_subset:395 / train_per_cluster:472)
+    # 4. PQ codebooks — batched device training, one compiled program for
+    # all books (train_per_subset:395 / train_per_cluster:472 replacements;
+    # the reference launches one balanced-kmeans per book)
+    key, ks = jax.random.split(key)
+    n_train = t_res.shape[0]
     if params.codebook_kind == codebook_gen.PER_SUBSPACE:
-        books = []
-        for s in range(pq_dim):
-            key, ks = jax.random.split(key)
-            cb, _ = build_clusters(t_res[:, s, :], K, 10, ks)
-            books.append(cb)
-        pq_centers = jnp.stack(books)  # [p, K, len]
+        # xs [p, S, len]: same row subsample for every subspace
+        S = min(n_train, max(K * 32, 8192))
+        sel = jax.random.choice(ks, n_train, (S,), replace=n_train < S)
+        xs = jnp.transpose(t_res[sel], (1, 0, 2))          # [p, S, len]
+        key, kt = jax.random.split(key)
+        pq_centers = kmeans_balanced.build_clusters_batched(xs, K, 10, kt)
     else:
-        books = []
-        t_labels_np = np.asarray(t_labels)
-        res_np = np.asarray(t_res)
-        for l in range(n_lists):
-            rows = res_np[t_labels_np == l].reshape(-1, pq_len)
-            key, ks = jax.random.split(key)
-            if rows.shape[0] < K:
-                rows = res_np.reshape(-1, pq_len)[: max(K * 4, 1024)]
-            cb, _ = build_clusters(rows, K, 10, ks)
-            books.append(np.asarray(cb))
-        pq_centers = jnp.asarray(np.stack(books))  # [C, K, len]
+        # xs [C, S, len]: S rows per cluster, wrapped from each cluster's
+        # contiguous run in label-sorted order; empty clusters fall back
+        # to global rows. S caps the per-book subvector count (~16k) to
+        # bound the gather.
+        S = max(64, 16384 // pq_dim)
+        flat = t_res.reshape(n_train, pq_dim * pq_len)
+        order = jnp.argsort(t_labels)
+        counts = jnp.bincount(t_labels, length=n_lists)
+        starts = jnp.cumsum(counts) - counts
+        s_idx = jnp.arange(S)
+        pos = starts[:, None] + s_idx[None, :] % jnp.maximum(counts[:, None], 1)
+        pos = jnp.where(counts[:, None] > 0, pos, s_idx[None, :] % n_train)
+        rows = flat[order][pos]                             # [C, S, p*len]
+        # a cluster codebook is trained on all its subvectors jointly
+        xs = rows.reshape(n_lists, S * pq_dim, pq_len)
+        key, kt = jax.random.split(key)
+        pq_centers = kmeans_balanced.build_clusters_batched(xs, K, 10, kt)
 
     index = Index(
         centers=centers,
         centers_rot=centers_rot,
         rotation=rotation,
         pq_centers=pq_centers,
-        codes=jnp.zeros((n_lists, 0, pq_dim), jnp.uint8),
+        codes=jnp.zeros(
+            (n_lists, 0, packed_words(pq_dim, int(params.pq_bits))),
+            jnp.uint32,
+        ),
         indices=jnp.full((n_lists, 0), -1, jnp.int32),
         list_sizes=jnp.zeros((n_lists,), jnp.int32),
         rec_norms=jnp.zeros((n_lists, 0), jnp.float32),
         metric=params.metric,
+        pq_dim_=pq_dim,
         metric_arg=params.metric_arg,
         codebook_kind=int(params.codebook_kind),
         pq_bits=int(params.pq_bits),
     )
     if not params.add_data_on_build:
         return index
-    return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+    if not stream:
+        return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+
+    # streaming encode: fixed-shape batches keep one compiled encoder;
+    # only compressed codes accumulate on device
+    from raft_tpu.utils.batch import BatchLoadIterator
+
+    parts_labels, parts_codes = [], []
+    for off, batch in BatchLoadIterator(dataset, int(batch_size),
+                                        pad_to_full=True):
+        lab, packed = encode(index, batch)
+        parts_labels.append(lab)
+        parts_codes.append(packed)
+    labels = jnp.concatenate(parts_labels)[:n]
+    packed = jnp.concatenate(parts_codes)[:n]
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    from raft_tpu.neighbors.ivf_flat import _aligned_cap
+
+    counts = np.bincount(np.asarray(labels), minlength=n_lists)
+    cap = _aligned_cap(int(counts.max()))
+    codes_packed, indices, list_sizes = _pack_lists(
+        packed, labels, ids, n_lists, cap
+    )
+    rec_norms = _rec_norms(
+        codes_packed, index.pq_centers, int(params.codebook_kind),
+        pq_dim, int(params.pq_bits),
+    )
+    return dataclasses.replace(
+        index,
+        codes=codes_packed,
+        indices=indices,
+        list_sizes=list_sizes,
+        rec_norms=rec_norms,
+    )
+
+
+def encode(index: Index, vectors) -> Tuple[jax.Array, jax.Array]:
+    """Label + PQ-encode vectors against an index's quantizers (reference
+    process_and_fill_codes:1322, minus the list scatter). Returns
+    (labels [n] int32, packed codes [n, n_words] uint32)."""
+    vectors = jnp.asarray(vectors)
+    kb = KMeansBalancedParams(
+        n_clusters=index.n_lists,
+        metric=(
+            DistanceType.InnerProduct
+            if index.metric == DistanceType.InnerProduct
+            else DistanceType.L2Expanded
+        ),
+    )
+    labels = kmeans_balanced.predict(kb, index.centers, vectors)
+
+    # encode: rotated residual → per-subspace nearest codebook entry
+    x32 = vectors.astype(jnp.float32)
+    x_rot = dist_dot(x32, index.rotation.T)
+    res = (x_rot - index.centers_rot[labels]).reshape(
+        -1, index.pq_dim, index.pq_len
+    )
+    if index.codebook_kind == codebook_gen.PER_SUBSPACE:
+        codes = _encode_subspace(res, index.pq_centers, index.pq_book_size)
+    else:
+        books = index.pq_centers[labels]  # [n, K, len]
+        dots = jnp.einsum(
+            "npl,nkl->npk", res, books,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rn = jnp.sum(res * res, axis=2)[:, :, None]
+        cn = jnp.sum(books * books, axis=2)[:, None, :]
+        codes = jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
+    return labels, pack_codes(codes, index.pq_bits)
 
 
 def extend(index: Index, new_vectors, new_ids=None) -> Index:
@@ -322,53 +460,27 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         new_ids = jnp.arange(index.size, index.size + n_new, dtype=jnp.int32)
     new_ids = jnp.asarray(new_ids).astype(jnp.int32)
 
-    kb = KMeansBalancedParams(
-        n_clusters=index.n_lists,
-        metric=(
-            DistanceType.InnerProduct
-            if index.metric == DistanceType.InnerProduct
-            else DistanceType.L2Expanded
-        ),
-    )
-    labels = kmeans_balanced.predict(kb, index.centers, new_vectors)
-
-    # encode: rotated residual → per-subspace nearest codebook entry
-    x32 = new_vectors.astype(jnp.float32)
-    x_rot = dist_dot(x32, index.rotation.T)
-    res = (x_rot - index.centers_rot[labels]).reshape(
-        -1, index.pq_dim, index.pq_len
-    )
-    if index.codebook_kind == codebook_gen.PER_SUBSPACE:
-        new_codes = _encode_subspace(res, index.pq_centers, index.pq_book_size)
-    else:
-        books = index.pq_centers[labels]  # [n, K, len]
-        dots = jnp.einsum(
-            "npl,nkl->npk", res, books,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        rn = jnp.sum(res * res, axis=2)[:, :, None]
-        cn = jnp.sum(books * books, axis=2)[:, None, :]
-        new_codes = jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
+    labels, new_packed = encode(index, new_vectors)
 
     # merge with existing lists and repack, all on device: old padding rows
     # get the out-of-range label n_lists so _pack_lists drops them (no
     # host round-trip)
     C = index.n_lists
+    nw = packed_words(index.pq_dim, index.pq_bits)
     old_cap = index.codes.shape[1]
     if old_cap > 0 and index.size > 0:
-        old_codes = index.codes.reshape(-1, index.pq_dim)
+        old_codes = index.codes.reshape(-1, nw)
         old_ids = index.indices.reshape(-1)
         old_labels = jnp.where(
             old_ids >= 0,
             jnp.repeat(jnp.arange(C, dtype=jnp.int32), old_cap),
             jnp.int32(C),
         )
-        codes_all = jnp.concatenate([old_codes, new_codes], axis=0)
+        codes_all = jnp.concatenate([old_codes, new_packed], axis=0)
         labels_all = jnp.concatenate([old_labels, labels])
         ids_all = jnp.concatenate([old_ids, new_ids])
     else:
-        codes_all, labels_all, ids_all = new_codes, labels, new_ids
+        codes_all, labels_all, ids_all = new_packed, labels, new_ids
 
     counts = np.asarray(index.list_sizes) + np.bincount(
         np.asarray(labels), minlength=C
@@ -380,17 +492,10 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         codes_all, labels_all, ids_all, C, cap
     )
 
-    # precompute reconstruction norms ||recon||^2 per stored vector
-    if index.codebook_kind == codebook_gen.PER_SUBSPACE:
-        recon = _decode_gather(
-            codes_packed, index.pq_centers, index.codebook_kind
-        )  # [C, cap, rot_dim]
-    else:
-        recon = _decode_gather(
-            codes_packed, index.pq_centers, index.codebook_kind,
-            jnp.arange(index.n_lists)[:, None],
-        )
-    rec_norms = jnp.sum(recon * recon, axis=-1)
+    rec_norms = _rec_norms(
+        codes_packed, index.pq_centers, index.codebook_kind,
+        index.pq_dim, index.pq_bits,
+    )
 
     return dataclasses.replace(
         index,
@@ -401,12 +506,38 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     )
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _rec_norms(codes_packed, pq_centers, codebook_kind: int, pq_dim: int,
+               pq_bits: int):
+    """||reconstructed residual||^2 per stored vector, scanned over lists
+    so the unpacked [cap, pq_dim] codes never materialize for the whole
+    index at once."""
+    C = codes_packed.shape[0]
+
+    def body(_, inp):
+        blk, lid = inp                                     # [cap, nw], []
+        u = unpack_codes(blk, pq_dim, pq_bits)             # [cap, p]
+        if codebook_kind == codebook_gen.PER_SUBSPACE:
+            recon = _decode_gather(u, pq_centers, codebook_kind)
+        else:
+            recon = _decode_gather(u, pq_centers, codebook_kind,
+                                   jnp.full((u.shape[0],), lid))
+        return None, jnp.sum(recon * recon, axis=-1)
+
+    _, norms = jax.lax.scan(
+        body, None, (codes_packed, jnp.arange(C, dtype=jnp.int32))
+    )
+    return norms
+
+
 # ---------------------------------------------------------------------------
 # search
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
+@functools.partial(
+    jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+)
 def _pq_search(
     arrays,
     k: int,
@@ -420,12 +551,15 @@ def _pq_search(
     local_recall_target: float = 0.95,
     lut_dtype: str = "f32",
     internal_dtype: str = "f32",
+    pq_dim: int = 0,
+    pq_bits: int = 8,
 ):
     (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
      list_sizes, rec_norms, filter_bits) = arrays
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
-    C, cap, p = codes.shape
+    C, cap, _nw = codes.shape
+    p = pq_dim
     rot_dim = rotation.shape[0]
     q32 = queries.astype(jnp.float32)
     m = q32.shape[0]
@@ -456,7 +590,7 @@ def _pq_search(
 
     def body(_, inp):
         bl, bq = inp  # [bb], [bb, group]
-        blk_codes = codes[bl]            # [bb, cap, p]
+        blk_codes = unpack_codes(codes[bl], p, pq_bits)  # [bb, cap, p]
         ids = indices[bl]
         sizes = list_sizes[bl]
         rn = rec_norms[bl]               # [bb, cap]
@@ -571,6 +705,8 @@ def search(
         float(search_params.local_recall_target),
         _norm_dtype_knob(search_params.lut_dtype),
         _norm_dtype_knob(search_params.internal_distance_dtype),
+        int(index.pq_dim),
+        int(index.pq_bits),
     )
 
 
@@ -619,6 +755,7 @@ def save(path: str, index: Index) -> None:
             "metric_arg": index.metric_arg,
             "codebook_kind": index.codebook_kind,
             "pq_bits": index.pq_bits,
+            "pq_dim": index.pq_dim,
         },
         arrays,
     )
@@ -636,6 +773,7 @@ def load(path: str) -> Index:
         list_sizes=jnp.asarray(arrays["list_sizes"]),
         rec_norms=jnp.asarray(arrays["rec_norms"]),
         metric=DistanceType(meta["metric"]),
+        pq_dim_=int(meta["pq_dim"]),
         metric_arg=meta["metric_arg"],
         codebook_kind=int(meta["codebook_kind"]),
         pq_bits=int(meta["pq_bits"]),
