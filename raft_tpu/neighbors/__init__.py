@@ -1,6 +1,12 @@
 """Neighbors layer — the core product (SURVEY.md §2.9)."""
 
-from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, refine as _refine_mod
+from raft_tpu.neighbors import (
+    brute_force,
+    cagra,
+    ivf_flat,
+    ivf_pq,
+    refine as _refine_mod,
+)
 from raft_tpu.neighbors.common import (
     BitsetFilter,
     IndexParams,
@@ -13,6 +19,7 @@ from raft_tpu.neighbors.refine import refine
 
 __all__ = [
     "brute_force",
+    "cagra",
     "ivf_flat",
     "ivf_pq",
     "refine",

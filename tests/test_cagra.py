@@ -1,0 +1,148 @@
+"""CAGRA tests — reference pattern (cpp/test/neighbors/ann_cagra.cuh):
+recall vs exact oracle, graph-optimize semantics vs a naive oracle of the
+reference's detour-count rule, serialization round-trips."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import cagra
+from tests.oracles import eval_recall, naive_knn
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(11)
+    centers = rng.uniform(-5, 5, (32, 24)).astype(np.float32)
+    x = (centers[rng.integers(0, 32, 10_000)]
+         + 0.7 * rng.standard_normal((10_000, 24))).astype(np.float32)
+    q = (centers[rng.integers(0, 32, 200)]
+         + 0.7 * rng.standard_normal((200, 24))).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def index(dataset):
+    x, _ = dataset
+    params = cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24
+    )
+    return cagra.build(params, x)
+
+
+def test_build_structure(dataset, index):
+    x, _ = dataset
+    n = x.shape[0]
+    assert index.graph.shape == (n, 24)
+    g = np.asarray(index.graph)
+    assert g.min() >= 0 and g.max() < n
+    # no self-edges
+    assert not (g == np.arange(n)[:, None]).any()
+
+
+def test_search_recall(dataset, index):
+    x, q = dataset
+    k = 10
+    sp = cagra.SearchParams(itopk_size=64, search_width=2)
+    dist, idx = cagra.search(sp, index, q, k)
+    _, want = naive_knn(q, x, k)
+    rec = eval_recall(np.asarray(idx), want)
+    assert rec > 0.9, rec
+
+
+def test_search_distances_are_exactish(dataset, index):
+    x, q = dataset
+    k = 5
+    sp = cagra.SearchParams(itopk_size=64, search_width=2)
+    dist, idx = cagra.search(sp, index, q[:20], k)
+    idx = np.asarray(idx)
+    dist = np.asarray(dist)
+    for i in range(20):
+        for j in range(k):
+            if idx[i, j] < 0:
+                continue
+            true = ((q[i] - x[idx[i, j]]) ** 2).sum()
+            np.testing.assert_allclose(dist[i, j], true, rtol=5e-2, atol=0.5)
+
+
+def _naive_detour_counts(graph):
+    """Reference rule (graph_core.cuh:360 comment): for edge A->B at rank
+    kAB, count ranks kAD < kAB with B in graph[A[kAD]]."""
+    n, D = graph.shape
+    out = np.zeros((n, D), np.int32)
+    for a in range(n):
+        for kab in range(D):
+            b = graph[a, kab]
+            c = 0
+            for kad in range(kab):
+                if b in graph[graph[a, kad]]:
+                    c += 1
+            out[a, kab] = c
+    return out
+
+
+def test_detour_counts_match_oracle():
+    rng = np.random.default_rng(5)
+    n, D = 40, 6
+    graph = np.stack(
+        [rng.choice([j for j in range(n) if j != i], D, replace=False)
+         for i in range(n)]
+    ).astype(np.int32)
+    got = np.asarray(cagra._detour_counts(graph, 16))
+    want = _naive_detour_counts(graph)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_optimize_degree_and_reverse_edges():
+    rng = np.random.default_rng(6)
+    n, D, deg = 60, 12, 6
+    graph = np.stack(
+        [rng.choice([j for j in range(n) if j != i], D, replace=False)
+         for i in range(n)]
+    ).astype(np.int32)
+    out = np.asarray(cagra.optimize(graph, deg, chunk=16))
+    assert out.shape == (n, deg)
+    assert (out >= 0).all() and (out < n).all()
+    # rows contain no duplicate edges
+    for i in range(n):
+        assert len(set(out[i])) == deg
+    # protected prefix preserved: first deg//2 = lowest-detour originals
+    counts = _naive_detour_counts(graph)
+    for i in range(5):
+        key = counts[i] * D + np.arange(D)
+        keep = graph[i][np.argsort(key, kind="stable")][: deg // 2]
+        np.testing.assert_array_equal(out[i, : deg // 2], keep)
+
+
+def test_from_graph_and_serialize(dataset, index, tmp_path):
+    x, q = dataset
+    p = str(tmp_path / "cagra.idx")
+    cagra.save(p, index)
+    loaded = cagra.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(loaded.graph), np.asarray(index.graph)
+    )
+    sp = cagra.SearchParams(itopk_size=32)
+    _, i1 = cagra.search(sp, index, q[:10], 5)
+    _, i2 = cagra.search(sp, loaded, q[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_hnswlib_export(index, tmp_path):
+    import struct
+
+    p = str(tmp_path / "cagra_hnsw.bin")
+    cagra.serialize_to_hnswlib(p, index)
+    n, dim, deg = index.size, index.dim, index.graph_degree
+    size_links0 = deg * 4 + 4
+    size_per_elem = size_links0 + dim * 4 + 8
+    with open(p, "rb") as f:
+        header = f.read(8 * 5 + 4 * 2 + 8 + 8 * 4)
+        offset0, maxn, cur, spe, sl0 = struct.unpack("<5Q", header[:40])
+        assert (maxn, cur) == (n, n)
+        assert spe == size_per_elem and sl0 == size_links0
+        # first element: link count == degree, then the graph row
+        first = f.read(4 + deg * 4)
+        cnt = struct.unpack("<I", first[:4])[0]
+        assert cnt == deg
+        row = np.frombuffer(first[4:], dtype="<u4")
+        np.testing.assert_array_equal(row, np.asarray(index.graph[0]))

@@ -1,0 +1,66 @@
+"""NN-descent tests — reference pattern (cpp/test/neighbors/ann_nn_descent.cuh):
+all-KNN-graph recall vs exact oracle."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import cagra, nn_descent
+from tests.oracles import eval_recall, naive_knn
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(21)
+    centers = rng.uniform(-5, 5, (16, 24)).astype(np.float32)
+    x = (centers[rng.integers(0, 16, 6000)]
+         + 0.8 * rng.standard_normal((6000, 24))).astype(np.float32)
+    return x
+
+
+def test_all_knn_graph_recall(dataset):
+    x = dataset
+    k = 32
+    params = nn_descent.IndexParams(graph_degree=k, max_iterations=20)
+    index = nn_descent.build(params, x)
+    assert index.graph.shape == (x.shape[0], k)
+    g = np.asarray(index.graph)
+    # oracle on a subset: true neighbors 1..k (self excluded)
+    sub = 300
+    _, want = naive_knn(x[:sub], x, k + 1)
+    rec = np.mean(
+        [len(set(g[i]) & set(want[i][1:k + 1])) / k for i in range(sub)]
+    )
+    assert rec > 0.9, rec
+    # distances are true metric values for the returned ids
+    d = np.asarray(index.distances)
+    for i in range(5):
+        true = ((x[i] - x[g[i, 0]]) ** 2).sum()
+        np.testing.assert_allclose(d[i, 0], true, rtol=1e-3, atol=1e-3)
+
+
+def test_no_self_edges_no_dups(dataset):
+    x = dataset
+    params = nn_descent.IndexParams(graph_degree=16, max_iterations=8)
+    index = nn_descent.build(params, x)
+    g = np.asarray(index.graph)
+    assert not (g == np.arange(x.shape[0])[:, None]).any()
+    for i in range(0, 200, 7):
+        row = g[i][g[i] >= 0]
+        assert len(set(row)) == len(row)
+
+
+def test_cagra_with_nn_descent_builder(dataset):
+    x = dataset
+    params = cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24,
+        graph_build_algo=cagra.build_algo.NN_DESCENT,
+    )
+    index = cagra.build(params, x)
+    rng = np.random.default_rng(3)
+    q = x[rng.integers(0, x.shape[0], 100)] + 0.05 * rng.standard_normal(
+        (100, x.shape[1])
+    ).astype(np.float32)
+    sp = cagra.SearchParams(itopk_size=64, search_width=2)
+    _, idx = cagra.search(sp, index, q.astype(np.float32), 10)
+    _, want = naive_knn(q.astype(np.float32), x, 10)
+    assert eval_recall(np.asarray(idx), want) > 0.9
