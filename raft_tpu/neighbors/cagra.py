@@ -487,21 +487,32 @@ def serialize_to_hnswlib(path: str, index: Index) -> None:
     deg = graph.shape[1]
     M = deg // 2
     size_links_level0 = deg * 4 + 4
-    size_data_per_element = size_links_level0 + dim * 4 + 8  # +label
+    data_size = dim * 4
+    size_data_per_element = size_links_level0 + data_size + 8  # +label
+    offset_data = size_links_level0
+    label_offset = size_links_level0 + data_size
     with open(path, "wb") as f:
-        f.write(struct.pack("<Q", size_data_per_element * n))  # offsetLevel0
+        # header fields in hnswlib HierarchicalNSW::loadIndex read order:
+        # offsetLevel0, max_elements, cur_element_count,
+        # size_data_per_element, label_offset, offsetData (size_t each),
+        # maxlevel (int), enterpoint (unsigned), maxM, maxM0, M (size_t),
+        # mult (double), ef_construction (size_t)
+        f.write(struct.pack("<Q", 0))                          # offsetLevel0
         f.write(struct.pack("<Q", n))                          # max_elements
         f.write(struct.pack("<Q", n))                          # cur_count
         f.write(struct.pack("<Q", size_data_per_element))
-        f.write(struct.pack("<Q", size_links_level0))
-        f.write(struct.pack("<I", 0))                          # maxlevel
+        f.write(struct.pack("<Q", label_offset))
+        f.write(struct.pack("<Q", offset_data))
+        f.write(struct.pack("<i", 0))                          # maxlevel
         f.write(struct.pack("<I", 0))                          # entrypoint
-        f.write(struct.pack("<d", 1.0 / np.log(max(M, 2))))    # mult
-        f.write(struct.pack("<Q", deg * 4 + 4))                # size_links
+        f.write(struct.pack("<Q", M))                          # maxM
+        f.write(struct.pack("<Q", deg))                        # maxM0
         f.write(struct.pack("<Q", M))                          # M
-        f.write(struct.pack("<Q", deg))                        # maxM0... M0
+        f.write(struct.pack("<d", 1.0 / np.log(max(M, 2))))    # mult
         f.write(struct.pack("<Q", 200))                        # ef_construction
         for i in range(n):
+            # link count lives in the first 2 bytes (hnswlib setListCount
+            # writes unsigned short); <I with deg < 2^16 matches that
             f.write(struct.pack("<I", deg))
             f.write(graph[i].astype("<u4").tobytes())
             f.write(data[i].astype("<f4").tobytes())

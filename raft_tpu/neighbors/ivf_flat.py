@@ -180,7 +180,13 @@ def _pack_lists(data, labels, row_ids, n_lists: int, cap: int):
 
     Rows labelled >= n_lists are dropped (their scatter slots fall out of
     bounds, which XLA drops) — device-side ``extend`` uses this to discard
-    the padding rows of the old storage without a host round-trip."""
+    the padding rows of the old storage without a host round-trip.
+
+    Lists holding more than ``cap`` rows are truncated to their first
+    ``cap`` rows in stable row order (IVF builds size cap >= max list
+    count so this never fires there; the CAGRA/nn-descent reverse-graph
+    packers rely on it to cap hub in-degree). Returned sizes are the
+    *stored* (truncated) counts."""
     n, d = data.shape
     order = jnp.argsort(labels, stable=True)
     sorted_labels = labels[order]
@@ -188,8 +194,11 @@ def _pack_lists(data, labels, row_ids, n_lists: int, cap: int):
     starts = jnp.cumsum(counts) - counts
     pos = jnp.arange(n) - starts[jnp.minimum(sorted_labels, n_lists - 1)]
     slot = jnp.where(
-        sorted_labels < n_lists, sorted_labels * cap + pos, n_lists * cap
+        (sorted_labels < n_lists) & (pos < cap),
+        sorted_labels * cap + pos,
+        n_lists * cap,
     )
+    counts = jnp.minimum(counts, cap)
     storage = (
         jnp.zeros((n_lists * cap, d), data.dtype).at[slot].set(data[order])
     ).reshape(n_lists, cap, d)

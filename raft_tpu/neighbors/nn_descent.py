@@ -101,6 +101,7 @@ def _merge_topk_unique(cur_d, cur_i, new_d, new_i, K: int):
         axis=1,
     ) | (si < 0)
     sd = jnp.where(dup, jnp.inf, sd)
+    si = jnp.where(dup, -1, si)  # dup slots must not leak ids into the top-K
     nd, sel = jax.lax.top_k(-sd, K)
     return -nd, jnp.take_along_axis(si, sel, axis=1)
 

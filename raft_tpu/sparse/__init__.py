@@ -1,0 +1,25 @@
+"""Sparse subsystem — COO/CSR types, ops, linalg, distances, neighbors,
+MST/CC solvers (reference cpp/include/raft/sparse/, SURVEY.md §2.7)."""
+
+from raft_tpu.sparse.types import (
+    COO,
+    CSR,
+    coo_sort,
+    coo_to_csr,
+    coo_to_dense,
+    csr_to_coo,
+    dense_to_coo,
+    dense_to_csr,
+    from_scipy,
+    to_scipy,
+)
+from raft_tpu.sparse import distance, linalg, neighbors, op, solver
+from raft_tpu.sparse.solver import connected_components, mst
+
+__all__ = [
+    "COO", "CSR",
+    "coo_sort", "coo_to_csr", "coo_to_dense", "csr_to_coo",
+    "dense_to_coo", "dense_to_csr", "from_scipy", "to_scipy",
+    "distance", "linalg", "neighbors", "op", "solver",
+    "connected_components", "mst",
+]

@@ -1,0 +1,112 @@
+"""Sparse linear algebra (reference sparse/linalg/{spmm,transpose,norm,
+degree,add,symmetrize,spectral}.cuh).
+
+TPU formulation: SpMV/SpMM are gather + segment-sum — XLA lowers the
+segment-sum to a sorted-scatter-add which is bandwidth-bound, exactly the
+roofline a cuSPARSE SpMV sits on. For the MXU-heavy consumers (spectral
+embedding) the Lanczos operator only needs matvecs, so this is the whole
+story; there is deliberately no sparse-GEMM — at RAFT's densities a
+block-densified dense GEMM beats any TPU SpGEMM formulation
+(see sparse/distance.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR, coo_sort, coo_to_csr, csr_to_coo
+from raft_tpu.sparse import op as sparse_op
+
+
+def spmv(csr: CSR, v) -> jax.Array:
+    """y = A @ v for CSR A [m, n], dense v [n]."""
+    coo = csr_to_coo(csr)
+    prod = csr.vals * v[csr.indices]
+    return jax.ops.segment_sum(prod, coo.rows, num_segments=csr.shape[0])
+
+
+def spmm(csr: CSR, b) -> jax.Array:
+    """C = A @ B for CSR A [m, k], dense B [k, n] (sparse/linalg/spmm.cuh).
+
+    O(nnz · n) gather + segment-sum; rows of B are gathered per nonzero.
+    """
+    coo = csr_to_coo(csr)
+    contrib = csr.vals[:, None] * b[csr.indices]  # [nnz, n]
+    return jax.ops.segment_sum(contrib, coo.rows, num_segments=csr.shape[0])
+
+
+def gemv_t(csr: CSR, v) -> jax.Array:
+    """y = Aᵀ @ v without materializing the transpose."""
+    coo = csr_to_coo(csr)
+    return jax.ops.segment_sum(
+        csr.vals * v[coo.rows], csr.indices, num_segments=csr.shape[1]
+    )
+
+
+def transpose(csr: CSR) -> CSR:
+    """CSR transpose via COO swap + re-sort (sparse/linalg/transpose.cuh)."""
+    coo = csr_to_coo(csr)
+    m, n = csr.shape
+    return coo_to_csr(COO(coo.cols, coo.rows, coo.vals, (n, m)))
+
+
+def row_norm(csr: CSR, norm: str = "l2") -> jax.Array:
+    """Per-row norms (sparse/linalg/norm.cuh rowNormCsr): l1 | l2 | linf."""
+    coo = csr_to_coo(csr)
+    m = csr.shape[0]
+    if norm == "l1":
+        return jax.ops.segment_sum(jnp.abs(csr.vals), coo.rows, num_segments=m)
+    if norm == "l2":
+        return jax.ops.segment_sum(csr.vals * csr.vals, coo.rows, num_segments=m)
+    if norm == "linf":
+        return jax.ops.segment_max(jnp.abs(csr.vals), coo.rows, num_segments=m)
+    raise ValueError(norm)
+
+
+def add(a: CSR, b: CSR) -> CSR:
+    """C = A + B (sparse/linalg/add.cuh csr_add). Host-compressing."""
+    assert a.shape == b.shape
+    ca, cb = csr_to_coo(a), csr_to_coo(b)
+    both = COO(
+        jnp.concatenate([ca.rows, cb.rows]),
+        jnp.concatenate([ca.cols, cb.cols]),
+        jnp.concatenate([ca.vals, cb.vals]),
+        a.shape,
+    )
+    return coo_to_csr(sparse_op.sum_duplicates(both), assume_sorted=True)
+
+
+def degree(csr: CSR) -> jax.Array:
+    """Weighted vertex degree d_i = Σ_j a_ij."""
+    return row_norm(csr, "l1")
+
+
+def laplacian(adj: CSR, normalized: bool = False) -> Tuple[CSR, jax.Array]:
+    """Graph Laplacian L = D - A (or normalized I - D^-1/2 A D^-1/2) from a
+    symmetric adjacency (the operator behind the reference's
+    spectral/matrix_wrappers.hpp laplacian_matrix_t).
+
+    Returns (L as CSR, degree vector). The diagonal is appended as explicit
+    entries, so L is directly usable by spmv/Lanczos.
+    """
+    coo = csr_to_coo(adj)
+    m = adj.shape[0]
+    d = jax.ops.segment_sum(coo.vals, coo.rows, num_segments=m)
+    if normalized:
+        dinv = jnp.where(d > 0, 1.0 / jnp.sqrt(jnp.maximum(d, 1e-30)), 0.0)
+        offdiag = -coo.vals * dinv[coo.rows] * dinv[coo.cols]
+        diag = jnp.where(d > 0, 1.0, 0.0)
+    else:
+        offdiag = -coo.vals
+        diag = d
+    rows = jnp.concatenate([coo.rows, jnp.arange(m, dtype=jnp.int32)])
+    cols = jnp.concatenate([coo.cols, jnp.arange(m, dtype=jnp.int32)])
+    vals = jnp.concatenate([offdiag, diag])
+    lap = coo_to_csr(
+        sparse_op.sum_duplicates(COO(rows, cols, vals, adj.shape)),
+        assume_sorted=True,
+    )
+    return lap, d

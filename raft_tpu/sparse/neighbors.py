@@ -1,0 +1,81 @@
+"""Sparse neighbors: sparse brute-force KNN and KNN-graph construction
+(reference sparse/neighbors/{brute_force,knn,knn_graph,
+cross_component_nn}.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.sparse import distance as sparse_distance
+from raft_tpu.sparse.types import COO, CSR
+
+
+def brute_force_knn(
+    x: CSR, y: CSR, k: int, metric="euclidean", metric_arg: float = 2.0,
+    block_rows: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN between sparse query rows ``x`` and sparse index rows ``y``
+    (reference sparse/neighbors/detail/knn.cuh brute_force_knn: tiled sparse
+    pairwise + select_k per tile — the same structure here, with the tile
+    distances coming from the densified-block engine).
+
+    Returns (distances [m, k], indices [m, k]).
+    """
+    metric = sparse_distance.check_sparse_metric(metric)
+    minim = is_min_close(metric)
+    m = x.shape[0]
+    out_d, out_i = [], []
+    yb = sparse_distance.densify_block(y, 0, y.shape[0])
+    for r0 in range(0, m, block_rows):
+        r1 = min(r0 + block_rows, m)
+        xb = sparse_distance.densify_block(x, r0, r1)
+        d = sparse_distance._pairwise(
+            xb, yb, int(metric), float(metric_arg), None, None
+        )
+        dd, ii = select_k(d, k, select_min=minim)
+        out_d.append(dd)
+        out_i.append(ii)
+    return jnp.concatenate(out_d, axis=0), jnp.concatenate(out_i, axis=0)
+
+
+def knn_graph(
+    x, k: int, metric="sqeuclidean", include_self: bool = False
+) -> COO:
+    """Symmetric KNN connectivity graph from dense rows (reference
+    sparse/neighbors/knn_graph.cuh knn_graph — the single-linkage
+    connectivity builder).
+
+    Each row contributes its k nearest neighbors as weighted edges; the
+    graph is returned un-symmetrized COO (callers symmetrize with
+    sparse.op.symmetrize, as the reference's connectivities detail does).
+    """
+    from raft_tpu.neighbors import brute_force
+
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    kk = k if include_self else k + 1
+    dist, idx = brute_force.knn(x, x, kk, metric=metric)
+    if not include_self:
+        # drop each row's self column (first hit at distance 0; guard the
+        # degenerate duplicate-point case by masking where idx == row)
+        rows = jnp.arange(n)[:, None]
+        self_mask = idx == rows
+        # ensure exactly one drop per row: prefer the self column, else col 0
+        has_self = self_mask.any(axis=1)
+        drop = jnp.where(has_self, jnp.argmax(self_mask, axis=1), 0)
+        keep = jnp.arange(kk)[None, :] != drop[:, None]
+        order = jnp.argsort(~keep, axis=1, stable=True)[:, : kk - 1]
+        dist = jnp.take_along_axis(dist, order, axis=1)
+        idx = jnp.take_along_axis(idx, order, axis=1)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), idx.shape[1])
+    return COO(
+        rows, idx.reshape(-1).astype(jnp.int32),
+        dist.reshape(-1).astype(jnp.float32), (n, n),
+    )

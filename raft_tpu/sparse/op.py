@@ -1,0 +1,126 @@
+"""COO/CSR structural ops (reference sparse/op/{sort,filter,reduce,slice,
+row_op}.cuh).
+
+Fixed-shape policy: ops that shrink nnz (dedup, zero-removal) come in two
+flavors — a jittable masked form that keeps nnz and returns a validity
+mask, and a host ``compress=True`` form that materializes the short result
+at the API boundary (the reference's equivalent of a device→host nnz
+readback before reallocating, e.g. sparse/op/detail/filter.cuh:coo_remove_scalar).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.types import COO, CSR, coo_sort, coo_to_csr, csr_to_coo
+
+
+def degree(coo: COO) -> jax.Array:
+    """Per-row nonzero count (sparse/linalg/degree.cuh coo_degree)."""
+    return jnp.zeros(coo.shape[0], jnp.int32).at[coo.rows].add(1)
+
+
+def coo_remove_scalar(coo: COO, scalar: float = 0.0) -> COO:
+    """Drop entries equal to ``scalar`` (sparse/op/filter.cuh
+    coo_remove_scalar). Host-compressing: output nnz is data-dependent."""
+    keep = np.asarray(coo.vals != scalar)
+    return COO(
+        jnp.asarray(np.asarray(coo.rows)[keep]),
+        jnp.asarray(np.asarray(coo.cols)[keep]),
+        jnp.asarray(np.asarray(coo.vals)[keep]),
+        coo.shape,
+    )
+
+
+def sum_duplicates(coo: COO, compress: bool = True):
+    """Merge duplicate (row, col) entries by summing values
+    (the reference's max_duplicates/sum pattern in sparse/op/reduce.cuh).
+
+    compress=True: host-compressed COO with unique coordinates.
+    compress=False: jittable — returns (coo_sorted_summed, valid_mask) at
+    the original nnz; invalid slots carry zero values.
+    """
+    coo = coo_sort(coo)
+    nnz = coo.rows.shape[0]
+    if nnz == 0:
+        return coo if compress else (coo, jnp.zeros((0,), bool))
+    same = (coo.rows[1:] == coo.rows[:-1]) & (coo.cols[1:] == coo.cols[:-1])
+    first = jnp.concatenate([jnp.ones((1,), bool), ~same])
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    summed = jnp.zeros((nnz,), coo.vals.dtype).at[gid].add(coo.vals)
+    # each group's sum lands on the group's first slot; the rest zero out
+    vals = jnp.where(first, summed[gid], 0.0)
+    out = COO(coo.rows, coo.cols, vals, coo.shape)
+    if not compress:
+        return out, first
+    keep = np.asarray(first)
+    return COO(
+        jnp.asarray(np.asarray(out.rows)[keep]),
+        jnp.asarray(np.asarray(out.cols)[keep]),
+        jnp.asarray(np.asarray(out.vals)[keep]),
+        coo.shape,
+    )
+
+
+def symmetrize(coo: COO, mode: str = "max") -> COO:
+    """Graph symmetrization A ← sym(A) (sparse/linalg/symmetrize.cuh).
+
+    mode: "max" keeps max(|a_ij|, |a_ji|) — the KNN-graph symmetrization
+    used for single-linkage connectivity; "sum" computes A + Aᵀ;
+    "mean" (A + Aᵀ)/2. Host-compressing.
+    """
+    both = COO(
+        jnp.concatenate([coo.rows, coo.cols]),
+        jnp.concatenate([coo.cols, coo.rows]),
+        jnp.concatenate([coo.vals, coo.vals]),
+        coo.shape,
+    )
+    if mode == "sum":
+        return sum_duplicates(both)
+    # recover per-key duplicate counts to undo the sum
+    s = coo_sort(both)
+    same = (s.rows[1:] == s.rows[:-1]) & (s.cols[1:] == s.cols[:-1])
+    first = jnp.concatenate([jnp.ones((1,), bool), ~same])
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    nnz2 = s.rows.shape[0]
+    cnt = jnp.zeros((nnz2,), jnp.float32).at[gid].add(1.0)
+    if mode == "mean":
+        summed = jnp.zeros((nnz2,), s.vals.dtype).at[gid].add(s.vals)
+        vals = jnp.where(first, summed[gid] / cnt[gid], 0.0)
+    elif mode == "max":
+        big = jnp.full((nnz2,), -jnp.inf, jnp.float32)
+        mx = big.at[gid].max(s.vals.astype(jnp.float32))
+        vals = jnp.where(first, mx[gid].astype(s.vals.dtype), 0.0)
+    else:
+        raise ValueError(mode)
+    keep = np.asarray(first)
+    return COO(
+        jnp.asarray(np.asarray(s.rows)[keep]),
+        jnp.asarray(np.asarray(s.cols)[keep]),
+        jnp.asarray(np.asarray(vals)[keep]),
+        coo.shape,
+    )
+
+
+def row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """Contiguous row range view (sparse/op/slice.cuh csr_row_slice).
+    Host-compressing (slice nnz is data-dependent)."""
+    indptr = np.asarray(csr.indptr)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    return CSR(
+        jnp.asarray(indptr[start : stop + 1] - lo, jnp.int32),
+        csr.indices[lo:hi],
+        csr.vals[lo:hi],
+        (stop - start, csr.shape[1]),
+    )
+
+
+def row_op(csr: CSR, fn) -> CSR:
+    """Apply ``fn(vals, rows) -> vals`` over entries with their row ids
+    (sparse/op/row_op.cuh csr_row_op analog)."""
+    coo = csr_to_coo(csr)
+    return CSR(csr.indptr, csr.indices, fn(coo.vals, coo.rows), csr.shape)

@@ -1,0 +1,159 @@
+"""Sparse matrix containers — COO and CSR.
+
+TPU-native analog of the reference's owning/view sparse structures
+(cpp/include/raft/core/{coo_matrix,csr_matrix,sparse_types}.hpp and the
+legacy ``raft::sparse::COO`` in sparse/coo.hpp).
+
+Design: both containers are immutable pytree dataclasses with a *fixed*
+``nnz`` — XLA requires static shapes, so structural mutation (dedup,
+filtering) either returns a same-length container plus a validity mask, or
+compresses on the host at an API boundary. ``shape`` is static aux data so
+jitted functions specialize per matrix geometry, matching how the reference
+templates on index/value types rather than carrying runtime descriptors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class COO:
+    """Coordinate-format sparse matrix (reference sparse/coo.hpp COO).
+
+    rows/cols: int32 [nnz]; vals: [nnz]; shape: static (m, n).
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        return cls(*children, shape=shape)
+
+    def to_dense(self) -> jax.Array:
+        return coo_to_dense(self)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CSR:
+    """Compressed-sparse-row matrix (reference core/csr_matrix.hpp).
+
+    indptr: int32 [m+1]; indices: int32 [nnz]; vals: [nnz]; shape (m, n).
+    """
+
+    indptr: jax.Array
+    indices: jax.Array
+    vals: jax.Array
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.vals), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        return cls(*children, shape=shape)
+
+    def to_dense(self) -> jax.Array:
+        return coo_to_dense(csr_to_coo(self))
+
+
+# ---------------------------------------------------------------------------
+# conversions (reference sparse/convert/{coo,csr,dense}.cuh)
+# ---------------------------------------------------------------------------
+
+
+def coo_sort(coo: COO) -> COO:
+    """Row-major (row, col) lexicographic sort (sparse/op/sort.cuh coo_sort)."""
+    order = jnp.lexsort((coo.cols, coo.rows))
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+def coo_to_csr(coo: COO, assume_sorted: bool = False) -> CSR:
+    """COO → CSR (sparse/convert/csr.cuh sorted_coo_to_csr)."""
+    if not assume_sorted:
+        coo = coo_sort(coo)
+    m = coo.shape[0]
+    indptr = jnp.searchsorted(
+        coo.rows, jnp.arange(m + 1, dtype=coo.rows.dtype)
+    ).astype(jnp.int32)
+    return CSR(indptr, coo.cols, coo.vals, coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """CSR → COO (sparse/convert/coo.cuh csr_to_coo)."""
+    nnz = csr.indices.shape[0]
+    counts = jnp.diff(csr.indptr)
+    rows = jnp.repeat(
+        jnp.arange(csr.shape[0], dtype=jnp.int32), counts,
+        total_repeat_length=nnz,
+    )
+    return COO(rows, csr.indices, csr.vals, csr.shape)
+
+
+def dense_to_coo(x) -> COO:
+    """Dense → COO. Host-side (nnz is data-dependent; XLA needs it static)."""
+    x = np.asarray(x)
+    rows, cols = np.nonzero(x)
+    return COO(
+        jnp.asarray(rows, jnp.int32),
+        jnp.asarray(cols, jnp.int32),
+        jnp.asarray(x[rows, cols]),
+        tuple(x.shape),
+    )
+
+
+def dense_to_csr(x) -> CSR:
+    return coo_to_csr(dense_to_coo(x), assume_sorted=True)
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    """COO → dense scatter (sparse/convert/dense.cuh csr_to_dense)."""
+    out = jnp.zeros(coo.shape, coo.vals.dtype)
+    return out.at[coo.rows, coo.cols].add(coo.vals)
+
+
+def from_scipy(sp) -> CSR:
+    """Interop: scipy.sparse matrix → CSR."""
+    sp = sp.tocsr()
+    return CSR(
+        jnp.asarray(sp.indptr, jnp.int32),
+        jnp.asarray(sp.indices, jnp.int32),
+        jnp.asarray(sp.data),
+        tuple(sp.shape),
+    )
+
+
+def to_scipy(mat):
+    """Interop: COO/CSR → scipy.sparse.csr_matrix (host copy)."""
+    import scipy.sparse as sps
+
+    if isinstance(mat, COO):
+        return sps.coo_matrix(
+            (np.asarray(mat.vals), (np.asarray(mat.rows), np.asarray(mat.cols))),
+            shape=mat.shape,
+        ).tocsr()
+    return sps.csr_matrix(
+        (np.asarray(mat.vals), np.asarray(mat.indices), np.asarray(mat.indptr)),
+        shape=mat.shape,
+    )

@@ -136,13 +136,36 @@ def test_hnswlib_export(index, tmp_path):
     size_links0 = deg * 4 + 4
     size_per_elem = size_links0 + dim * 4 + 8
     with open(p, "rb") as f:
-        header = f.read(8 * 5 + 4 * 2 + 8 + 8 * 4)
-        offset0, maxn, cur, spe, sl0 = struct.unpack("<5Q", header[:40])
+        # parse exactly as hnswlib HierarchicalNSW::loadIndex does
+        header = f.read(6 * 8 + 4 + 4 + 3 * 8 + 8 + 8)
+        offset0, maxn, cur, spe, label_off, off_data = struct.unpack(
+            "<6Q", header[:48]
+        )
+        maxlevel, entry = struct.unpack("<iI", header[48:56])
+        maxM, maxM0, M = struct.unpack("<3Q", header[56:80])
+        (mult,) = struct.unpack("<d", header[80:88])
+        (efc,) = struct.unpack("<Q", header[88:96])
+        assert offset0 == 0
         assert (maxn, cur) == (n, n)
-        assert spe == size_per_elem and sl0 == size_links0
-        # first element: link count == degree, then the graph row
-        first = f.read(4 + deg * 4)
-        cnt = struct.unpack("<I", first[:4])[0]
+        assert spe == size_per_elem
+        assert off_data == size_links0
+        assert label_off == size_links0 + dim * 4
+        assert maxlevel == 0 and entry == 0
+        assert maxM0 == deg and maxM == M == deg // 2
+        assert mult > 0 and efc > 0
+        # first element: link count (unsigned short in the first 2 bytes,
+        # like hnswlib getListCount), then the graph row, data, label
+        first = f.read(size_per_elem)
+        cnt = struct.unpack("<H", first[:2])[0]
         assert cnt == deg
-        row = np.frombuffer(first[4:], dtype="<u4")
+        row = np.frombuffer(first[4 : 4 + deg * 4], dtype="<u4")
         np.testing.assert_array_equal(row, np.asarray(index.graph[0]))
+        vec = np.frombuffer(first[off_data : off_data + dim * 4], "<f4")
+        np.testing.assert_allclose(vec, np.asarray(index.dataset[0]),
+                                   rtol=1e-6)
+        (label0,) = struct.unpack("<Q", first[label_off : label_off + 8])
+        assert label0 == 0
+        # level list sizes: one zero int per element
+        f.seek(0, 2)
+        end = f.tell()
+        assert end == 96 + n * size_per_elem + n * 4

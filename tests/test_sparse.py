@@ -1,0 +1,248 @@
+"""Sparse subsystem tests — scipy.sparse / scipy.sparse.csgraph oracles.
+
+Mirrors the reference's sparse test strategy (cpp/test/sparse/*.cu:
+conversion round-trips, op correctness vs dense math, distances vs dense
+engine, MST weight vs csgraph, CC vs csgraph).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+import scipy.sparse.csgraph as csgraph
+
+from raft_tpu import sparse
+from raft_tpu.sparse import COO, CSR
+
+
+def _rand_sparse(m, n, density, seed):
+    rng = np.random.default_rng(seed)
+    mat = sps.random(
+        m, n, density=density, random_state=rng, format="csr",
+        data_rvs=lambda k: rng.uniform(0.1, 1.0, k),
+    )
+    return mat
+
+
+class TestTypesConvert:
+    def test_roundtrip_dense(self):
+        x = _rand_sparse(37, 53, 0.15, 0).toarray().astype(np.float32)
+        coo = sparse.dense_to_coo(x)
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), x)
+        csr = sparse.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), x)
+        back = sparse.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(back.to_dense()), x)
+
+    def test_scipy_interop(self):
+        m = _rand_sparse(20, 30, 0.2, 1)
+        csr = sparse.from_scipy(m)
+        assert csr.nnz == m.nnz
+        back = sparse.to_scipy(csr)
+        np.testing.assert_allclose(back.toarray(), m.toarray(), rtol=1e-6)
+
+    def test_coo_sort(self):
+        rng = np.random.default_rng(2)
+        rows = rng.integers(0, 10, 50).astype(np.int32)
+        cols = rng.integers(0, 10, 50).astype(np.int32)
+        vals = rng.uniform(size=50).astype(np.float32)
+        s = sparse.coo_sort(COO(rows, cols, vals, (10, 10)))
+        r, c = np.asarray(s.rows), np.asarray(s.cols)
+        key = r.astype(np.int64) * 10 + c
+        assert (np.diff(key) >= 0).all()
+
+
+class TestOps:
+    def test_sum_duplicates(self):
+        rows = np.array([0, 0, 1, 0], np.int32)
+        cols = np.array([1, 1, 2, 1], np.int32)
+        vals = np.array([1.0, 2.0, 5.0, 4.0], np.float32)
+        out = sparse.op.sum_duplicates(COO(rows, cols, vals, (3, 3)))
+        dense = np.asarray(out.to_dense())
+        assert dense[0, 1] == 7.0 and dense[1, 2] == 5.0
+        assert out.nnz == 2
+
+    def test_symmetrize_max(self):
+        # knn-style asymmetric graph
+        coo = COO(
+            np.array([0, 1], np.int32), np.array([1, 2], np.int32),
+            np.array([3.0, 4.0], np.float32), (3, 3),
+        )
+        sym = sparse.op.symmetrize(coo, mode="max")
+        d = np.asarray(sym.to_dense())
+        assert d[0, 1] == d[1, 0] == 3.0
+        assert d[1, 2] == d[2, 1] == 4.0
+
+    def test_degree_and_remove_scalar(self):
+        x = _rand_sparse(15, 15, 0.3, 3)
+        coo = sparse.from_scipy(x)
+        deg = np.asarray(sparse.op.degree(sparse.csr_to_coo(coo)))
+        np.testing.assert_array_equal(deg, np.diff(x.indptr))
+
+    def test_row_slice(self):
+        x = _rand_sparse(20, 10, 0.3, 4)
+        csr = sparse.from_scipy(x)
+        sl = sparse.op.row_slice(csr, 5, 12)
+        np.testing.assert_allclose(
+            np.asarray(sl.to_dense()), x[5:12].toarray(), rtol=1e-6
+        )
+
+
+class TestLinalg:
+    def test_spmv_spmm(self):
+        x = _rand_sparse(40, 30, 0.2, 5)
+        csr = sparse.from_scipy(x)
+        v = np.random.default_rng(6).standard_normal(30).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.linalg.spmv(csr, v)), x @ v, rtol=1e-4,
+            atol=1e-5,
+        )
+        b = np.random.default_rng(7).standard_normal((30, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.linalg.spmm(csr, b)), x @ b, rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_transpose_add_norm(self):
+        x = _rand_sparse(25, 18, 0.25, 8)
+        csr = sparse.from_scipy(x)
+        t = sparse.linalg.transpose(csr)
+        np.testing.assert_allclose(
+            np.asarray(t.to_dense()), x.T.toarray(), rtol=1e-6
+        )
+        y = _rand_sparse(25, 18, 0.25, 9)
+        s = sparse.linalg.add(csr, sparse.from_scipy(y))
+        np.testing.assert_allclose(
+            np.asarray(s.to_dense()), (x + y).toarray(), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse.linalg.row_norm(csr, "l1")),
+            np.abs(x).sum(1).A1 if hasattr(np.abs(x).sum(1), "A1")
+            else np.asarray(np.abs(x).sum(1)).ravel(),
+            rtol=1e-6,
+        )
+
+    def test_laplacian(self):
+        x = _rand_sparse(12, 12, 0.3, 10)
+        adj = (x + x.T) * 0.5
+        adj.setdiag(0)
+        adj.eliminate_zeros()
+        csr = sparse.from_scipy(adj)
+        lap, d = sparse.linalg.laplacian(csr)
+        want = csgraph.laplacian(adj.tocsr())
+        np.testing.assert_allclose(
+            np.asarray(lap.to_dense()), want.toarray(), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestSparseDistance:
+    @pytest.mark.parametrize(
+        "metric",
+        ["sqeuclidean", "euclidean", "cosine", "l1", "linf", "canberra",
+         "inner_product", "braycurtis", "hamming"],
+    )
+    def test_vs_dense_engine(self, metric):
+        from raft_tpu.distance.pairwise import pairwise_distance as dense_pd
+
+        xs = _rand_sparse(33, 47, 0.3, 11)
+        ys = _rand_sparse(21, 47, 0.3, 12)
+        got = np.asarray(
+            sparse.distance.pairwise_distance(
+                sparse.from_scipy(xs), sparse.from_scipy(ys), metric,
+                block_rows=16,
+            )
+        )
+        want = np.asarray(
+            dense_pd(xs.toarray().astype(np.float32),
+                     ys.toarray().astype(np.float32), metric)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_knn_vs_dense(self):
+        xs = _rand_sparse(50, 31, 0.4, 13)
+        ys = _rand_sparse(80, 31, 0.4, 14)
+        d, i = sparse.neighbors.brute_force_knn(
+            sparse.from_scipy(xs), sparse.from_scipy(ys), k=5,
+            metric="sqeuclidean",
+        )
+        from sklearn.neighbors import NearestNeighbors
+
+        nn = NearestNeighbors(n_neighbors=5, metric="sqeuclidean").fit(
+            ys.toarray()
+        )
+        wd, wi = nn.kneighbors(xs.toarray())
+        np.testing.assert_allclose(np.sort(np.asarray(d), 1), np.sort(wd, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestMST:
+    def test_mst_weight_vs_csgraph(self):
+        rng = np.random.default_rng(15)
+        n = 60
+        x = rng.standard_normal((n, 3)).astype(np.float32)
+        # dense complete graph on pairwise distances
+        from scipy.spatial.distance import squareform, pdist
+
+        d = squareform(pdist(x)).astype(np.float32)
+        iu = np.triu_indices(n, 1)
+        coo = COO(
+            iu[0].astype(np.int32), iu[1].astype(np.int32),
+            d[iu].astype(np.float32), (n, n),
+        )
+        src, dst, w, colors = sparse.mst(coo)
+        want = csgraph.minimum_spanning_tree(sps.csr_matrix(np.triu(d)))
+        assert src.shape[0] == n - 1
+        np.testing.assert_allclose(w.sum(), want.sum(), rtol=1e-5)
+
+    def test_mst_forest_disconnected(self):
+        # two disjoint triangles -> 4 edges, 2 components
+        rows = np.array([0, 1, 2, 3, 4, 5], np.int32)
+        cols = np.array([1, 2, 0, 4, 5, 3], np.int32)
+        vals = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0], np.float32)
+        src, dst, w, colors = sparse.mst(COO(rows, cols, vals, (6, 6)))
+        assert src.shape[0] == 4
+        assert w.sum() == 6.0
+        ncc, labels = sparse.connected_components(COO(rows, cols, vals, (6, 6)))
+        assert ncc == 2
+        labels = np.asarray(labels)
+        assert labels[0] == labels[1] == labels[2]
+        assert labels[3] == labels[4] == labels[5]
+        assert labels[0] != labels[3]
+
+    def test_mst_ties(self):
+        # all-equal weights: any spanning tree is minimal; must not hang
+        n = 16
+        rng = np.random.default_rng(16)
+        rows, cols = np.meshgrid(np.arange(n), np.arange(n))
+        mask = rows < cols
+        coo = COO(
+            rows[mask].astype(np.int32), cols[mask].astype(np.int32),
+            np.ones(int(mask.sum()), np.float32), (n, n),
+        )
+        src, dst, w, colors = sparse.mst(coo)
+        assert src.shape[0] == n - 1
+        assert w.sum() == n - 1
+
+    def test_connect_components(self):
+        rng = np.random.default_rng(17)
+        a = rng.standard_normal((10, 4)).astype(np.float32)
+        b = rng.standard_normal((10, 4)).astype(np.float32) + 50.0
+        x = np.vstack([a, b])
+        colors = np.array([0] * 10 + [1] * 10, np.int32)
+        src, dst, w = sparse.solver.connect_components(x, colors)
+        assert len(src) >= 1
+        # every bridging edge crosses the partition
+        for s, t in zip(src, dst):
+            assert colors[s] != colors[t]
+
+
+class TestKnnGraph:
+    def test_knn_graph_degree(self):
+        rng = np.random.default_rng(18)
+        x = rng.standard_normal((40, 8)).astype(np.float32)
+        g = sparse.neighbors.knn_graph(x, k=5)
+        assert g.nnz == 40 * 5
+        rows = np.asarray(g.rows)
+        np.testing.assert_array_equal(np.bincount(rows, minlength=40),
+                                      np.full(40, 5))
+        # no self edges
+        assert (np.asarray(g.rows) != np.asarray(g.cols)).all()
