@@ -4,6 +4,7 @@ Reference layer: cpp/include/raft/cluster/ (SURVEY.md §2.8).
 """
 
 from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
 from raft_tpu.cluster.kmeans import (
     KMeansParams,
     cluster_cost,
@@ -30,4 +31,6 @@ __all__ = [
     "compute_new_centroids",
     "init_plus_plus",
     "find_k",
+    "single_linkage",
+    "SingleLinkageOutput",
 ]

@@ -100,13 +100,22 @@ def pairwise_distance(
         raise ValueError(f"feature dims differ: {x.shape} vs {y.shape}")
     m, n = x.shape[0], y.shape[0]
     if block_rows is None:
-        # ~64 MiB of densified query block
+        # ~64 MiB of densified block per side
         block_rows = max(64, min(m, (64 << 20) // max(4 * x.shape[1], 1)))
-    y_dense = densify_block(y, 0, n)
     out = []
+    # densify per block *pair* so peak dense memory is two blocks (+ the
+    # [m, n] output the API contract requires); y is re-densified per x
+    # block only when it doesn't fit a single block
+    single_y = densify_block(y, 0, n) if n <= block_rows else None
     for r0 in range(0, m, block_rows):
         r1 = min(r0 + block_rows, m)
         xb = densify_block(x, r0, r1)
-        out.append(_pairwise(xb, y_dense, int(metric), float(metric_arg),
-                             None, None))
+        row = []
+        for c0 in range(0, n, block_rows):
+            c1 = min(c0 + block_rows, n)
+            yb = single_y if single_y is not None else densify_block(y, c0, c1)
+            row.append(
+                _pairwise(xb, yb, int(metric), float(metric_arg), None, None)
+            )
+        out.append(row[0] if len(row) == 1 else jnp.concatenate(row, axis=1))
     return jnp.concatenate(out, axis=0)

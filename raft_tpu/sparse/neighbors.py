@@ -30,18 +30,52 @@ def brute_force_knn(
     """
     metric = sparse_distance.check_sparse_metric(metric)
     minim = is_min_close(metric)
-    m = x.shape[0]
+    m, n = x.shape[0], y.shape[0]
     out_d, out_i = [], []
-    yb = sparse_distance.densify_block(y, 0, y.shape[0])
+    # index side streams in blocks with a running top-k merge, so peak
+    # dense memory is one block per side regardless of index size
+    # (knn_merge_parts is the reference's detail/knn_merge_parts.cuh)
+    from raft_tpu.neighbors.common import knn_merge_parts
+
+    single_y = (
+        sparse_distance.densify_block(y, 0, n) if n <= block_rows else None
+    )
     for r0 in range(0, m, block_rows):
         r1 = min(r0 + block_rows, m)
         xb = sparse_distance.densify_block(x, r0, r1)
-        d = sparse_distance._pairwise(
-            xb, yb, int(metric), float(metric_arg), None, None
-        )
-        dd, ii = select_k(d, k, select_min=minim)
-        out_d.append(dd)
-        out_i.append(ii)
+        part_d, part_i, offsets = [], [], []
+        for c0 in range(0, n, block_rows):
+            c1 = min(c0 + block_rows, n)
+            yb = (
+                single_y if single_y is not None
+                else sparse_distance.densify_block(y, c0, c1)
+            )
+            d = sparse_distance._pairwise(
+                xb, yb, int(metric), float(metric_arg), None, None
+            )
+            dd, ii = select_k(d, min(k, c1 - c0), select_min=minim)
+            if dd.shape[1] < k:  # tiny tail block: pad to k for stacking
+                pad = k - dd.shape[1]
+                fill = jnp.inf if minim else -jnp.inf
+                dd = jnp.pad(dd, ((0, 0), (0, pad)), constant_values=fill)
+                ii = jnp.pad(ii, ((0, 0), (0, pad)), constant_values=-1)
+            part_d.append(dd)
+            part_i.append(ii)
+            offsets.append(c0)
+        if len(part_d) == 1:
+            out_d.append(part_d[0])
+            out_i.append(part_i[0])
+        else:
+            md, mi = knn_merge_parts(
+                jnp.stack(part_d), jnp.stack(part_i), k,
+                select_min=minim, translations=jnp.asarray(offsets),
+            )
+            # pad slots carry +-inf sentinels; keep their ids at -1
+            # (translations shifted the -1 pads to look like real ids)
+            sentinel = jnp.inf if minim else -jnp.inf
+            mi = jnp.where(md == sentinel, -1, mi)
+            out_d.append(md)
+            out_i.append(mi)
     return jnp.concatenate(out_d, axis=0), jnp.concatenate(out_i, axis=0)
 
 

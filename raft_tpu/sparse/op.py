@@ -53,7 +53,8 @@ def sum_duplicates(coo: COO, compress: bool = True):
     gid = jnp.cumsum(first.astype(jnp.int32)) - 1
     summed = jnp.zeros((nnz,), coo.vals.dtype).at[gid].add(coo.vals)
     # each group's sum lands on the group's first slot; the rest zero out
-    vals = jnp.where(first, summed[gid], 0.0)
+    # (typed zero: a weak 0.0 would silently promote integer vals)
+    vals = jnp.where(first, summed[gid], jnp.zeros((), coo.vals.dtype))
     out = COO(coo.rows, coo.cols, vals, coo.shape)
     if not compress:
         return out, first

@@ -1,0 +1,158 @@
+"""Spectral graph partitioning and modularity clustering
+(reference cpp/include/raft/spectral/{partition,modularity_maximization,
+eigen_solvers,cluster_solvers}.cuh — SURVEY.md §2.8 layer 11).
+
+TPU formulation: the eigen stage is the existing Lanczos solver
+(linalg/lanczos.py — full-reorth, GEMM-dominated) driven by a sparse
+Laplacian/modularity matvec (segment-sum SpMV); the cluster stage is the
+existing Lloyd kmeans on the embedding rows. This mirrors the reference's
+lanczos_solver_t + kmeans_solver_t plumbing (spectral/partition.cuh:67).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans
+from raft_tpu.linalg.lanczos import lanczos_eigsh
+from raft_tpu.sparse import linalg as sparse_linalg
+from raft_tpu.sparse.types import CSR, csr_to_coo
+
+
+def _laplacian_matvec(adj: CSR):
+    """v ↦ L v = D v - A v without materializing L
+    (spectral/matrix_wrappers.hpp laplacian_matrix_t::mv)."""
+    d = sparse_linalg.degree(adj)
+
+    def mv(v):
+        return d * v - sparse_linalg.spmv(adj, v)
+
+    return mv
+
+
+def _modularity_matvec(adj: CSR):
+    """v ↦ B v = A v - (dᵀv) d / 2m (modularity_matrix_t::mv)."""
+    coo = csr_to_coo(adj)
+    d = sparse_linalg.degree(adj)
+    two_m = jnp.maximum(jnp.sum(coo.vals), 1e-30)
+
+    def mv(v):
+        return sparse_linalg.spmv(adj, v) - d * (jnp.dot(d, v) / two_m)
+
+    return mv
+
+
+def fit_embedding(
+    adj: CSR, n_components: int, n_iters: int | None = None, seed: int = 0,
+    which: str = "smallest",
+) -> Tuple[jax.Array, jax.Array]:
+    """Spectral embedding: ``n_components`` non-trivial Laplacian
+    eigenpairs (the reference's computeSmallestEigenvectors stage).
+
+    Skips the trivial constant eigenvector (eigenvalue 0) by requesting
+    one extra pair and dropping the first. Returns (eigenvalues [k],
+    embedding [n, k]).
+    """
+    n = adj.shape[0]
+    mv = _laplacian_matvec(adj) if which == "smallest" else _modularity_matvec(adj)
+    k = n_components + 1 if which == "smallest" else n_components
+    evals, evecs = lanczos_eigsh(
+        mv, n, min(k, n), n_iters=n_iters, key=jax.random.PRNGKey(seed),
+        which=which,
+    )
+    if which == "smallest":
+        return evals[1:], evecs[:, 1:]
+    return evals, evecs
+
+
+def partition(
+    adj: CSR,
+    n_clusters: int,
+    n_eigenvecs: int | None = None,
+    n_lanczos_iters: int | None = None,
+    kmeans_max_iter: int = 100,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Spectral min-balanced-cut partition (reference
+    spectral/partition.cuh:67 ``partition``): Laplacian smallest
+    eigenvectors → kmeans on the embedding rows.
+
+    Returns (labels [n], eigenvalues [k], eigenvectors [n, k]).
+    """
+    k = n_eigenvecs or n_clusters
+    evals, embed = fit_embedding(
+        adj, k, n_iters=n_lanczos_iters, seed=seed, which="smallest"
+    )
+    # row-normalize the embedding: standard scaling for spectral kmeans
+    # (the reference scales by eigenvalue transform inside its solver)
+    norms = jnp.linalg.norm(embed, axis=1, keepdims=True)
+    embed_n = embed / jnp.maximum(norms, 1e-12)
+    params = kmeans.KMeansParams(
+        n_clusters=n_clusters, max_iter=kmeans_max_iter, seed=seed,
+        init="k-means++",
+    )
+    labels, _, _, _ = kmeans.fit_predict(params, embed_n)
+    return labels, evals, embed
+
+
+def modularity_maximization(
+    adj: CSR,
+    n_clusters: int,
+    n_eigenvecs: int | None = None,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Modularity-maximizing clustering (reference
+    spectral/modularity_maximization.cuh:62): largest eigenvectors of the
+    modularity matrix B = A - d dᵀ/2m → kmeans."""
+    k = n_eigenvecs or n_clusters
+    n = adj.shape[0]
+    mv = _modularity_matvec(adj)
+    evals, evecs = lanczos_eigsh(
+        mv, n, min(k, n), key=jax.random.PRNGKey(seed), which="largest"
+    )
+    norms = jnp.linalg.norm(evecs, axis=1, keepdims=True)
+    embed = evecs / jnp.maximum(norms, 1e-12)
+    params = kmeans.KMeansParams(n_clusters=n_clusters, seed=seed,
+                                 init="k-means++")
+    labels, _, _, _ = kmeans.fit_predict(params, embed)
+    return labels, evals, evecs
+
+
+def analyze_partition(adj: CSR, labels) -> Tuple[jax.Array, jax.Array]:
+    """Partition quality (reference spectral/partition.cuh:151
+    ``analyzePartition``): returns (edge_cut, cost = Σ_k cut_k/size_k)."""
+    coo = csr_to_coo(adj)
+    labels = jnp.asarray(labels)
+    cross = labels[coo.rows] != labels[coo.cols]
+    edge_cut = jnp.sum(jnp.where(cross, coo.vals, 0.0)) / 2.0
+    k = int(jnp.max(labels)) + 1 if labels.shape[0] else 0
+    cost = jnp.float32(0.0)
+    for c in range(k):
+        mask = labels == c
+        size = jnp.maximum(jnp.sum(mask), 1)
+        cut_c = jnp.sum(
+            jnp.where(cross & (mask[coo.rows] | mask[coo.cols]), coo.vals, 0.0)
+        ) / 2.0
+        cost = cost + cut_c / size
+    return edge_cut, cost
+
+
+def analyze_modularity(adj: CSR, labels) -> jax.Array:
+    """Modularity Q of a clustering (reference
+    spectral/modularity_maximization.cuh:94 analyzeModularity):
+    Q = (1/2m) Σ_ij [A_ij - d_i d_j / 2m] δ(c_i, c_j)."""
+    coo = csr_to_coo(adj)
+    labels = jnp.asarray(labels)
+    d = sparse_linalg.degree(adj)
+    two_m = jnp.maximum(jnp.sum(coo.vals), 1e-30)
+    same = labels[coo.rows] == labels[coo.cols]
+    a_term = jnp.sum(jnp.where(same, coo.vals, 0.0))
+    # Σ_k (Σ_{i∈k} d_i)² / 2m
+    k = int(jnp.max(labels)) + 1 if labels.shape[0] else 0
+    dk = jnp.zeros((max(k, 1),), jnp.float32).at[labels].add(d)
+    null_term = jnp.sum(dk * dk) / two_m
+    return (a_term - null_term) / two_m

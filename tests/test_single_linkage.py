@@ -1,0 +1,72 @@
+"""single_linkage tests — scipy.cluster.hierarchy / sklearn oracles
+(mirrors cpp/test/cluster/linkage.cu: known-blob labelings + dendrogram
+height parity)."""
+
+import numpy as np
+import pytest
+from scipy.cluster.hierarchy import fcluster, linkage
+from sklearn.metrics import adjusted_rand_score
+
+from raft_tpu.cluster import single_linkage
+
+
+def _blobs(n, d, k, seed, spread=0.05):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-5, 5, (k, d))
+    x = centers[rng.integers(0, k, n)] + rng.normal(0, spread, (n, d))
+    return x.astype(np.float32)
+
+
+@pytest.mark.parametrize("connectivity", ["knn", "pairwise"])
+def test_blobs_exact_labels(connectivity):
+    x = _blobs(300, 4, 5, seed=0)
+    out = single_linkage(x, n_clusters=5, metric="euclidean",
+                         connectivity=connectivity)
+    want = fcluster(linkage(x, method="single"), 5, criterion="maxclust")
+    assert adjusted_rand_score(want, out.labels) == 1.0
+
+
+def test_dendrogram_heights_match_scipy():
+    x = _blobs(120, 3, 3, seed=1, spread=0.3)
+    out = single_linkage(x, n_clusters=3, metric="euclidean",
+                         connectivity="pairwise")
+    z = linkage(x, method="single")
+    # single-linkage merge heights are unique up to ties; the sorted
+    # sequence must match scipy's third column
+    np.testing.assert_allclose(
+        np.sort(out.deltas), np.sort(z[:, 2]), rtol=1e-3
+    )
+    # sizes: final merge must cover all points
+    assert out.sizes[-1] == 120
+    assert out.children.shape == (119, 2)
+
+
+def test_knn_connectivity_disconnected_repair():
+    # two far-apart tight blobs with small k: KNN graph is disconnected,
+    # the cross-component repair must still produce a full dendrogram
+    rng = np.random.default_rng(2)
+    a = rng.normal(0, 0.1, (40, 3))
+    b = rng.normal(50, 0.1, (40, 3))
+    x = np.vstack([a, b]).astype(np.float32)
+    out = single_linkage(x, n_clusters=2, metric="euclidean",
+                         connectivity="knn", c=5)
+    labels = out.labels
+    assert len(np.unique(labels)) == 2
+    assert len(np.unique(labels[:40])) == 1
+    assert len(np.unique(labels[40:])) == 1
+    assert labels[0] != labels[40]
+
+
+def test_n_clusters_sweep():
+    x = _blobs(200, 5, 4, seed=3)
+    for k in (2, 3, 4, 8):
+        out = single_linkage(x, n_clusters=k, connectivity="knn", c=10)
+        assert len(np.unique(out.labels)) == k
+
+
+def test_sqeuclidean_metric():
+    x = _blobs(150, 4, 3, seed=4)
+    out = single_linkage(x, n_clusters=3, metric="sqeuclidean",
+                         connectivity="knn")
+    want = fcluster(linkage(x, method="single"), 3, criterion="maxclust")
+    assert adjusted_rand_score(want, out.labels) == 1.0
