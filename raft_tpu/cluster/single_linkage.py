@@ -188,17 +188,21 @@ def single_linkage(
         k = max(2, min(int(c), n - 1))
         graph = sparse_neighbors.knn_graph(x, k, metric=metric)
         sym = sparse_op.symmetrize(graph, mode="max")
-        src_d, dst_d, w_d, colors = sparse_solver.mst(sym)
+        src_d, dst_d, w_d, colors_dev = sparse_solver.mst(sym)
         src = src_d.astype(np.int64)
         dst = dst_d.astype(np.int64)
         w = w_d.astype(np.float64)
-        # repair disconnected KNN graphs (cross_component_nn loop)
-        uf = _UnionFind(n)
-        for s, t in zip(src, dst):
-            uf.union(int(s), int(t))
-        colors = np.array([uf.find(i) for i in range(n)], np.int32)
+        # repair disconnected KNN graphs (cross_component_nn loop);
+        # Borůvka's final colors give the components for free — the host
+        # union-find is only built if a repair round is actually needed
+        colors = np.asarray(colors_dev, np.int32)
+        uf = None
         guard = 0
         while np.unique(colors).size > 1 and guard < n:
+            if uf is None:
+                uf = _UnionFind(n)
+                for s, t in zip(src, dst):
+                    uf.union(int(s), int(t))
             bs, bt, bw = sparse_solver.connect_components(x, colors, metric)
             added = False
             for s, t, wt in zip(bs, bt, bw):

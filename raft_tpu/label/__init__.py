@@ -1,0 +1,69 @@
+"""Label utilities (reference cpp/include/raft/label/{classlabels,
+merge_labels}.cuh — SURVEY.md §2 layer 11).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_unique_labels(labels) -> jax.Array:
+    """Sorted distinct label values (classlabels.cuh getUniquelabels).
+    Host-compressing (count is data-dependent)."""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def get_ovr_labels(labels, target, true_val=1, false_val=0) -> jax.Array:
+    """One-vs-rest relabeling (classlabels.cuh getOvrlabels)."""
+    labels = jnp.asarray(labels)
+    return jnp.where(labels == target, true_val, false_val).astype(jnp.int32)
+
+
+def make_monotonic(labels) -> Tuple[jax.Array, jax.Array]:
+    """Map arbitrary label values onto 0..k-1 by sorted rank
+    (classlabels.cuh make_monotonic). Returns (mapped, unique_values)."""
+    labels = jnp.asarray(labels)
+    uniq = get_unique_labels(labels)
+    mapped = jnp.searchsorted(uniq, labels).astype(jnp.int32)
+    return mapped, uniq
+
+
+def merge_labels(labels_a, labels_b, mask, max_iters: int | None = None
+                 ) -> jax.Array:
+    """Merge two labelings over the same vertices
+    (merge_labels.cuh merge_labels, the DBSCAN multi-batch merge): two
+    vertices end up with the same output label iff they are connected in
+    the union relation {same label in A} ∪ {same label in B, restricted
+    to vertices where ``mask`` holds}. Output labels are the minimum
+    vertex-id of each merged group (the reference propagates min label
+    through its label-equivalence graph the same way).
+    """
+    la = jnp.asarray(labels_a).astype(jnp.int32)
+    lb = jnp.asarray(labels_b).astype(jnp.int32)
+    mask = jnp.asarray(mask).astype(bool)
+    n = la.shape[0]
+    ka = int(jnp.max(la)) + 1 if n else 1
+    kb = int(jnp.max(lb)) + 1 if n else 1
+    big = jnp.int32(n)
+
+    def body(state):
+        l, _ = state
+        # propagate min through A-groups (all vertices participate)
+        ga = jnp.full((ka,), big, jnp.int32).at[la].min(l)
+        l2 = jnp.minimum(l, ga[la])
+        # propagate min through B-groups (only mask vertices)
+        gb = jnp.full((kb,), big, jnp.int32).at[
+            jnp.where(mask, lb, kb - 1)
+        ].min(jnp.where(mask, l2, big))
+        l3 = jnp.where(mask, jnp.minimum(l2, gb[lb]), l2)
+        return l3, jnp.any(l3 != l)
+
+    l0 = jnp.arange(n, dtype=jnp.int32)
+    l, _ = jax.lax.while_loop(
+        lambda s: s[1], body, (l0, jnp.bool_(True))
+    )
+    return l

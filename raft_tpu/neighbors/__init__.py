@@ -1,10 +1,13 @@
 """Neighbors layer — the core product (SURVEY.md §2.9)."""
 
 from raft_tpu.neighbors import (
+    ball_cover,
     brute_force,
     cagra,
+    epsilon_neighborhood,
     ivf_flat,
     ivf_pq,
+    nn_descent,
     refine as _refine_mod,
 )
 from raft_tpu.neighbors.common import (
@@ -18,7 +21,10 @@ from raft_tpu.neighbors.common import (
 from raft_tpu.neighbors.refine import refine
 
 __all__ = [
+    "ball_cover",
     "brute_force",
+    "epsilon_neighborhood",
+    "nn_descent",
     "cagra",
     "ivf_flat",
     "ivf_pq",

@@ -1,0 +1,39 @@
+"""Epsilon neighborhood — dense boolean adjacency within a radius
+(reference neighbors/epsilon_neighborhood.cuh epsUnexpL2SqNeighborhood:
+tiled L2² + threshold + per-vertex degree, spatial/knn/detail/
+epsilon_neighborhood.cuh).
+
+TPU: one tiled pairwise pass (MXU for the L2 term) with the comparison
+and row-degree reduction fused by XLA into the same pass.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType, resolve_metric
+
+
+def eps_neighbors(
+    x, y, eps: float, metric="sqeuclidean"
+) -> Tuple[jax.Array, jax.Array]:
+    """Adjacency ``adj[i, j] = dist(x_i, y_j) <= eps`` and per-row degrees.
+
+    Mirrors ``epsUnexpL2SqNeighborhood(adj, vd, x, y, eps)`` — with
+    metric="sqeuclidean" and eps in squared units, exactly the reference
+    semantics; other metrics compare in their own units.
+    """
+    metric = resolve_metric(metric)
+    d = pairwise_distance(x, y, metric)
+    adj = d <= jnp.asarray(eps, d.dtype)
+    vd = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    return adj, vd
+
+
+def eps_neighbors_l2sq(x, y, eps_sq: float) -> Tuple[jax.Array, jax.Array]:
+    """Reference-named alias: squared-L2 threshold."""
+    return eps_neighbors(x, y, eps_sq, DistanceType.L2Expanded)

@@ -1,0 +1,6 @@
+"""Solvers — linear assignment (reference cpp/include/raft/solver/
+linear_assignment.cuh; legacy alias raft/lap/)."""
+
+from raft_tpu.solver.linear_assignment import LinearAssignmentProblem, solve
+
+__all__ = ["LinearAssignmentProblem", "solve"]

@@ -36,9 +36,8 @@ def _laplacian_matvec(adj: CSR):
 
 def _modularity_matvec(adj: CSR):
     """v ↦ B v = A v - (dᵀv) d / 2m (modularity_matrix_t::mv)."""
-    coo = csr_to_coo(adj)
     d = sparse_linalg.degree(adj)
-    two_m = jnp.maximum(jnp.sum(coo.vals), 1e-30)
+    two_m = jnp.maximum(jnp.sum(adj.vals), 1e-30)
 
     def mv(v):
         return sparse_linalg.spmv(adj, v) - d * (jnp.dot(d, v) / two_m)
@@ -48,25 +47,23 @@ def _modularity_matvec(adj: CSR):
 
 def fit_embedding(
     adj: CSR, n_components: int, n_iters: int | None = None, seed: int = 0,
-    which: str = "smallest",
 ) -> Tuple[jax.Array, jax.Array]:
-    """Spectral embedding: ``n_components`` non-trivial Laplacian
+    """Spectral embedding: ``n_components`` non-trivial *Laplacian*
     eigenpairs (the reference's computeSmallestEigenvectors stage).
 
     Skips the trivial constant eigenvector (eigenvalue 0) by requesting
     one extra pair and dropping the first. Returns (eigenvalues [k],
-    embedding [n, k]).
+    embedding [n, k]). (Modularity-matrix embeddings live in
+    ``modularity_maximization``, which drives the Lanczos solver with
+    its own operator.)
     """
     n = adj.shape[0]
-    mv = _laplacian_matvec(adj) if which == "smallest" else _modularity_matvec(adj)
-    k = n_components + 1 if which == "smallest" else n_components
+    k = n_components + 1
     evals, evecs = lanczos_eigsh(
-        mv, n, min(k, n), n_iters=n_iters, key=jax.random.PRNGKey(seed),
-        which=which,
+        _laplacian_matvec(adj), n, min(k, n), n_iters=n_iters,
+        key=jax.random.PRNGKey(seed), which="smallest",
     )
-    if which == "smallest":
-        return evals[1:], evecs[:, 1:]
-    return evals, evecs
+    return evals[1:], evecs[:, 1:]
 
 
 def partition(
@@ -84,9 +81,7 @@ def partition(
     Returns (labels [n], eigenvalues [k], eigenvectors [n, k]).
     """
     k = n_eigenvecs or n_clusters
-    evals, embed = fit_embedding(
-        adj, k, n_iters=n_lanczos_iters, seed=seed, which="smallest"
-    )
+    evals, embed = fit_embedding(adj, k, n_iters=n_lanczos_iters, seed=seed)
     # row-normalize the embedding: standard scaling for spectral kmeans
     # (the reference scales by eigenvalue transform inside its solver)
     norms = jnp.linalg.norm(embed, axis=1, keepdims=True)
@@ -128,16 +123,19 @@ def analyze_partition(adj: CSR, labels) -> Tuple[jax.Array, jax.Array]:
     coo = csr_to_coo(adj)
     labels = jnp.asarray(labels)
     cross = labels[coo.rows] != labels[coo.cols]
-    edge_cut = jnp.sum(jnp.where(cross, coo.vals, 0.0)) / 2.0
+    cross_w = jnp.where(cross, coo.vals, 0.0)
+    edge_cut = jnp.sum(cross_w) / 2.0
     k = int(jnp.max(labels)) + 1 if labels.shape[0] else 0
-    cost = jnp.float32(0.0)
-    for c in range(k):
-        mask = labels == c
-        size = jnp.maximum(jnp.sum(mask), 1)
-        cut_c = jnp.sum(
-            jnp.where(cross & (mask[coo.rows] | mask[coo.cols]), coo.vals, 0.0)
-        ) / 2.0
-        cost = cost + cut_c / size
+    k = max(k, 1)
+    # per-cluster cut and size in one segment-sum pass each: with both
+    # directions of every edge stored, scattering cross_w by the row
+    # endpoint's label lands each undirected cross edge's full weight on
+    # both incident clusters — exactly cut_c
+    cut_k = jnp.zeros((k,), jnp.float32).at[labels[coo.rows]].add(cross_w)
+    size_k = jnp.maximum(
+        jnp.zeros((k,), jnp.float32).at[labels].add(1.0), 1.0
+    )
+    cost = jnp.sum(cut_k / size_k)
     return edge_cut, cost
 
 

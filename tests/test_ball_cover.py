@@ -1,0 +1,88 @@
+"""Ball cover + epsilon neighborhood tests (mirrors
+cpp/test/neighbors/ball_cover.cu: exactness vs brute force on haversine
+and euclidean, eps_nn degree checks)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import ball_cover, brute_force, epsilon_neighborhood
+
+
+def _geo(n, seed):
+    """lat/lon in radians, clustered like city data."""
+    rng = np.random.default_rng(seed)
+    hubs = rng.uniform([-1.0, -2.5], [1.0, 2.5], (12, 2))
+    pts = hubs[rng.integers(0, 12, n)] + rng.normal(0, 0.02, (n, 2))
+    pts[:, 0] = np.clip(pts[:, 0], -1.4, 1.4)
+    return pts.astype(np.float32)
+
+
+def _recall(got, want):
+    return np.mean([
+        len(set(got[r]) & set(want[r])) / want.shape[1]
+        for r in range(want.shape[0])
+    ])
+
+
+class TestBallCoverEuclidean:
+    def test_exact_vs_brute_force(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5000, 3)).astype(np.float32)
+        q = rng.standard_normal((500, 3)).astype(np.float32)
+        index = ball_cover.build(x, metric="euclidean")
+        d, i = ball_cover.knn_query(index, q, k=10)
+        bd, bi = brute_force.knn(q, x, 10, metric="euclidean")
+        assert _recall(np.asarray(i), np.asarray(bi)) > 0.999
+        np.testing.assert_allclose(
+            np.sort(np.asarray(d), 1), np.sort(np.asarray(bd), 1),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_all_knn_query(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2000, 3)).astype(np.float32)
+        index = ball_cover.build(x, metric="euclidean")
+        d, i = ball_cover.all_knn_query(index, k=5)
+        # each point's own id must be its nearest neighbor (distance 0)
+        first = np.asarray(i)[:, 0]
+        np.testing.assert_array_equal(first, np.arange(2000))
+
+    def test_rejects_non_true_metric(self):
+        with pytest.raises(ValueError):
+            ball_cover.build(np.zeros((10, 2), np.float32), metric="cosine")
+
+
+class TestBallCoverHaversine:
+    def test_exact_vs_brute_force_haversine(self):
+        x = _geo(4000, seed=2)
+        q = _geo(400, seed=3)
+        index = ball_cover.build(x, metric="haversine")
+        d, i = ball_cover.knn_query(index, q, k=8)
+        bd, bi = brute_force.knn(q, x, 8, metric="haversine")
+        assert _recall(np.asarray(i), np.asarray(bi)) > 0.999
+        np.testing.assert_allclose(
+            np.sort(np.asarray(d), 1), np.sort(np.asarray(bd), 1),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestEpsilonNeighborhood:
+    def test_adjacency_vs_numpy(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((200, 5)).astype(np.float32)
+        y = rng.standard_normal((300, 5)).astype(np.float32)
+        eps_sq = 4.0
+        adj, vd = epsilon_neighborhood.eps_neighbors_l2sq(x, y, eps_sq)
+        d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        want = d <= eps_sq
+        np.testing.assert_array_equal(np.asarray(adj), want)
+        np.testing.assert_array_equal(np.asarray(vd), want.sum(1))
+
+    def test_ball_cover_eps_nn(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1000, 3)).astype(np.float32)
+        q = rng.standard_normal((100, 3)).astype(np.float32)
+        index = ball_cover.build(x, metric="euclidean")
+        adj, vd = ball_cover.eps_nn(index, q, eps=1.5)
+        d = np.sqrt(((q[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_array_equal(np.asarray(adj), d <= 1.5)

@@ -1,0 +1,84 @@
+"""LAP + label utility tests — scipy.optimize.linear_sum_assignment oracle
+(mirrors cpp/test/linear_assignment.cu and cpp/test/label/*.cu)."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from raft_tpu import label as label_utils
+from raft_tpu import solver
+
+
+class TestLAP:
+    @pytest.mark.parametrize("n", [4, 16, 64, 128])
+    def test_optimal_cost_random(self, n):
+        rng = np.random.default_rng(n)
+        cost = rng.uniform(0, 10, (n, n)).astype(np.float32)
+        assign, total = solver.solve(cost)
+        a = np.asarray(assign)
+        # valid permutation
+        assert sorted(a.tolist()) == list(range(n))
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        got = cost[np.arange(n), a].sum()
+        assert got <= opt * (1 + 1e-3) + 1e-2
+
+    def test_integer_costs_exact(self):
+        rng = np.random.default_rng(7)
+        n = 32
+        cost = rng.integers(0, 50, (n, n)).astype(np.float32)
+        assign, total = solver.solve(cost)
+        ri, ci = linear_sum_assignment(cost)
+        assert float(total) == cost[ri, ci].sum()
+
+    def test_maximize(self):
+        rng = np.random.default_rng(8)
+        n = 16
+        cost = rng.integers(0, 30, (n, n)).astype(np.float32)
+        assign, total = solver.solve(cost, maximize=True)
+        ri, ci = linear_sum_assignment(cost, maximize=True)
+        assert float(total) == cost[ri, ci].sum()
+
+    def test_object_api(self):
+        rng = np.random.default_rng(9)
+        n = 10
+        cost = rng.uniform(0, 5, (2, n, n)).astype(np.float32)
+        lap = solver.LinearAssignmentProblem(n, batchsize=2)
+        lap.solve(cost)
+        for b in range(2):
+            row = np.asarray(lap.getRowAssignmentVector(b))
+            col = np.asarray(lap.getColAssignmentVector(b))
+            assert sorted(row.tolist()) == list(range(n))
+            np.testing.assert_array_equal(col[row], np.arange(n))
+
+
+class TestLabel:
+    def test_make_monotonic(self):
+        labels = np.array([10, 3, 3, 99, 10, -5])
+        mapped, uniq = label_utils.make_monotonic(labels)
+        np.testing.assert_array_equal(np.asarray(uniq), [-5, 3, 10, 99])
+        np.testing.assert_array_equal(np.asarray(mapped), [2, 1, 1, 3, 2, 0])
+
+    def test_ovr(self):
+        labels = np.array([0, 1, 2, 1])
+        ovr = label_utils.get_ovr_labels(labels, 1)
+        np.testing.assert_array_equal(np.asarray(ovr), [0, 1, 0, 1])
+
+    def test_merge_labels_chain(self):
+        # A: {0,1} {2,3}; B: {1,2} — mask on all => one merged group + {4}
+        la = np.array([0, 0, 1, 1, 2])
+        lb = np.array([0, 1, 1, 2, 3])
+        mask = np.ones(5, bool)
+        out = np.asarray(label_utils.merge_labels(la, lb, mask))
+        assert out[0] == out[1] == out[2] == out[3]
+        assert out[4] != out[0]
+
+    def test_merge_labels_mask_blocks(self):
+        # same as above but vertex 1 and 2 masked out of B: no bridge
+        la = np.array([0, 0, 1, 1, 2])
+        lb = np.array([0, 1, 1, 2, 3])
+        mask = np.array([True, False, False, True, True])
+        out = np.asarray(label_utils.merge_labels(la, lb, mask))
+        assert out[0] == out[1]
+        assert out[2] == out[3]
+        assert out[0] != out[2]
