@@ -54,7 +54,6 @@ class KMeansBalancedParams:
 # reference constants (detail/kmeans_balanced.cuh)
 _ADJUST_CENTERS_WEIGHT = 7.0   # kAdjustCentersWeight (:61)
 _BALANCING_THRESHOLD = 0.25    # build_clusters default (:755)
-_BALANCING_PULLBACK = 2        # build_clusters default (:754)
 
 
 def _as_f32(x) -> jax.Array:
@@ -180,40 +179,52 @@ def _adjust_centers(x, labels, sizes, centers, key, n_clusters: int):
     return centers, starved.sum()
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7))
-def _balancing_em_iter(
-    x, centers, labels, sizes, key, n_clusters: int,
-    metric: int = int(DistanceType.L2Expanded),
-    compute_dtype: str = "bf16",
-):
-    """One adjust → normalize → predict → update iteration, fully jitted —
-    the loop body of the reference's balancing_em_iters
-    (detail/kmeans_balanced.cuh:618). ``labels``/``sizes`` are carried from
-    the previous iteration (pass None on the first — no adjustment then,
-    matching the reference's iter>0 guard). Order matters: adjustment
-    happens at the *start* so every iteration ends with a clean EM update
-    (adjusted centers are never returned raw)."""
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _em_loop(x, centers, key, n_iters: int, n_clusters: int, metric: int,
+             compute_dtype: str):
+    """The whole balancing EM loop as ONE compiled program: seed iteration
+    (predict + update, no adjustment — the reference's iter==0 guard),
+    then ``n_iters`` adjust → normalize → predict → update rounds under
+    ``lax.scan``. No host synchronization anywhere in the loop — on a
+    remote-tunnel device a per-iteration host readback costs more than the
+    entire fit."""
     n = x.shape[0]
     br = min(n, 1 << 16)
-    n_adjusted = jnp.int32(0)
-    if labels is not None:
-        centers, n_adjusted = _adjust_centers(
-            x, labels, sizes, centers, key, n_clusters
-        )
-    if metric in (
+    ip_like = metric in (
         int(DistanceType.InnerProduct), int(DistanceType.CosineExpanded)
-    ):
-        # the reference L2-normalizes centers every iteration for IP/Cosine
-        # (detail/kmeans_balanced.cuh:659) so the partition matches the
-        # angular probe geometry
-        norms = jnp.linalg.norm(centers, axis=1, keepdims=True)
-        centers = centers / jnp.maximum(norms, 1e-30)
-    labels = _predict_metric(x, centers, metric, br, compute_dtype)
-    sums, sizes = _update_centers(x, labels, n_clusters, br, compute_dtype)
-    new_centers = jnp.where(
-        sizes[:, None] > 0, sums / jnp.maximum(sizes, 1.0)[:, None], centers
     )
-    return new_centers, labels, sizes, n_adjusted
+
+    def normalize(centers):
+        if not ip_like:
+            return centers
+        # reference L2-normalizes centers every iteration for IP/Cosine
+        # (detail/kmeans_balanced.cuh:659)
+        norms = jnp.linalg.norm(centers, axis=1, keepdims=True)
+        return centers / jnp.maximum(norms, 1e-30)
+
+    def em_update(centers):
+        labels = _predict_metric(x, centers, metric, br, compute_dtype)
+        sums, sizes = _update_centers(x, labels, n_clusters, br, compute_dtype)
+        centers = jnp.where(
+            sizes[:, None] > 0, sums / jnp.maximum(sizes, 1.0)[:, None],
+            centers,
+        )
+        return centers, labels, sizes
+
+    centers, labels, sizes = em_update(normalize(centers))
+
+    def body(carry, kk):
+        centers, labels, sizes = carry
+        centers, n_adj = _adjust_centers(
+            x, labels, sizes, centers, kk, n_clusters
+        )
+        centers, labels, sizes = em_update(normalize(centers))
+        return (centers, labels, sizes), n_adj
+
+    (centers, labels, sizes), _ = jax.lax.scan(
+        body, (centers, labels, sizes), jax.random.split(key, n_iters)
+    )
+    return centers, labels, sizes
 
 
 def balancing_em_iters(
@@ -224,31 +235,23 @@ def balancing_em_iters(
     key,
     metric: DistanceType = DistanceType.L2Expanded,
     compute_dtype: str = "bf16",
-    labels=None,
-    sizes=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Run the balancing EM loop with the reference's pullback rule
-    (detail/kmeans_balanced.cuh:618 balancing_em_iters): every
-    ``_BALANCING_PULLBACK``-th iteration that actually adjusted centers
-    adds one extra iteration, so convergence iterations always follow the
-    last rebalancing. Bounded at 3x the requested count."""
+    """Run the balancing EM loop (detail/kmeans_balanced.cuh:618
+    balancing_em_iters).
+
+    The reference's pullback rule extends the budget while rebalancing
+    keeps firing; that needs a per-iteration device→host readback of the
+    adjustment count, which on a tunnelled TPU costs more than the whole
+    fit. Instead the loop runs a *fixed* ``n_iters + n_iters//2`` rounds
+    on device (the extra half-budget plays the pullback's role of
+    guaranteeing convergence iterations after the last reseed) as one
+    compiled program."""
     x = jnp.asarray(x)
-    balancing_counter = _BALANCING_PULLBACK
-    it, budget, hard_cap = 0, int(n_iters), max(3 * int(n_iters), int(n_iters) + 8)
-    n_adj = 0
-    while it < budget or (n_adj > 0 and it < hard_cap):
-        key, sub = jax.random.split(key)
-        centers, labels, sizes, n_adj_dev = _balancing_em_iter(
-            x, centers, labels, sizes, sub, n_clusters, int(metric),
-            compute_dtype,
-        )
-        n_adj = int(n_adj_dev)
-        if it > 0 and n_adj > 0 and budget < hard_cap:
-            balancing_counter += 1
-            if balancing_counter >= _BALANCING_PULLBACK:
-                balancing_counter -= _BALANCING_PULLBACK
-                budget += 1
-        it += 1
+    rounds = max(int(n_iters) + int(n_iters) // 2, 1)
+    centers, labels, sizes = _em_loop(
+        x, _as_f32(centers), key, rounds, int(n_clusters), int(metric),
+        compute_dtype,
+    )
     return centers, sizes
 
 
@@ -353,25 +356,32 @@ def build_hierarchical(
     meso_sizes = np.bincount(meso_labels, minlength=n_meso)
     fine_counts = _arrange_fine_clusters(n_clusters, n_meso, meso_sizes)
 
-    # --- fine init: fixed-size subsample per mesocluster -----------------
+    # --- fine init: fixed-size subsample per mesocluster, ALL fine fits
+    # batched into one compiled program (build_clusters_batched) — the
+    # per-meso host loop of separate fits costs one dispatch round-trip
+    # per mesocluster, which dominates on a tunnelled device ------------
     c_max = int(fine_counts.max())
     S = max(32 * c_max, 256)  # one shared shape for all fine fits
-    fine_centers = []
-    for m in range(n_meso):
-        c = int(fine_counts[m])
-        if c == 0:
-            continue
+    active = [m for m in range(n_meso) if fine_counts[m] > 0]
+    rows_all = np.empty((len(active), S, d), np.float32)
+    for bi, m in enumerate(active):
         members = np.nonzero(meso_labels == m)[0]
         if members.size == 0:
-            fine_centers.append(x_np[rng.choice(n, c, replace=n < c)])
-            continue
-        rows = x_np[sel[rng.choice(members, S, replace=members.size < S)]]
-        key, sub = jax.random.split(key)
-        # few iterations — this is only an init for the balancing phase
-        centers_m, _ = build_clusters(rows, c_max, 4, sub, metric,
-                                      compute_dtype=compute_dtype)
-        fine_centers.append(np.asarray(centers_m[:c]))
-    centers = jnp.asarray(np.concatenate(fine_centers, axis=0))
+            rows_all[bi] = x_np[rng.choice(n, S, replace=n < S)]
+        else:
+            rows_all[bi] = x_np[
+                sel[rng.choice(members, S, replace=members.size < S)]
+            ]
+    key, sub = jax.random.split(key)
+    # few iterations — this is only an init for the balancing phase
+    books = build_clusters_batched(
+        jnp.asarray(rows_all), c_max, 4, sub, int(metric)
+    )
+    books_np = np.asarray(books)                      # [B, c_max, d]
+    centers = jnp.asarray(np.concatenate(
+        [books_np[bi, : int(fine_counts[m])] for bi, m in enumerate(active)],
+        axis=0,
+    ))
     assert centers.shape[0] == n_clusters
 
     # --- full-dataset balancing EM (the real training) -------------------
@@ -412,18 +422,25 @@ def fit_predict(params: KMeansBalancedParams, x):
     return centers, predict(params, centers, x)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def build_clusters_batched(xs, n_clusters: int, n_iters: int, key):
+@functools.partial(jax.jit, static_argnums=(1, 2, 4))
+def build_clusters_batched(xs, n_clusters: int, n_iters: int, key,
+                           metric: int = int(DistanceType.L2Expanded)):
     """Train B independent codebooks in one compiled program — the batched
     replacement for the reference's per-subspace / per-cluster
     ``build_clusters`` loops (detail/ivf_pq_build.cuh:395 train_per_subset,
-    :472 train_per_cluster, which launch one trainer per book).
+    :472 train_per_cluster, which launch one trainer per book) and for the
+    hierarchical trainer's per-mesocluster fine fits.
 
     ``xs`` [B, n, d] -> centers [B, K, d]. Sequential scan over B (one
     compile, bounded memory); each book runs ``n_iters`` Lloyd iterations
-    with starved-cluster reseeding from random rows.
+    with starved-cluster reseeding from random rows. IP/Cosine metrics
+    assign by max dot with per-iteration center normalization (matching
+    build_clusters' angular geometry).
     """
     B, n, d = xs.shape
+    ip_like = metric in (
+        int(DistanceType.InnerProduct), int(DistanceType.CosineExpanded)
+    )
 
     def one_book(_, inp):
         x, key = inp
@@ -432,10 +449,16 @@ def build_clusters_batched(xs, n_clusters: int, n_iters: int, key):
         centers = x[idx]
 
         def iter_body(centers, kk):
-            cn2 = jnp.sum(centers * centers, axis=1)
+            if ip_like:
+                cnorm = jnp.linalg.norm(centers, axis=1, keepdims=True)
+                centers = centers / jnp.maximum(cnorm, 1e-30)
             dots = jnp.dot(x, centers.T, preferred_element_type=jnp.float32,
                            precision=jax.lax.Precision.HIGH)
-            labels = jnp.argmin(cn2[None, :] - 2.0 * dots, axis=1)
+            if ip_like:
+                labels = jnp.argmax(dots, axis=1)
+            else:
+                cn2 = jnp.sum(centers * centers, axis=1)
+                labels = jnp.argmin(cn2[None, :] - 2.0 * dots, axis=1)
             one_hot = (
                 labels[:, None] == jnp.arange(n_clusters)[None, :]
             ).astype(jnp.float32)

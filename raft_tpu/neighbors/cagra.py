@@ -127,7 +127,7 @@ def build_knn_graph(
     intermediate_degree: int,
     metric: DistanceType,
     refine_rate: float = 2.0,
-    query_batch: int = 8192,
+    query_batch: int = 16384,
 ) -> jax.Array:
     """Raw KNN graph via IVF-PQ self-search + exact refine (reference
     detail/cagra/cagra_build.cuh:43; params heuristic :60-68; batch loop
@@ -176,39 +176,53 @@ def build_knn_graph(
     return graph.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _detour_counts(graph, chunk: int):
-    """Detour count per edge (reference kern_prune, graph_core.cuh:128).
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _detour_counts_block(graph, start, rows: int, chunk: int):
+    """Detour counts for node range [start, start+rows) (reference
+    kern_prune, graph_core.cuh:128).
 
     For node A with rank-sorted neighbors N: count[kAB] = #{kAD < kAB :
-    N[kAB] in graph[N[kAD]]}. Membership via per-row sorted adjacency +
-    searchsorted; scanned over node chunks."""
+    N[kAB] in graph[N[kAD]]}. Membership is a vectorized D³ equality
+    compare per chunk (the VPU chews through it; a binary search lowers
+    to a serial gather loop on TPU and is ~100x slower)."""
     n, D = graph.shape
+    gb = jax.lax.dynamic_slice(graph, (start, 0), (rows, D))
+    tri = jnp.arange(D)[:, None] < jnp.arange(D)[None, :]  # kAD < kAB
 
     def one_chunk(_, g_chunk):                # [chunk, D]
         nbrs = graph[g_chunk]                 # [chunk, D, D] two-hop lists
-        th_sorted = jnp.sort(nbrs, axis=2)    # sorted per (node, kAD)
-        # pos[c, kAD, kAB] = insertion slot of N[kAB] in sorted 2-hop row
-        tgt = g_chunk[:, None, :]             # [chunk, 1, D] broadcast kAD
-        pos = jax.vmap(
-            jax.vmap(jnp.searchsorted, in_axes=(0, None)), in_axes=(0, 0)
-        )(th_sorted, g_chunk)                 # [chunk, D(kAD), D(kAB)]
-        found = (
-            jnp.take_along_axis(th_sorted, jnp.minimum(pos, D - 1), axis=2)
-            == tgt
+        # found[c, kAD, kAB] = N[kAB] ∈ graph[N[kAD]]
+        found = jnp.any(
+            nbrs[:, :, :, None] == g_chunk[:, None, None, :], axis=2
         )
-        tri = (
-            jnp.arange(D)[:, None] < jnp.arange(D)[None, :]
-        )                                     # kAD < kAB
         counts = jnp.sum(found & tri[None, :, :], axis=1)  # [chunk, D]
         return None, counts.astype(jnp.int32)
 
-    npad = -(-n // chunk) * chunk
-    gp = jnp.pad(graph, ((0, npad - n), (0, 0)))
+    npad = -(-rows // chunk) * chunk
+    gp = jnp.pad(gb, ((0, npad - rows), (0, 0)))
     _, counts = jax.lax.scan(
         one_chunk, None, gp.reshape(npad // chunk, chunk, D)
     )
-    return counts.reshape(npad, D)[:n]
+    return counts.reshape(npad, D)[:rows]
+
+
+def _detour_counts(graph, chunk: int, nodes_per_call: int = 1 << 16):
+    """Host-blocked detour counts: one device dispatch per
+    ``nodes_per_call`` node range. A single program covering a large graph
+    runs minutes on-device, which trips the remote platform's execution
+    watchdog (observed: programs > ~2 min kill the TPU worker) — and
+    bounded dispatches also keep the scan transients small."""
+    graph = jnp.asarray(graph)
+    n, _ = graph.shape
+    if n <= nodes_per_call:
+        return _detour_counts_block(graph, jnp.int32(0), n, chunk)
+    parts = [
+        _detour_counts_block(
+            graph, jnp.int32(s), min(nodes_per_call, n - s), chunk
+        )
+        for s in range(0, n, nodes_per_call)
+    ]
+    return jnp.concatenate(parts, axis=0)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -233,22 +247,42 @@ def _optimize_impl(graph, counts, degree: int, protected: int):
     # 3. splice reverse edges after the protected prefix
     #    (graph_core.cuh:520-546): final = protected originals, then
     #    reverse edges, then surviving unprotected originals — duplicates
-    #    (vs the protected prefix or earlier candidates) dropped
+    #    (vs the protected prefix or earlier candidates) dropped.
+    #    Chunked over nodes: the [chunk, L, L] dedup masks are the peak
+    #    transient (unchunked at n=300k they are ~3 GB each and OOM a v5e
+    #    alongside the rest of the build's live buffers).
     prot = pruned[:, :protected]
-    cand = jnp.concatenate([rev, pruned[:, protected:]], axis=1)  # [n, L]
-    L = cand.shape[1]
-    dup_prot = jnp.any(cand[:, :, None] == prot[:, None, :], axis=2)
-    earlier = (cand[:, :, None] == cand[:, None, :]) & (
-        jnp.arange(L)[None, :] < jnp.arange(L)[:, None]
-    )[None, :, :]
-    dup_earlier = jnp.any(earlier, axis=2)
-    bad = dup_prot | dup_earlier | (cand < 0)
-    # stable-compact the good candidates to the front
-    rank = jnp.argsort(bad.astype(jnp.int32), axis=1, stable=True)
-    cand = jnp.take_along_axis(cand, rank[:, : degree - protected], axis=1)
-    # any remaining -1 (degenerate tiny graphs) falls back to originals
-    tail = pruned[:, protected:]
-    cand = jnp.where(cand >= 0, cand, tail)
+    cand_full = jnp.concatenate([rev, pruned[:, protected:]], axis=1)  # [n, L]
+    L = cand_full.shape[1]
+    tri = (jnp.arange(L)[None, :] < jnp.arange(L)[:, None])[None, :, :]
+
+    def splice_chunk(inp):
+        cand, pr, tail = inp                               # [c, L], [c, P]
+        dup_prot = jnp.any(cand[:, :, None] == pr[:, None, :], axis=2)
+        dup_earlier = jnp.any(
+            (cand[:, :, None] == cand[:, None, :]) & tri, axis=2
+        )
+        bad = dup_prot | dup_earlier | (cand < 0)
+        # stable-compact the good candidates to the front
+        rank = jnp.argsort(bad.astype(jnp.int32), axis=1, stable=True)
+        kept = jnp.take_along_axis(cand, rank[:, : degree - protected], axis=1)
+        # any remaining -1 (degenerate tiny graphs) falls back to originals
+        return jnp.where(kept >= 0, kept, tail)
+
+    chunk = 1 << 14
+    tail_full = pruned[:, protected:]
+    if n <= chunk:
+        cand = splice_chunk((cand_full, prot, tail_full))
+    else:
+        npad = -(-n // chunk) * chunk
+        pad = lambda a: jnp.pad(a, ((0, npad - n), (0, 0)))
+        out = jax.lax.map(
+            splice_chunk,
+            (pad(cand_full).reshape(npad // chunk, chunk, L),
+             pad(prot).reshape(npad // chunk, chunk, protected),
+             pad(tail_full).reshape(npad // chunk, chunk, degree - protected)),
+        )
+        cand = out.reshape(npad, degree - protected)[:n]
     return jnp.concatenate([prot, cand], axis=1)
 
 

@@ -243,17 +243,30 @@ def _auto_pq_dim(dim: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
-def _encode_subspace(residuals, pq_centers, K: int):
-    """codes[n, p] = argmin_j ||residuals[n,p,:] - pq_centers[p,j,:]||^2."""
-    dots = jnp.einsum(
-        "npl,pkl->npk", residuals, pq_centers,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    rn = jnp.sum(residuals * residuals, axis=2)[:, :, None]
+def _encode_subspace(residuals, pq_centers, K: int, block: int = 1 << 14):
+    """codes[n, p] = argmin_j ||residuals[n,p,:] - pq_centers[p,j,:]||^2.
+
+    Row-blocked under ``lax.map`` so the [block, p, K] distance tensor is
+    the peak transient — unblocked, n=1M × p=64 × K=256 is a 65 GB
+    intermediate (this crashed a v5e at CAGRA-build scale)."""
+    n, p, plen = residuals.shape
     cn = jnp.sum(pq_centers * pq_centers, axis=2)[None, :, :]
-    d = rn - 2.0 * dots + cn
-    return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+    def one_block(res_b):
+        dots = jnp.einsum(
+            "npl,pkl->npk", res_b, pq_centers,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rn = jnp.sum(res_b * res_b, axis=2)[:, :, None]
+        return jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
+
+    if n <= block:
+        return one_block(residuals)
+    npad = -(-n // block) * block
+    res_p = jnp.pad(residuals, ((0, npad - n), (0, 0), (0, 0)))
+    out = jax.lax.map(one_block, res_p.reshape(npad // block, block, p, plen))
+    return out.reshape(npad, p)[:n]
 
 
 def _decode_gather(codes, pq_centers, codebook_kind: int, list_ids=None):
@@ -439,16 +452,38 @@ def encode(index: Index, vectors) -> Tuple[jax.Array, jax.Array]:
     if index.codebook_kind == codebook_gen.PER_SUBSPACE:
         codes = _encode_subspace(res, index.pq_centers, index.pq_book_size)
     else:
-        books = index.pq_centers[labels]  # [n, K, len]
+        codes = _encode_per_cluster(res, labels, index.pq_centers)
+    return labels, pack_codes(codes, index.pq_bits)
+
+
+def _encode_per_cluster(res, labels, pq_centers, block: int = 1 << 14):
+    """PER_CLUSTER encode, row-blocked like _encode_subspace (the book
+    gather [n, K, len] plus the [n, p, K] distances OOM unblocked)."""
+    n, p, plen = res.shape
+
+    def one_block(inp):
+        res_b, lab_b = inp
+        books = pq_centers[lab_b]  # [block, K, len]
         dots = jnp.einsum(
-            "npl,nkl->npk", res, books,
+            "npl,nkl->npk", res_b, books,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-        rn = jnp.sum(res * res, axis=2)[:, :, None]
+        rn = jnp.sum(res_b * res_b, axis=2)[:, :, None]
         cn = jnp.sum(books * books, axis=2)[:, None, :]
-        codes = jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
-    return labels, pack_codes(codes, index.pq_bits)
+        return jnp.argmin(rn - 2.0 * dots + cn, axis=2).astype(jnp.uint8)
+
+    if n <= block:
+        return one_block((res, labels))
+    npad = -(-n // block) * block
+    res_p = jnp.pad(res, ((0, npad - n), (0, 0), (0, 0)))
+    lab_p = jnp.pad(labels, (0, npad - n))
+    out = jax.lax.map(
+        one_block,
+        (res_p.reshape(npad // block, block, p, plen),
+         lab_p.reshape(npad // block, block)),
+    )
+    return out.reshape(npad, p)[:n]
 
 
 def extend(index: Index, new_vectors, new_ids=None) -> Index:

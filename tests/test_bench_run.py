@@ -1,0 +1,61 @@
+"""Bench orchestration smoke test (config-driven runner, groundtruth
+cache, CSV + plot export — raft-ann-bench analog)."""
+
+import json
+import os
+
+import numpy as np
+
+from raft_tpu.bench import run as bench_run
+
+
+def test_smoke_config(tmp_path):
+    cfg = json.load(open("raft_tpu/bench/conf/smoke.json"))
+    cfg["dataset"]["synthetic"]["n"] = 5000
+    cfg["dataset"]["synthetic"]["n_queries"] = 100
+    results = bench_run.run_config(cfg, iters=2)
+    assert len(results) == 3  # bf + 2 ivf search param sets
+    bf = results[0]
+    assert bf.recall > 0.999  # exact method
+    assert all(r.qps > 0 for r in results)
+    # ivf recall grows with n_probes
+    assert results[2].recall >= results[1].recall - 1e-6
+    # exports
+    from raft_tpu.bench.harness import export_csv
+
+    csv_path = str(tmp_path / "out.csv")
+    export_csv(results, csv_path)
+    assert os.path.getsize(csv_path) > 0
+    png = str(tmp_path / "out.png")
+    bench_run.plot_results(results, png)
+    assert os.path.getsize(png) > 0
+
+
+def test_groundtruth_cache(tmp_path):
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((2000, 16)).astype(np.float32)
+    q = rng.standard_normal((50, 16)).astype(np.float32)
+    cache = str(tmp_path / "gt")
+    cfg = {"distance": "sqeuclidean", "groundtruth_cache": cache}
+    gt1 = bench_run.get_groundtruth(cfg, base, q, 10)
+    assert os.path.exists(cache + ".neighbors.ibin")
+    gt2 = bench_run.get_groundtruth(cfg, base, q, 10)
+    np.testing.assert_array_equal(gt1, gt2)
+    # oracle: exact
+    d = ((q[:, None, :] - base[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d, 1)[:, :10]
+    overlap = np.mean([
+        len(set(gt1[i]) & set(want[i])) / 10 for i in range(50)
+    ])
+    assert overlap > 0.99
+
+
+def test_chunked_groundtruth():
+    rng = np.random.default_rng(1)
+    base = rng.standard_normal((3000, 8)).astype(np.float32)
+    q = rng.standard_normal((40, 8)).astype(np.float32)
+    gt = bench_run.generate_groundtruth(base, q, 5, "sqeuclidean", chunk=1000)
+    d = ((q[:, None, :] - base[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d, 1)[:, :5]
+    overlap = np.mean([len(set(gt[i]) & set(want[i])) / 5 for i in range(40)])
+    assert overlap > 0.99
