@@ -371,11 +371,18 @@ def _beam_search(
         return data_norms[ids] - 2.0 * dots    # ||q||^2 constant: dropped
 
     # --- seed: random_pickup (search_single_cta_kernel-inl.cuh:585) ------
+    # score more random candidates than the buffer holds (the reference's
+    # num_pickup oversampling): wider basin coverage costs one extra
+    # gather+GEMM and rescues clustered datasets where few random nodes
+    # land near the query's region
+    n_seeds = max(2 * itopk, 128)
     seeds = (
         (jnp.arange(m, dtype=jnp.uint32)[:, None] * jnp.uint32(2654435761)
-         + jnp.arange(itopk, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
+         + jnp.arange(n_seeds, dtype=jnp.uint32)[None, :]
+         * jnp.uint32(40503)
+         + jnp.uint32(0x128394))
         % jnp.uint32(n)
-    ).astype(jnp.int32)                        # [m, itopk]
+    ).astype(jnp.int32)                        # [m, n_seeds]
     seed_d = score(seeds)
     # dedup seeds (same trick as the loop): sort by id, kill repeats
     sd_i, sd_d = _dedup_by_id(seeds, seed_d)

@@ -242,7 +242,7 @@ def _auto_pq_dim(dim: int) -> int:
     return min(v, dim)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@functools.partial(jax.jit, static_argnums=(2, 3))
 def _encode_subspace(residuals, pq_centers, K: int, block: int = 1 << 14):
     """codes[n, p] = argmin_j ||residuals[n,p,:] - pq_centers[p,j,:]||^2.
 
