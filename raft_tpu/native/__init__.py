@@ -1,0 +1,148 @@
+"""ctypes bindings for the native IO runtime (raft_tpu_native.cpp).
+
+Compiled on first use with g++ (-O3 -shared -fPIC -pthread) into this
+directory, keyed on source mtime; every entry point has a numpy fallback
+so the package works without a toolchain. pybind11 is deliberately not
+used (not in the image) — the C ABI + ctypes is the whole interface.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "raft_tpu_native.cpp")
+_SO = os.path.join(_DIR, "libraft_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if stale/absent) the native library, or None."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        try:
+            stale = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                return None
+            lib = ctypes.CDLL(_SO)
+            lib.rt_read_block.restype = ctypes.c_long
+            lib.rt_read_block.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p
+            ]
+            lib.rt_prefetch_open.restype = ctypes.c_void_p
+            lib.rt_prefetch_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+                ctypes.c_int,
+            ]
+            lib.rt_prefetch_next.restype = ctypes.c_long
+            lib.rt_prefetch_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long
+            ]
+            lib.rt_prefetch_close.restype = None
+            lib.rt_prefetch_close.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def read_block(path: str, offset: int, nbytes: int) -> np.ndarray:
+    """Positioned binary read → uint8 array (native; numpy fallback)."""
+    lib = get_lib()
+    out = np.empty(nbytes, np.uint8)
+    if lib is not None:
+        got = lib.rt_read_block(
+            path.encode(), offset, nbytes,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        if got < 0:
+            raise IOError(f"native read failed: {path}")
+        return out[:got]
+    with open(path, "rb") as fp:
+        fp.seek(offset)
+        data = fp.read(nbytes)
+    out[: len(data)] = np.frombuffer(data, np.uint8)
+    return out[: len(data)]
+
+
+class FilePrefetcher:
+    """Double-buffered streaming reads of [offset, offset+total_bytes) in
+    ``block_bytes`` chunks — a reader thread keeps ``depth`` blocks ahead
+    of the consumer (the host half of the reference's
+    batch_load_iterator pipeline, ann_utils.cuh:397).
+    """
+
+    def __init__(self, path: str, offset: int, block_bytes: int,
+                 total_bytes: int, depth: int = 2):
+        self.path = path
+        self.offset = int(offset)
+        self.block_bytes = int(block_bytes)
+        self.total_bytes = int(total_bytes)
+        self.depth = int(depth)
+        self._lib = get_lib()
+        self._handle = None
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        if self._lib is None:
+            # numpy fallback: plain sequential reads
+            pos, end = self.offset, self.offset + self.total_bytes
+            with open(self.path, "rb") as fp:
+                fp.seek(pos)
+                while pos < end:
+                    want = min(self.block_bytes, end - pos)
+                    data = fp.read(want)
+                    if not data:
+                        return
+                    pos += len(data)
+                    yield np.frombuffer(data, np.uint8)
+            return
+        handle = self._lib.rt_prefetch_open(
+            self.path.encode(), self.offset, self.block_bytes,
+            self.total_bytes, self.depth,
+        )
+        if not handle:
+            raise IOError(f"prefetch_open failed: {self.path}")
+        buf = np.empty(self.block_bytes, np.uint8)
+        try:
+            while True:
+                got = self._lib.rt_prefetch_next(
+                    handle, buf.ctypes.data_as(ctypes.c_void_p),
+                    self.block_bytes,
+                )
+                if got < 0:
+                    raise IOError(f"prefetch read failed: {self.path}")
+                if got == 0:
+                    return
+                yield buf[:got].copy()
+        finally:
+            self._lib.rt_prefetch_close(handle)

@@ -1,0 +1,126 @@
+// Native IO runtime for raft_tpu — the TPU-host analog of the reference's
+// native data-loading path (cpp/bench/ann/src/common/dataset.hpp mmap+read
+// loaders and the batch_load_iterator host side,
+// cpp/include/raft/spatial/knn/detail/ann_utils.cuh:397).
+//
+// Python drives the device; this layer keeps the *disk* side off the
+// interpreter: positioned block reads and a double-buffered reader thread
+// that prefetches ahead of consumption, so streaming index builds overlap
+// file IO with TPU work instead of stalling on synchronous memmap page
+// faults. Exposed through ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -pthread (see native/__init__.py).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Positioned read: returns bytes read, or -1 on error.
+long rt_read_block(const char* path, long offset, long nbytes, void* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (std::fseek(f, offset, SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  size_t got = std::fread(out, 1, (size_t)nbytes, f);
+  std::fclose(f);
+  return (long)got;
+}
+
+struct Prefetcher {
+  FILE* f = nullptr;
+  long block_bytes = 0;
+  long remaining = 0;
+  int depth = 2;
+  bool eof = false;
+  bool error = false;
+  bool stop = false;
+  std::deque<std::vector<uint8_t>> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits: a block is ready
+  std::condition_variable cv_space;   // reader waits: ring has space
+  std::thread worker;
+
+  void run() {
+    for (;;) {
+      std::vector<uint8_t> buf;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] { return stop || (long)ready.size() < depth; });
+        if (stop || remaining <= 0) break;
+      }
+      long want = block_bytes < remaining ? block_bytes : remaining;
+      buf.resize((size_t)want);
+      size_t got = std::fread(buf.data(), 1, (size_t)want, f);
+      std::unique_lock<std::mutex> lk(mu);
+      if ((long)got != want) error = true;
+      buf.resize(got);
+      remaining -= (long)got;
+      if (remaining <= 0 || got == 0) eof = true;
+      if (got > 0) ready.emplace_back(std::move(buf));
+      cv_ready.notify_one();
+      if (eof || error) break;
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    eof = true;
+    cv_ready.notify_all();
+  }
+};
+
+// Open a streaming window [offset, offset+total_bytes) read in
+// block_bytes chunks with `depth` blocks of read-ahead.
+void* rt_prefetch_open(const char* path, long offset, long block_bytes,
+                       long total_bytes, int depth) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  if (std::fseek(f, offset, SEEK_SET) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* p = new Prefetcher();
+  p->f = f;
+  p->block_bytes = block_bytes;
+  p->remaining = total_bytes;
+  p->depth = depth > 1 ? depth : 1;
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Copy the next block into out (capacity out_cap). Returns bytes copied,
+// 0 at end of stream, -1 on error.
+long rt_prefetch_next(void* handle, void* out, long out_cap) {
+  auto* p = (Prefetcher*)handle;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_ready.wait(lk, [&] { return !p->ready.empty() || p->eof || p->error; });
+  if (p->ready.empty()) return p->error ? -1 : 0;
+  std::vector<uint8_t> buf = std::move(p->ready.front());
+  p->ready.pop_front();
+  p->cv_space.notify_one();
+  lk.unlock();
+  long n = (long)buf.size();
+  if (n > out_cap) return -1;
+  std::memcpy(out, buf.data(), (size_t)n);
+  return n;
+}
+
+void rt_prefetch_close(void* handle) {
+  auto* p = (Prefetcher*)handle;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_space.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  if (p->f) std::fclose(p->f);
+  delete p;
+}
+
+}  // extern "C"

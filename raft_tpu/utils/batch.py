@@ -59,3 +59,64 @@ class BatchLoadIterator:
             pending = (start, dev)
         if pending is not None:
             yield pending
+
+
+class FileBatchLoadIterator:
+    """Stream device-resident row batches straight from a big-ann ``*.bin``
+    file (8-byte [n, d] uint32 header) without ever materializing the host
+    array — the full analog of the reference's file-backed
+    batch_load_iterator (ann_utils.cuh:397): a native double-buffered
+    reader thread (raft_tpu.native.FilePrefetcher) keeps disk IO ahead of
+    the device transfers.
+
+    Yields ``(offset_rows, device_batch)``; the final batch is zero-padded
+    to ``batch_rows`` when ``pad_to_full`` (one XLA shape for all batches).
+    """
+
+    def __init__(self, path: str, batch_rows: int, dtype=None,
+                 device=None, pad_to_full: bool = False, depth: int = 2):
+        from raft_tpu.bench.datasets import _dtype_for
+
+        self.path = path
+        self.dtype = _dtype_for(path, dtype)
+        header = np.fromfile(path, dtype=np.uint32, count=2)
+        self.n, self.d = int(header[0]), int(header[1])
+        self.batch_rows = int(batch_rows)
+        self.device = device
+        self.pad_to_full = pad_to_full
+        self.depth = depth
+
+    @property
+    def shape(self):
+        return (self.n, self.d)
+
+    def __len__(self) -> int:
+        return -(-self.n // self.batch_rows)
+
+    def __iter__(self):
+        from raft_tpu.native import FilePrefetcher
+
+        row_bytes = self.d * self.dtype.itemsize
+        pf = FilePrefetcher(
+            self.path, offset=8, block_bytes=self.batch_rows * row_bytes,
+            total_bytes=self.n * row_bytes, depth=self.depth,
+        )
+        offset = 0
+        pending = None
+        for raw in pf:
+            rows = raw.size // row_bytes
+            chunk = raw[: rows * row_bytes].view(self.dtype).reshape(
+                rows, self.d
+            )
+            if self.pad_to_full and rows < self.batch_rows:
+                pad = np.zeros(
+                    (self.batch_rows - rows, self.d), self.dtype
+                )
+                chunk = np.concatenate([chunk, pad], axis=0)
+            dev = jax.device_put(chunk, self.device)
+            if pending is not None:
+                yield pending
+            pending = (offset, dev)
+            offset += rows
+        if pending is not None:
+            yield pending
