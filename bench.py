@@ -72,7 +72,8 @@ def bench_bruteforce_sift10k(results):
     x = jax.device_put(_sift_like(n, d, seed=1))
     q = jax.device_put(_sift_like(nq, d, seed=2))
     index = brute_force.build(x, "sqeuclidean")
-    s = scan_qps_time(lambda qq: brute_force.search(index, qq, k), q)
+    s = scan_qps_time(lambda qq, ix: brute_force.search(ix, qq, k), q,
+                      operands=index)
     results["bruteforce_sift10k_qps"] = round(nq / s, 1)
 
 
@@ -84,9 +85,9 @@ def bench_pairwise(results):
     x = jax.device_put(_sift_like(n, d, seed=1))
     q = jax.device_put(_sift_like(n, d, seed=2))
     s = scan_qps_time(
-        lambda qq: (pairwise_distance(qq, x, "sqeuclidean"),
-                    jax.numpy.zeros((1,), jax.numpy.int32)),
-        q,
+        lambda qq, xx: (pairwise_distance(qq, xx, "sqeuclidean"),
+                        jax.numpy.zeros((1,), jax.numpy.int32)),
+        q, operands=x,
     )
     bytes_moved = n * d * 4 * 2 + n * n * 4
     results["pairwise_l2_gbps"] = round(bytes_moved / s / 1e9, 1)
@@ -112,7 +113,8 @@ def bench_ivfflat_sift1m(results):
     sub = 1000
     _, bf_idx = brute_force.knn(q[:sub], x, k)
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(bf_idx))
-    s = scan_qps_time(lambda qq: ivf_flat.search(sp, index, qq, k), q)
+    s = scan_qps_time(lambda qq, ix: ivf_flat.search(sp, ix, qq, k), q,
+                      operands=index)
     results["ivfflat_sift1m_qps"] = round(nq / s, 1)
     results["ivfflat_recall"] = round(float(recall), 3)
 
@@ -136,7 +138,8 @@ def bench_cagra_sift1m(results):
     sub = 1000
     _, bf_idx = brute_force.knn(q[:sub], x, k)
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(bf_idx))
-    s = scan_qps_time(lambda qq: cagra.search(sp, index, qq, k), q)
+    s = scan_qps_time(lambda qq, ix: cagra.search(sp, ix, qq, k), q,
+                      operands=index)
     results["cagra_sift1m_qps"] = round(nq / s, 1)
     results["cagra_recall"] = round(float(recall), 3)
 
@@ -165,7 +168,8 @@ def bench_ivfpq_deep10m(results):
         x, np.asarray(q[:sub]), k, "sqeuclidean", chunk=2_000_000
     )
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(mi))
-    s = scan_qps_time(lambda qq: ivf_pq.search(sp, index, qq, k), q)
+    s = scan_qps_time(lambda qq, ix: ivf_pq.search(sp, ix, qq, k), q,
+                      operands=index)
     results["ivfpq_deep10m_qps"] = round(nq / s, 1)
     results["ivfpq_recall"] = round(float(recall), 3)
 

@@ -79,8 +79,10 @@ def time_fn(fn: Callable[[], Any], iters: int = 10, warmup: int = 2) -> float:
     return (time.perf_counter() - t0) / iters
 
 
-def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13) -> float:
-    """Trustworthy per-iteration seconds of ``search_step(q) -> (d, i)``.
+def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13,
+                  operands=None) -> float:
+    """Trustworthy per-iteration seconds of ``search_step(q) -> (d, i)``
+    (or ``search_step(q, operands)`` when ``operands`` is given).
 
     Runs N iterations of the step *inside one jitted program* (lax.scan),
     each on a rolled — hence distinct — query batch, folding every output
@@ -89,15 +91,23 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13) -> float:
     (T2-T1)/(N2-N1), cancelling constant dispatch/RTT/fetch overhead.
     This is steady-state on-device throughput, robust against the axon
     tunnel's async ``block_until_ready`` and result caching.
+
+    Pass the index through ``operands`` (any pytree — the Index
+    dataclasses are registered pytrees): closure-captured arrays would be
+    baked into the HLO as constants, which blows up remote compilation
+    for GB-scale indexes.
     """
     import jax.numpy as jnp
 
     def runner(iters):
         @jax.jit
-        def run(qs, salt):
+        def run(qs, salt, ops):
             def body(carry, i):
                 q = jnp.roll(qs, i + 1 + salt, axis=0)
-                d, idx = search_step(q)
+                if ops is None:
+                    d, idx = search_step(q)
+                else:
+                    d, idx = search_step(q, ops)
                 return carry + d.sum() + idx.sum(), None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(iters))
@@ -109,19 +119,19 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13) -> float:
     # changes each call so a platform-level result cache can never serve a
     # timed execution from the warmup (or a previous timed) run
     r1, r2 = runner(n1), runner(n2)
-    _ = float(r1(queries, jnp.int32(0)))  # compile + warm both programs
-    _ = float(r2(queries, jnp.int32(1)))
+    _ = float(r1(queries, jnp.int32(0), operands))  # compile + warm both
+    _ = float(r2(queries, jnp.int32(1), operands))
     t0 = time.perf_counter()
-    _ = float(r1(queries, jnp.int32(2)))
+    _ = float(r1(queries, jnp.int32(2), operands))
     t1 = time.perf_counter()
-    _ = float(r2(queries, jnp.int32(3)))
+    _ = float(r2(queries, jnp.int32(3), operands))
     t2 = time.perf_counter()
     per_iter = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
     if per_iter <= 0:
         # fast workloads on a local backend can be noise-dominated; fall
         # back to the overhead-inclusive total (never over-reports QPS)
         t3 = time.perf_counter()
-        _ = float(r2(queries, jnp.int32(4)))
+        _ = float(r2(queries, jnp.int32(4), operands))
         per_iter = (time.perf_counter() - t3) / n2
     return per_iter
 

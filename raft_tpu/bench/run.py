@@ -240,10 +240,12 @@ def run_config(cfg: dict, iters: int = 10) -> List[BenchResult]:
             recall = compute_recall(np.asarray(idx), gt)
             try:
                 search_s = scan_qps_time(
-                    lambda qq: search_q(index, qq),
+                    lambda qq, ix: search_q(ix, qq),
                     q_dev, n1=max(2, iters // 4), n2=max(4, iters),
+                    operands=index,
                 )
-            except jax.errors.TracerBoolConversionError:
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError):
                 # algos with host-side adaptive loops (ball_cover's
                 # certification rounds) can't run inside the scan; fall
                 # back to the pipelined host timer

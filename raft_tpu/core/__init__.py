@@ -12,9 +12,13 @@ from raft_tpu.core.serialize import save_npy, load_npy, serialize_mdspan, deseri
 from raft_tpu.core.logger import logger, set_level
 from raft_tpu.core.trace import annotate, push_range, pop_range
 from raft_tpu.core.interruptible import Interruptible, synchronize
+from raft_tpu.core.device_ndarray import auto_convert_output, cai_wrapper, device_ndarray
 
 __all__ = [
     "Resources",
+    "device_ndarray",
+    "auto_convert_output",
+    "cai_wrapper",
     "DeviceResources",
     "Bitset",
     "save_npy",

@@ -1,0 +1,125 @@
+"""pylibraft-compatible device array container + output conversion hooks
+(reference python/pylibraft/pylibraft/common/device_ndarray.py and
+common/outputs.py auto_convert_output).
+
+Backing storage is a jax.Array; interop rides the DLPack protocol both
+ways (torch, cupy, numpy ≥1.23 all speak it), so a pylibraft user's
+``device_ndarray`` call sites work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class device_ndarray:
+    """Device-resident ndarray (pylibraft common/device_ndarray.py).
+
+    Construct from any array-like (host copies to device) or any object
+    speaking ``__dlpack__`` (zero-copy when the producer is on the same
+    device).
+    """
+
+    def __init__(self, np_ndarray):
+        if isinstance(np_ndarray, device_ndarray):
+            self._array = np_ndarray._array
+        elif isinstance(np_ndarray, jax.Array):
+            self._array = np_ndarray
+        elif hasattr(np_ndarray, "__dlpack__") and not isinstance(
+            np_ndarray, np.ndarray
+        ):
+            self._array = jnp.from_dlpack(np_ndarray)
+        else:
+            self._array = jnp.asarray(np_ndarray)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        """Uninitialized-semantics device allocation (zeros here — XLA has
+        no uninitialized alloc; matches pylibraft's contract of
+        'contents undefined')."""
+        return cls(jnp.zeros(shape, dtype))
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True  # XLA arrays are dense row-major
+
+    @property
+    def f_contiguous(self) -> bool:
+        return self._array.ndim <= 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype.name)
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    @property
+    def strides(self):
+        itemsize = self.dtype.itemsize
+        strides = []
+        acc = itemsize
+        for dim in reversed(self.shape):
+            strides.append(acc)
+            acc *= dim
+        return tuple(reversed(strides))
+
+    @property
+    def jax_array(self) -> jax.Array:
+        return self._array
+
+    def copy_to_host(self) -> np.ndarray:
+        """Device → host numpy copy (device_ndarray.copy_to_host)."""
+        return np.asarray(self._array)
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._array.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._array.__dlpack_device__()
+
+    def __array__(self, dtype=None):
+        host = self.copy_to_host()
+        return host.astype(dtype) if dtype is not None else host
+
+    def __repr__(self):
+        return f"device_ndarray(shape={self.shape}, dtype={self.dtype})"
+
+
+def auto_convert_output(f: Callable) -> Callable:
+    """Decorator converting returned jax arrays to ``device_ndarray``
+    (pylibraft common/outputs.py auto_convert_output analog)."""
+    import functools
+
+    def conv(x: Any) -> Any:
+        if isinstance(x, jax.Array):
+            return device_ndarray(x)
+        if isinstance(x, tuple):
+            return tuple(conv(v) for v in x)
+        if isinstance(x, list):
+            return [conv(v) for v in x]
+        return x
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        return conv(f(*args, **kwargs))
+
+    return wrapper
+
+
+def cai_wrapper(obj) -> jax.Array:
+    """Accept any array-ish input (numpy, device_ndarray, DLPack
+    producers like torch tensors) as a jax array — the role pylibraft's
+    cai_wrapper (CUDA array interface) plays at every API boundary."""
+    if isinstance(obj, device_ndarray):
+        return obj.jax_array
+    if isinstance(obj, jax.Array) or isinstance(obj, np.ndarray):
+        return jnp.asarray(obj)
+    if hasattr(obj, "__dlpack__"):
+        return jnp.from_dlpack(obj)
+    return jnp.asarray(obj)

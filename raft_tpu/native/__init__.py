@@ -110,19 +110,22 @@ class FilePrefetcher:
         self.total_bytes = int(total_bytes)
         self.depth = int(depth)
         self._lib = get_lib()
-        self._handle = None
 
     def __iter__(self) -> Iterator[np.ndarray]:
         if self._lib is None:
-            # numpy fallback: plain sequential reads
+            # numpy fallback: plain sequential reads (same truncation
+            # contract as the native path: short file -> IOError)
             pos, end = self.offset, self.offset + self.total_bytes
             with open(self.path, "rb") as fp:
                 fp.seek(pos)
                 while pos < end:
                     want = min(self.block_bytes, end - pos)
                     data = fp.read(want)
-                    if not data:
-                        return
+                    if len(data) < want:
+                        raise IOError(
+                            f"short read at {pos}: {self.path} is smaller "
+                            "than the requested stream window"
+                        )
                     pos += len(data)
                     yield np.frombuffer(data, np.uint8)
             return
@@ -132,9 +135,11 @@ class FilePrefetcher:
         )
         if not handle:
             raise IOError(f"prefetch_open failed: {self.path}")
-        buf = np.empty(self.block_bytes, np.uint8)
         try:
             while True:
+                # fresh buffer per block: the consumer keeps it, so no
+                # second copy on top of the prefetcher's memcpy
+                buf = np.empty(self.block_bytes, np.uint8)
                 got = self._lib.rt_prefetch_next(
                     handle, buf.ctypes.data_as(ctypes.c_void_p),
                     self.block_bytes,
@@ -143,6 +148,6 @@ class FilePrefetcher:
                     raise IOError(f"prefetch read failed: {self.path}")
                 if got == 0:
                     return
-                yield buf[:got].copy()
+                yield buf[:got]
         finally:
             self._lib.rt_prefetch_close(handle)

@@ -71,6 +71,13 @@ class BallCoverIndex:
         return int(self.list_sizes.sum())
 
 
+jax.tree_util.register_dataclass(
+    BallCoverIndex,
+    data_fields=["landmarks", "storage", "indices", "list_sizes", "radii"],
+    meta_fields=["metric"],
+)
+
+
 def _true_metric(metric) -> DistanceType:
     metric = resolve_metric(metric)
     if metric == DistanceType.L2Expanded:

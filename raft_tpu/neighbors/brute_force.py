@@ -52,6 +52,13 @@ class Index:
         return self.dataset.shape[1]
 
 
+jax.tree_util.register_dataclass(
+    Index,
+    data_fields=["dataset", "norms"],
+    meta_fields=["metric", "metric_arg"],
+)
+
+
 def build(dataset, metric="sqeuclidean", metric_arg: float = 2.0) -> Index:
     """Build a brute-force index (reference brute_force-inl.cuh:345)."""
     metric = resolve_metric(metric)

@@ -117,6 +117,13 @@ class Index:
         return self.graph.shape[1]
 
 
+jax.tree_util.register_dataclass(
+    Index,
+    data_fields=["dataset", "graph", "data_norms"],
+    meta_fields=["metric"],
+)
+
+
 # ---------------------------------------------------------------------------
 # build
 # ---------------------------------------------------------------------------

@@ -148,6 +148,13 @@ class Index:
         return int(self.list_sizes.sum())
 
 
+jax.tree_util.register_dataclass(
+    Index,
+    data_fields=["centers", "storage", "indices", "list_sizes", "data_norms"],
+    meta_fields=["metric", "metric_arg", "adaptive_centers"],
+)
+
+
 def _aligned_cap(max_count: int) -> int:
     """List capacity: lane-aligned (128) once lists are big enough for the
     fused scan kernel; 8-aligned for tiny test indexes."""

@@ -180,6 +180,15 @@ class Index:
         return int(self.list_sizes.sum())
 
 
+jax.tree_util.register_dataclass(
+    Index,
+    data_fields=["centers", "centers_rot", "rotation", "pq_centers", "codes",
+                 "indices", "list_sizes", "rec_norms"],
+    meta_fields=["metric", "pq_dim_", "metric_arg", "codebook_kind",
+                 "pq_bits"],
+)
+
+
 # ---------------------------------------------------------------------------
 # bit-packed code words (reference ivf_pq_types.hpp:172-187 bitfield)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,70 @@
+"""device_ndarray / DLPack interop tests (pylibraft
+common/device_ndarray.py parity; torch interop via DLPack)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.device_ndarray import (
+    auto_convert_output,
+    cai_wrapper,
+    device_ndarray,
+)
+
+
+def test_roundtrip_host():
+    x = np.random.default_rng(0).standard_normal((10, 4)).astype(np.float32)
+    d = device_ndarray(x)
+    assert d.shape == (10, 4)
+    assert d.dtype == np.float32
+    assert d.c_contiguous
+    np.testing.assert_array_equal(d.copy_to_host(), x)
+    np.testing.assert_array_equal(np.asarray(d), x)
+
+
+def test_empty_and_strides():
+    d = device_ndarray.empty((3, 5), np.int32)
+    assert d.shape == (3, 5) and d.dtype == np.int32
+    assert d.strides == (20, 4)
+
+
+def test_dlpack_numpy():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    d = device_ndarray(x)
+    back = np.from_dlpack(d)
+    np.testing.assert_array_equal(back, x)
+
+
+def test_dlpack_torch():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(8, dtype=torch.float32).reshape(2, 4)
+    d = device_ndarray(t)
+    assert d.shape == (2, 4)
+    np.testing.assert_array_equal(d.copy_to_host(), t.numpy())
+    back = torch.from_dlpack(d)
+    assert back.shape == (2, 4)
+
+
+def test_auto_convert_output_and_cai():
+    import jax.numpy as jnp
+
+    @auto_convert_output
+    def f():
+        return jnp.ones((2, 2)), [jnp.zeros(3), "meta"]
+
+    a, (b, meta) = f()
+    assert isinstance(a, device_ndarray)
+    assert isinstance(b, device_ndarray)
+    assert meta == "meta"
+    arr = cai_wrapper(a)
+    assert arr.shape == (2, 2)
+
+
+def test_search_pipeline_through_wrapper():
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import brute_force
+
+    rng = np.random.default_rng(1)
+    x = device_ndarray(rng.standard_normal((500, 8)).astype(np.float32))
+    q = device_ndarray(rng.standard_normal((20, 8)).astype(np.float32))
+    d, i = brute_force.knn(cai_wrapper(q), cai_wrapper(x), 5)
+    assert i.shape == (20, 5)
