@@ -153,13 +153,19 @@ def bench_ivfpq_deep10m(results):
     x = _sift_like(n, d, seed=3)
     q = jax.device_put(_sift_like(nq, d, seed=4))
     t0 = time.time()
+    # streaming build: per-batch encode keeps the full-dataset rotation /
+    # residual intermediates (≈12 GB at 10M x 96) out of HBM
     index = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, pq_bits=8), x
+        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, pq_bits=8), x,
+        batch_size=2_000_000,
     )
     np.asarray(index.list_sizes)
     results["ivfpq_build_s"] = round(time.time() - t0, 1)
     sp = ivf_pq.SearchParams(n_probes=128)
+    t0 = time.time()
     dist, idx = ivf_pq.search(sp, index, q, k)
+    np.asarray(idx[0, 0])
+    rough_s = max(time.time() - t0, 0.1)  # order-of-magnitude, incl. RTT
     # chunked exact oracle on a query subset
     sub = 500
     from raft_tpu.bench.run import generate_groundtruth
@@ -168,8 +174,12 @@ def bench_ivfpq_deep10m(results):
         x, np.asarray(q[:sub]), k, "sqeuclidean", chunk=2_000_000
     )
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(mi))
+    # size the scan so one timed program stays well under the remote
+    # platform's ~2 min single-program watchdog
+    n2 = int(np.clip(45.0 / rough_s, 2, 13))
+    n1 = max(1, n2 // 3)
     s = scan_qps_time(lambda qq, ix: ivf_pq.search(sp, ix, qq, k), q,
-                      operands=index)
+                      n1=n1, n2=n2, operands=index)
     results["ivfpq_deep10m_qps"] = round(nq / s, 1)
     results["ivfpq_recall"] = round(float(recall), 3)
 
