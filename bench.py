@@ -155,17 +155,22 @@ def bench_ivfpq_deep10m(results):
     t0 = time.time()
     # streaming build: per-batch encode keeps the full-dataset rotation /
     # residual intermediates (≈12 GB at 10M x 96) out of HBM
+    # trainset fraction 0.1: 1M training rows are plenty for 1024 coarse
+    # centers + codebooks and cut the dominant kmeans/upload cost
     index = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, pq_bits=8), x,
+        ivf_pq.IndexParams(n_lists=1024, pq_dim=48, pq_bits=8,
+                           kmeans_trainset_fraction=0.1), x,
         batch_size=2_000_000,
     )
     np.asarray(index.list_sizes)
     results["ivfpq_build_s"] = round(time.time() - t0, 1)
     sp = ivf_pq.SearchParams(n_probes=128)
-    t0 = time.time()
     dist, idx = ivf_pq.search(sp, index, q, k)
-    np.asarray(idx[0, 0])
-    rough_s = max(time.time() - t0, 0.1)  # order-of-magnitude, incl. RTT
+    np.asarray(idx[0, 0])  # first call: compile + warm
+    t0 = time.time()
+    _, idx2 = ivf_pq.search(sp, index, q, k)
+    np.asarray(idx2[0, 0])
+    rough_s = max(time.time() - t0, 0.1)  # warm order-of-magnitude + RTT
     # chunked exact oracle on a query subset
     sub = 500
     from raft_tpu.bench.run import generate_groundtruth
@@ -187,6 +192,8 @@ def bench_ivfpq_deep10m(results):
 def main():
     results = {}
     full = os.environ.get("BENCH_FULL", "1") != "0"
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "4500"))
+    t_start = time.time()
     bench_bruteforce_sift10k(results)
     bench_pairwise(results)
     bench_ivfflat_sift1m(results)
@@ -195,10 +202,15 @@ def main():
             bench_cagra_sift1m(results)
         except Exception as e:  # keep the headline alive on partial failure
             results["cagra_error"] = repr(e)[:200]
-        try:
-            bench_ivfpq_deep10m(results)
-        except Exception as e:
-            results["ivfpq_error"] = repr(e)[:200]
+        # the PQ bench needs ~2400s end to end (BASELINE.md measurement);
+        # only start it if that fits in what's left of the budget
+        if budget_s - (time.time() - t_start) > 2400:
+            try:
+                bench_ivfpq_deep10m(results)
+            except Exception as e:
+                results["ivfpq_error"] = repr(e)[:200]
+        else:
+            results["ivfpq_skipped"] = "insufficient bench time budget"
 
     qps = results["ivfflat_sift1m_qps"]
     out = {
