@@ -108,7 +108,10 @@ def sharded_ivf_search(
         )
     select_min = is_min_close(index.metric)
     metric = int(index.metric)
-    group = int(search_params.query_group)
+    group = ivf_flat.adaptive_query_group(
+        int(queries.shape[0]), n_probes, index.n_lists,
+        int(search_params.query_group),
+    )
     bucket_batch = int(search_params.bucket_batch)
 
     has_norms = index.data_norms is not None

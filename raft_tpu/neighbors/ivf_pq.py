@@ -736,12 +736,18 @@ def search(
         index.pq_centers, index.codes, index.indices, index.list_sizes,
         index.rec_norms, None if bits is None else bits.bits,
     )
+    from raft_tpu.neighbors.ivf_flat import adaptive_query_group
+
+    group = adaptive_query_group(
+        int(queries.shape[0]), n_probes, index.n_lists,
+        int(search_params.query_group),
+    )
     return _pq_search(
         arrays,
         int(k),
         n_probes,
         int(index.metric),
-        int(search_params.query_group),
+        group,
         int(search_params.bucket_batch),
         int(index.codebook_kind),
         0 if bits is None else int(bits.n_bits),
