@@ -1,0 +1,28 @@
+"""Multi-host bootstrap — import-light by design.
+
+``jax.distributed.initialize`` must run before ANYTHING initializes the
+XLA backend, and importing the heavier raft_tpu subpackages (comms,
+neighbors) traces jitted helpers that do. This module imports only jax,
+so a multi-host program can safely do:
+
+    from raft_tpu.bootstrap import init_multihost
+    init_multihost(coordinator_address=..., num_processes=N, process_id=i)
+    from raft_tpu.comms import Comms, sharded_knn   # now safe
+
+(the raft-dask ``Comms.init`` analog; the TPU runtime owns rank
+discovery, so there is no NCCL unique-id exchange to implement —
+reference python/raft-dask/raft_dask/common/comms.py:173).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def init_multihost(coordinator_address: Optional[str] = None, **kwargs) -> None:
+    """Process-group bootstrap: thin wrapper over
+    ``jax.distributed.initialize`` (auto-discovery on TPU pods when no
+    coordinator is given)."""
+    jax.distributed.initialize(coordinator_address=coordinator_address, **kwargs)

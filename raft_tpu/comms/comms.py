@@ -99,8 +99,7 @@ def local_handle(
     return h
 
 
-def init_multihost(coordinator_address: Optional[str] = None, **kwargs) -> None:
-    """Multi-host bootstrap: the raft-dask Comms.init analog. On TPU pods
-    ``jax.distributed.initialize`` discovers peers from the runtime; no
-    NCCL unique-id broadcast is needed."""
-    jax.distributed.initialize(coordinator_address=coordinator_address, **kwargs)
+# Multi-host bootstrap lives in raft_tpu.bootstrap (import-light):
+# jax.distributed.initialize must run before anything touches the XLA
+# backend, and importing THIS package already does — so a bootstrap
+# entry point here could never succeed and is deliberately not provided.
