@@ -163,6 +163,10 @@ def build_knn_graph(
             else DistanceType.L2Expanded
         ),
         kmeans_n_iters=10,
+        # full-dataset coarse training measured FASTER end-to-end than a
+        # 256-rows/list subsample at n=1M (359 s vs 499 s): better
+        # centers -> tighter list balance -> smaller cap -> faster
+        # self-search batches, outweighing the kmeans savings
         kmeans_trainset_fraction=min(1.0, max(0.1, 10000.0 * n_lists / n)),
     )
     index = ivf_pq.build(params, dataset)
