@@ -133,16 +133,28 @@ def get_groundtruth(cfg: dict, base, queries, k: int) -> np.ndarray:
             raise ValueError(
                 f"groundtruth_file has {gt.shape[1]} neighbors < k={k}"
             )
+        if gt.shape[0] != queries.shape[0]:
+            raise ValueError(
+                f"groundtruth_file has {gt.shape[0]} rows but the query "
+                f"set has {queries.shape[0]}"
+            )
         return gt[:, :k]
     cache = cfg.get("groundtruth_cache")
     if cache is None and "synthetic" in cfg and cfg.get("name"):
-        # deterministic synthetic data: default a cache keyed on the
-        # dataset name + k so repeat runs skip the exact-KNN pass
+        # deterministic synthetic data: default a cache keyed on the FULL
+        # spec (a name-only key poisons runs whose configs share a name
+        # but differ in size/seed)
+        spec = cfg["synthetic"]
+        tag = "-".join(
+            [str(spec.get(f, "")) for f in
+             ("n", "dim", "n_queries", "seed", "intrinsic_dim")]
+            + [str(cfg.get("distance", "sqeuclidean"))]
+        )
         os.makedirs(".bench_cache", exist_ok=True)
-        cache = os.path.join(".bench_cache", f"{cfg['name']}-gt")
+        cache = os.path.join(".bench_cache", f"{cfg['name']}-{tag}-gt")
     if cache and os.path.exists(cache + ".neighbors.ibin"):
         gt = ds.read_groundtruth(cache)[0]
-        if gt.shape[1] >= k:
+        if gt.shape[1] >= k and gt.shape[0] == queries.shape[0]:
             return gt[:, :k]
     gt = generate_groundtruth(base, queries, max(k, 100), metric)
     if cache:
