@@ -1,0 +1,112 @@
+"""Bitonic sorting networks as reshape + elementwise ops (no gathers).
+
+TPU-native analog of the reference's warp bitonic sort
+(cpp/include/raft/util/bitonic_sort.cuh; CAGRA's itopk merge
+detail/cagra/bitonic.hpp): the CUDA warp-shuffle compare-exchange becomes
+a static [.., L/(2j), 2, j] reshape pair-up — every substage is pure
+elementwise min/max/select on the VPU, so sorting a row costs zero
+dynamic gathers (lax.sort / argsort + take_along_axis lower to serial
+per-row gathers on TPU and measure ~5-10x slower at beam-search shapes).
+
+Rows sort along the LAST axis, ascending by key, payloads carried by the
+same compare-exchange predicate. Length must be a power of two — callers
+pad with +inf keys.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _substage(keys, payloads, j: int, asc_mask):
+    """One compare-exchange substage: partner i <-> i^j via reshape."""
+    shape = keys.shape
+    L = shape[-1]
+    lead = shape[:-1]
+    r = lead + (L // (2 * j), 2, j)
+
+    def pair(x):
+        x = x.reshape(r)
+        return x[..., 0, :], x[..., 1, :]
+
+    k0, k1 = pair(keys)
+    swap = jnp.where(asc_mask, k0 > k1, k0 < k1)        # [.., L/2j, j] bool
+
+    def exchange(x0, x1):
+        lo = jnp.where(swap, x1, x0)
+        hi = jnp.where(swap, x0, x1)
+        return jnp.stack([lo, hi], axis=-2).reshape(shape)
+
+    keys = exchange(k0, k1)
+    payloads = tuple(exchange(*pair(p)) for p in payloads)
+    return keys, payloads
+
+
+@functools.lru_cache(maxsize=None)
+def _asc_masks(L: int):
+    """Static ascending-direction masks per (k, j) substage.
+
+    Direction of the compare at index i in stage k is ascending iff bit
+    log2(k) of i is 0 (both partners agree: they differ only in bit
+    log2(j) < log2(k)).
+    """
+    idx = np.arange(L)
+    masks = {}
+    k = 2
+    while k <= L:
+        asc = (idx & k) == 0
+        j = k // 2
+        while j >= 1:
+            masks[(k, j)] = asc.reshape(L // (2 * j), 2, j)[:, 0, :]
+            j //= 2
+        k *= 2
+    return masks
+
+
+def sort_by_key(keys, *payloads, descending: bool = False):
+    """Sort rows of ``keys`` (last axis, power-of-two length) carrying
+    ``payloads`` through the same permutation. Returns (keys, payloads)."""
+    L = keys.shape[-1]
+    if L & (L - 1):
+        raise ValueError(f"bitonic length must be a power of two, got {L}")
+    if descending:
+        keys = -keys
+    masks = _asc_masks(L)
+    k = 2
+    while k <= L:
+        j = k // 2
+        while j >= 1:
+            asc = jnp.asarray(masks[(k, j)])
+            keys, payloads = _substage(keys, payloads, j, asc)
+            j //= 2
+        k *= 2
+    if descending:
+        keys = -keys
+    return keys, payloads
+
+
+def merge_sorted(keys, *payloads):
+    """Bitonic *merge* of a row whose two halves are each sorted
+    ascending: flip the upper half to form a bitonic sequence, then run
+    the final-stage network — log2(L) substages instead of a full sort.
+    Used for sorted-buffer + sorted-candidates merges."""
+    L = keys.shape[-1]
+    if L & (L - 1):
+        raise ValueError(f"bitonic length must be a power of two, got {L}")
+    half = L // 2
+    flip = lambda x: jnp.concatenate(
+        [x[..., :half], jnp.flip(x[..., half:], axis=-1)], axis=-1
+    )
+    keys = flip(keys)
+    payloads = tuple(flip(p) for p in payloads)
+    j = L // 2
+    while j >= 1:
+        asc = jnp.asarray(
+            np.ones((L // (2 * j), j), dtype=bool)
+        )
+        keys, payloads = _substage(keys, payloads, j, asc)
+        j //= 2
+    return keys, payloads

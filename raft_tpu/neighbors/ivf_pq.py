@@ -90,6 +90,9 @@ class IndexParams:
     add_data_on_build: bool = True
     # coarse-quantizer training GEMM dtype ("f32" | "bf16", see ivf_flat)
     kmeans_compute_dtype: str = "f32"
+    # build the int8 decoded-residual cache (fused-Pallas search path);
+    # auto-skipped above _CACHE_BUDGET bytes
+    cache_decoded: bool = True
 
     def __post_init__(self):
         self.metric = resolve_metric(self.metric)
@@ -121,6 +124,10 @@ class SearchParams:
     bucket_batch: int = 32
     compute_dtype: str = "bf16"        # matmul operand dtype (f32 accumulate)
     local_recall_target: float = 0.95  # per-list approx top-k; >=1.0 exact
+    # "auto" = fused Pallas scan over the decoded-residual cache when the
+    # index has one (TPU, lane-aligned cap, k<=64), else the XLA
+    # decode-then-matmul scan; "pallas" | "pallas_interpret" | "xla" force
+    scan_impl: str = "auto"
 
 
 @dataclasses.dataclass
@@ -150,6 +157,14 @@ class Index:
     metric_arg: float = 2.0
     codebook_kind: int = codebook_gen.PER_SUBSPACE
     pq_bits: int = 8
+    # optional int8 decoded-residual cache [n_lists, cap, rot_dim]: the
+    # codes stay the compressed source of truth, but search can scan the
+    # cache with the fused Pallas kernel (one MXU matmul per list block)
+    # instead of decode-then-matmul — ~1 byte/rot-dim extra HBM, gated by
+    # _CACHE_BUDGET. Rebuilt on load/extend; never serialized.
+    recon_cache: object = None
+    recon_scale: float = 1.0
+    cache_decoded: bool = True
 
     @property
     def n_lists(self) -> int:
@@ -183,10 +198,14 @@ class Index:
 jax.tree_util.register_dataclass(
     Index,
     data_fields=["centers", "centers_rot", "rotation", "pq_centers", "codes",
-                 "indices", "list_sizes", "rec_norms"],
+                 "indices", "list_sizes", "rec_norms", "recon_cache"],
     meta_fields=["metric", "pq_dim_", "metric_arg", "codebook_kind",
-                 "pq_bits"],
+                 "pq_bits", "recon_scale", "cache_decoded"],
 )
+
+# decoded-residual cache is skipped when n_lists * cap * rot_dim exceeds
+# this budget (bytes) — the decode-then-matmul scan path is used instead
+_CACHE_BUDGET = 10 << 30
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +416,7 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         metric_arg=params.metric_arg,
         codebook_kind=int(params.codebook_kind),
         pq_bits=int(params.pq_bits),
+        cache_decoded=bool(params.cache_decoded),
     )
     if not params.add_data_on_build:
         return index
@@ -428,13 +448,13 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         codes_packed, index.pq_centers, int(params.codebook_kind),
         pq_dim, int(params.pq_bits),
     )
-    return dataclasses.replace(
+    return _attach_cache(dataclasses.replace(
         index,
         codes=codes_packed,
         indices=indices,
         list_sizes=list_sizes,
         rec_norms=rec_norms,
-    )
+    ))
 
 
 def encode(index: Index, vectors) -> Tuple[jax.Array, jax.Array]:
@@ -541,13 +561,13 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
         index.pq_dim, index.pq_bits,
     )
 
-    return dataclasses.replace(
+    return _attach_cache(dataclasses.replace(
         index,
         codes=codes_packed,
         indices=indices,
         list_sizes=list_sizes,
         rec_norms=rec_norms,
-    )
+    ))
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
@@ -574,13 +594,54 @@ def _rec_norms(codes_packed, pq_centers, codebook_kind: int, pq_dim: int,
     return norms
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _recon_cache_scan(codes_packed, pq_centers, codebook_kind: int,
+                      pq_dim: int, pq_bits: int):
+    """int8-quantized decoded residuals per stored vector ([C, cap,
+    rot_dim]), scanned over lists. The dequant scale is bounded by the
+    codebook itself (every reconstructed component IS a codebook entry),
+    so no data pass is needed."""
+    C = codes_packed.shape[0]
+    scale = jnp.maximum(jnp.max(jnp.abs(pq_centers)), 1e-30) / 127.0
+
+    def body(_, inp):
+        blk, lid = inp                                     # [cap, nw], []
+        u = unpack_codes(blk, pq_dim, pq_bits)             # [cap, p]
+        if codebook_kind == codebook_gen.PER_SUBSPACE:
+            recon = _decode_gather(u, pq_centers, codebook_kind)
+        else:
+            recon = _decode_gather(u, pq_centers, codebook_kind,
+                                   jnp.full((u.shape[0],), lid))
+        q = jnp.clip(jnp.round(recon / scale), -127, 127).astype(jnp.int8)
+        return None, q
+
+    _, cache = jax.lax.scan(
+        body, None, (codes_packed, jnp.arange(C, dtype=jnp.int32))
+    )
+    return cache, scale
+
+
+def _attach_cache(index: "Index") -> "Index":
+    """(Re)build the decoded-residual cache when enabled and affordable."""
+    C, cap, _ = index.codes.shape
+    if (not index.cache_decoded or cap == 0
+            or C * cap * index.rot_dim > _CACHE_BUDGET):
+        return dataclasses.replace(index, recon_cache=None)
+    cache, scale = _recon_cache_scan(
+        index.codes, index.pq_centers, index.codebook_kind,
+        index.pq_dim, index.pq_bits,
+    )
+    return dataclasses.replace(index, recon_cache=cache,
+                               recon_scale=float(scale))
+
+
 # ---------------------------------------------------------------------------
 # search
 # ---------------------------------------------------------------------------
 
 
 @functools.partial(
-    jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+    jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 )
 def _pq_search(
     arrays,
@@ -597,9 +658,10 @@ def _pq_search(
     internal_dtype: str = "f32",
     pq_dim: int = 0,
     pq_bits: int = 8,
+    scan_impl: str = "xla",
 ):
     (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
-     list_sizes, rec_norms, filter_bits) = arrays
+     list_sizes, rec_norms, filter_bits, recon_cache, recon_scale) = arrays
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
     C, cap, _nw = codes.shape
@@ -631,6 +693,60 @@ def _pq_search(
     if lut_dtype == "bf16" and mm is jnp.float32:
         mm = jnp.bfloat16
     decode_via_f8 = lut_dtype == "f8"
+
+    if scan_impl.startswith("pallas"):
+        # fused Pallas scan over the int8 decoded-residual cache: identical
+        # machinery to ivf_flat's kernel — the PQ twist is that the scanned
+        # space is the rotated residual space, so the per-bucket "queries"
+        # are query residuals vs the probed list's center, with the int8
+        # dequant scale folded into them (dots then equal q_res . recon)
+        from raft_tpu.ops import ivf_scan
+
+        qsafe_b = jnp.maximum(bucket_q, 0)
+        q_res = q_rot[qsafe_b] - centers_rot[bucket_list][:, None, :]
+        qv = (q_res * recon_scale).astype(jnp.bfloat16)      # [nb, G, rot]
+        ip = metric == DistanceType.InnerProduct
+        if ip:
+            # dist contribution = -(q_rot . recon); the per-(query, list)
+            # constant q_rot . c_l is added back after the kernel
+            qv = (q_rot[qsafe_b] * recon_scale).astype(jnp.bfloat16)
+            mk, qaux = ivf_scan.IP, None
+        else:
+            mk, qaux = ivf_scan.L2, jnp.sum(q_res * q_res, axis=2)
+        keep = None
+        if filter_bits is not None:
+            keep = filter_keep(filter_bits, filter_nbits, indices).astype(
+                jnp.int32
+            )
+        out_d, out_pos = ivf_scan.fused_list_scan_topk(
+            recon_cache, list_sizes, bucket_list, qv, qaux,
+            None if ip else rec_norms,   # IP kernel never reads norms
+            keep,
+            k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
+            interpret=scan_impl == "pallas_interpret",
+        )
+        ids_nb = indices[bucket_list]                        # [nb, cap]
+        cand_i = jnp.take_along_axis(
+            ids_nb[:, None, :], jnp.minimum(out_pos, cap - 1), axis=2
+        )                                                     # [nb, G, kl]
+        if ip:
+            qc = jnp.einsum(
+                "bgd,bd->bg", q_rot[qsafe_b], centers_rot[bucket_list],
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            cand_d = qc[:, :, None] + (-out_d)               # min-space -> score
+        else:
+            cand_d = out_d
+        cand_d = jnp.where(jnp.isinf(out_d), sentinel, cand_d)
+        out_d, out_i = unbucketize_merge(
+            cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
+            n_probes, kl, k, select_min, sentinel,
+        )
+        out_i = jnp.where(out_d == sentinel, -1, out_i)
+        if metric == DistanceType.L2SqrtExpanded:
+            out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+        return out_d, out_i
 
     def body(_, inp):
         bl, bq = inp  # [bb], [bb, group]
@@ -735,13 +851,26 @@ def search(
         queries, index.centers, index.centers_rot, index.rotation,
         index.pq_centers, index.codes, index.indices, index.list_sizes,
         index.rec_norms, None if bits is None else bits.bits,
+        index.recon_cache, jnp.float32(index.recon_scale),
     )
-    from raft_tpu.neighbors.ivf_flat import adaptive_query_group
+    from raft_tpu.neighbors.ivf_flat import (
+        adaptive_query_group, _resolve_scan_impl,
+    )
 
     group = adaptive_query_group(
         int(queries.shape[0]), n_probes, index.n_lists,
         int(search_params.query_group),
     )
+    requested = str(search_params.scan_impl)
+    if index.recon_cache is None:
+        if requested.startswith("pallas"):
+            raise ValueError(
+                "scan_impl=%r needs the decoded-residual cache; build with "
+                "cache_decoded=True (and within _CACHE_BUDGET)" % requested
+            )
+        impl = "xla"
+    else:
+        impl = _resolve_scan_impl(requested, cap, min(k, cap))
     return _pq_search(
         arrays,
         int(k),
@@ -757,6 +886,7 @@ def search(
         _norm_dtype_knob(search_params.internal_distance_dtype),
         int(index.pq_dim),
         int(index.pq_bits),
+        impl,
     )
 
 
@@ -806,6 +936,7 @@ def save(path: str, index: Index) -> None:
             "codebook_kind": index.codebook_kind,
             "pq_bits": index.pq_bits,
             "pq_dim": index.pq_dim,
+            "cache_decoded": bool(index.cache_decoded),
         },
         arrays,
     )
@@ -813,7 +944,7 @@ def save(path: str, index: Index) -> None:
 
 def load(path: str) -> Index:
     _, meta, arrays = read_index_file(path, "ivf_pq")
-    return Index(
+    return _attach_cache(Index(
         centers=jnp.asarray(arrays["centers"]),
         centers_rot=jnp.asarray(arrays["centers_rot"]),
         rotation=jnp.asarray(arrays["rotation"]),
@@ -827,4 +958,5 @@ def load(path: str) -> Index:
         metric_arg=meta["metric_arg"],
         codebook_kind=int(meta["codebook_kind"]),
         pq_bits=int(meta["pq_bits"]),
-    )
+        cache_decoded=bool(meta.get("cache_decoded", True)),
+    ))
