@@ -63,7 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.serialize import read_index_file, write_index_file
-from raft_tpu.matrix.bitonic import merge_sorted, sort_by_key
+from raft_tpu.matrix.bitonic import sort_by_key
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.utils.precision import dist_dot
 
@@ -533,6 +533,24 @@ def _merge_step(buf_d, buf_i, buf_e, cand_d, cand_i, itopk: int,
     return sd[:, :itopk], si[:, :itopk], se[:, :itopk]
 
 
+def _exact_dedup_prefix(fd, fi, k: int):
+    """All-pairs id dedup on the sorted prefix, then resort — closes the
+    windowed dedup's escape hatch (interleaved bitwise-equal distances can
+    separate a duplicate pair arbitrarily far; an all-pairs compare on a
+    small prefix is exact and costs ~[m, 4k, 4k] VPU ops once)."""
+    m, L = fi.shape
+    P = min(L, _next_pow2(max(2 * k, 16)))
+    pi = fi[:, :P]
+    pd = fd[:, :P]
+    tri = (jnp.arange(P)[None, :] < jnp.arange(P)[:, None])[None, :, :]
+    dup = jnp.any((pi[:, :, None] == pi[:, None, :]) & tri
+                  & (pi >= 0)[:, :, None], axis=2)
+    pd = jnp.where(dup, jnp.inf, pd)
+    pi = jnp.where(dup, -1, pi)
+    pd, (pi,) = sort_by_key(pd, pi)
+    return pd[:, :k], pi[:, :k]
+
+
 def _finalize(out_d, out_i, q32, metric):
     """Restore the dropped ||q||^2 term / signs and mask invalid slots."""
     ip = metric == DistanceType.InnerProduct
@@ -609,12 +627,9 @@ def _beam_search(
     L = _next_pow2(itopk)
     fd = _pad_cols(jnp.where(buf_i < 0, jnp.inf, buf_d), L, jnp.inf)
     fi = _pad_cols(buf_i, L, -1)
-    fe = jnp.zeros((m, L), jnp.bool_)
-    fd, (fi, fe) = sort_by_key(fd, fi, fe)
-    fd, fi, fe = _window_dedup(fd, fi, fe, window=8)
-    fd = jnp.where(fi < 0, jnp.inf, fd)
     fd, (fi,) = sort_by_key(fd, fi)
-    return _finalize(fd[:, :k], fi[:, :k], q32, metric)
+    fd, fi = _exact_dedup_prefix(fd, fi, k)
+    return _finalize(fd, fi, q32, metric)
 
 
 @functools.partial(jax.jit, static_argnums=(8, 9, 10, 11, 12))
@@ -704,13 +719,9 @@ def _beam_search_inline(
     rd = _pad_cols(rd, LR, jnp.inf)
     ri = _pad_cols(ri, LR, -1)
     re = jnp.zeros_like(ri, dtype=jnp.bool_)
-    rd, (ri, re) = sort_by_key(rd, ri, re)
-    # wide window: exact-distance ties between distinct points (integer
-    # data) can split a duplicate run; then sink the blanked ghosts
-    rd, ri, re = _window_dedup(rd, ri, re, window=8)
-    rd = jnp.where(ri < 0, jnp.inf, rd)
     rd, (ri,) = sort_by_key(rd, ri)
-    return _finalize(rd[:, :k], ri[:, :k], q32, metric)
+    rd, ri = _exact_dedup_prefix(rd, ri, k)
+    return _finalize(rd, ri, q32, metric)
 
 
 def search(

@@ -107,7 +107,8 @@ class SearchParams:
     # accumulate in f32), "f32" = exact 6-pass. The reference's analog is
     # its fp16/fp8 LUT ladder (ivf_pq_types.hpp lut_dtype).
     compute_dtype: str = "bf16"
-    # recall target for the per-list approx top-k (lax.approx_min_k /
+    # recall target for the per-list approx top-k AND the final
+    # cross-probe merge (lax.approx_min_k /
     # lane-binned Pallas extraction); >= 1.0 switches to exact selection
     local_recall_target: float = 0.95
     # scan backend: "auto" picks the fused Pallas kernel on TPU when the
@@ -386,18 +387,28 @@ def bucketize_pairs(
 
 def unbucketize_merge(
     cand_d, cand_i, pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
-    select_min, sentinel,
+    select_min, sentinel, approx: bool = False, recall_target: float = 0.95,
 ):
     """Map per-bucket top-kl candidates back to query-major order and merge
-    each query's n_probes x kl candidates into the final top-k."""
+    each query's n_probes x kl candidates into the final top-k.
+
+    The back-mapping is ONE composed row gather: pair-major slot p reads
+    bucket slot ``flat_slot[inv_order[p]]`` (a gather-then-scatter pair
+    costs 2x the row traffic; row scatters measured slower still).
+    ``approx`` uses the TPU partial-reduce top-k for the final merge —
+    k=10 of 1280 candidates is its sweet spot (exact lax.top_k there
+    costs ~40 ms at m=10k)."""
     group = cand_d.shape[1]
     flat_slot = pair_bucket * group + pair_pos
-    sd = cand_d.reshape(-1, kl)[flat_slot]
-    si = cand_i.reshape(-1, kl)[flat_slot]
-    pd = jnp.full((total, kl), sentinel, sd.dtype).at[order].set(sd)
-    pi = jnp.full((total, kl), -1, si.dtype).at[order].set(si)
+    inv = jnp.zeros((total,), jnp.int32).at[order].set(
+        jnp.arange(total, dtype=jnp.int32)
+    )
+    comp = flat_slot[inv]
+    pd = cand_d.reshape(-1, kl)[comp]
+    pi = cand_i.reshape(-1, kl)[comp]
     return merge_topk(
-        pd.reshape(m, n_probes * kl), pi.reshape(m, n_probes * kl), k, select_min
+        pd.reshape(m, n_probes * kl), pi.reshape(m, n_probes * kl), k,
+        select_min, approx=approx, recall_target=recall_target,
     )
 
 
@@ -483,15 +494,11 @@ def _ivf_search(
             keep = filter_keep(filter_bits, filter_nbits, indices).astype(
                 jnp.int32
             )
-        out_d, out_pos = ivf_scan.fused_list_scan_topk(
-            storage, list_sizes, bucket_list, qv, qaux, pn2, keep,
+        out_d, cand_i = ivf_scan.fused_list_scan_topk(
+            storage, indices, list_sizes, bucket_list, qv, qaux, pn2, keep,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
             interpret=scan_impl == "pallas_interpret",
-        )
-        ids_nb = indices[bucket_list]                        # [nb, cap]
-        cand_i = jnp.take_along_axis(
-            ids_nb[:, None, :], jnp.minimum(out_pos, cap - 1), axis=2
-        )                                                     # [nb, G, kl]
+        )                                                    # ids in-kernel
         if metric == DistanceType.InnerProduct:
             cand_d = -out_d                                  # min-space -> score
         else:
@@ -500,6 +507,8 @@ def _ivf_search(
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
             n_probes, kl, k, select_min, sentinel,
+            approx=local_recall_target < 1.0,
+            recall_target=local_recall_target,
         )
         out_i = jnp.where(out_d == sentinel, -1, out_i)
         if metric == DistanceType.L2SqrtExpanded:
@@ -556,6 +565,8 @@ def _ivf_search(
     out_d, out_i = unbucketize_merge(
         cand_d, cand_i, pair_bucket, pair_pos, order, total, m, n_probes,
         kl, k, select_min, sentinel,
+        approx=local_recall_target < 1.0,
+        recall_target=local_recall_target,
     )
     # fewer than k valid candidates in the probed lists: report id -1, not
     # whatever id rode along at sentinel distance (the documented contract;

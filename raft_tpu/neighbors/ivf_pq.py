@@ -123,7 +123,9 @@ class SearchParams:
     query_group: int = 256
     bucket_batch: int = 32
     compute_dtype: str = "bf16"        # matmul operand dtype (f32 accumulate)
-    local_recall_target: float = 0.95  # per-list approx top-k; >=1.0 exact
+    # governs BOTH the per-list approx top-k and the final cross-probe
+    # merge (TPU partial-reduce); >= 1.0 runs both exactly
+    local_recall_target: float = 0.95
     # "auto" = fused Pallas scan over the decoded-residual cache when the
     # index has one (TPU, lane-aligned cap, k<=64), else the XLA
     # decode-then-matmul scan; "pallas" | "pallas_interpret" | "xla" force
@@ -718,17 +720,13 @@ def _pq_search(
             keep = filter_keep(filter_bits, filter_nbits, indices).astype(
                 jnp.int32
             )
-        out_d, out_pos = ivf_scan.fused_list_scan_topk(
-            recon_cache, list_sizes, bucket_list, qv, qaux,
+        out_d, cand_i = ivf_scan.fused_list_scan_topk(
+            recon_cache, indices, list_sizes, bucket_list, qv, qaux,
             None if ip else rec_norms,   # IP kernel never reads norms
             keep,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
             interpret=scan_impl == "pallas_interpret",
-        )
-        ids_nb = indices[bucket_list]                        # [nb, cap]
-        cand_i = jnp.take_along_axis(
-            ids_nb[:, None, :], jnp.minimum(out_pos, cap - 1), axis=2
-        )                                                     # [nb, G, kl]
+        )                                                    # ids in-kernel
         if ip:
             qc = jnp.einsum(
                 "bgd,bd->bg", q_rot[qsafe_b], centers_rot[bucket_list],
@@ -742,6 +740,8 @@ def _pq_search(
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
             n_probes, kl, k, select_min, sentinel,
+            approx=local_recall_target < 1.0,
+            recall_target=local_recall_target,
         )
         out_i = jnp.where(out_d == sentinel, -1, out_i)
         if metric == DistanceType.L2SqrtExpanded:
@@ -819,6 +819,8 @@ def _pq_search(
         cand_i.reshape(nb_pad, group, kl),
         pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
         select_min, sentinel,
+        approx=local_recall_target < 1.0,
+        recall_target=local_recall_target,
     )
     # fewer than k valid candidates: id must be -1 (documented contract);
     # otherwise refine re-scores filtered-out ids back into the top-k

@@ -11,6 +11,13 @@ a k-pass vectorized min-extraction; its approx mode mirrors
 lax.approx_min_k's lane-binning (one candidate per 128-lane bin, then
 extract from bins — collision loss ~C(k,2)/128 per list).
 
+The kernel resolves stored ids in-kernel: the list's id row is DMA'd
+alongside the block and the extraction emits global ids directly (the
+argmin's position-select runs on the id row instead of a column iota).
+Returning positions instead and mapping them outside costs a
+[nb, G, k]-element take_along_axis — per-element gathers that measured
+~10x the whole kernel's runtime at SIFT-1M scale.
+
 Inputs are produced by ``ivf_flat.bucketize_pairs``: ``bucket_list`` maps
 grid step -> list id, ``qv`` holds the pre-gathered query group per step.
 """
@@ -29,42 +36,68 @@ L2 = 0        # dist = ||q||^2 + ||x||^2 - 2 q.x   (needs norms + qaux=||q||^2)
 IP = 1        # dist = -q.x  (caller negates back; select-min internally)
 COSINE = 2    # dist = 1 - q.x / (||q|| ||x||)     (needs norms=||x||^2, qaux=||q||)
 
+# id emitted for invalid (inf-distance) slots; matches the library-wide
+# "-1 = no neighbor" contract
+_INVALID = -1
 
-def _extract_topk(dist, col, k: int, cap: int, outd_ref, outp_ref):
-    """k-pass min extraction over [G, cap]; writes [k, G] rows."""
+
+def _extract_topk(dist, ids_row, k: int, outd_ref, outi_ref):
+    """k-pass min extraction over [G, cap]; emits [G, k] dists + ids."""
+    G, cap = dist.shape
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    col = jax.lax.broadcasted_iota(jnp.int32, (G, cap), 1)
+    out_d, out_i = [], []
     for j in range(k):
         m = jnp.min(dist, axis=1)                              # [G]
         eq = dist == m[:, None]
         pos = jnp.min(jnp.where(eq, col, cap), axis=1)         # [G]
-        outd_ref[0, j, :] = m
-        outp_ref[0, j, :] = pos
+        sel = jnp.where(col == pos[:, None], ids_row[None, :], big)
+        out_d.append(m)
+        out_i.append(jnp.min(sel, axis=1))
         if j + 1 < k:
             dist = jnp.where(col == pos[:, None], jnp.inf, dist)
+    d = jnp.stack(out_d, axis=1)                               # [G, k]
+    i = jnp.stack(out_i, axis=1)
+    outd_ref[0] = d
+    outi_ref[0] = jnp.where(jnp.isinf(d), _INVALID, i)
 
 
-def _extract_topk_binned(dist, k: int, cap: int, outd_ref, outp_ref):
+def _extract_topk_binned(dist, ids_row, k: int, cap: int, outd_ref, outi_ref):
     """Lane-binned approximate extraction: fold [G, cap] into 128 bins
     (bin b holds min over columns == b mod 128), then extract k from the
     bins. One top-k candidate is lost per same-bin collision among the
-    true top-k (expected C(k,2)/128 items)."""
+    true top-k (expected C(k,2)/128 per list)."""
     G = dist.shape[0]
     nch = cap // 128
     lane = jax.lax.broadcasted_iota(jnp.int32, (G, 128), 1)
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
     binmin = jnp.full((G, 128), jnp.inf, jnp.float32)
+    binid = jnp.full((G, 128), _INVALID, jnp.int32)
     binpos = jnp.zeros((G, 128), jnp.int32)
     for c in range(nch):
         chunk = dist[:, c * 128:(c + 1) * 128]
+        ids_c = ids_row[c * 128:(c + 1) * 128]
         better = chunk < binmin
         binmin = jnp.where(better, chunk, binmin)
+        binid = jnp.where(better, ids_c[None, :], binid)
         binpos = jnp.where(better, lane + c * 128, binpos)
+    out_d, out_i = [], []
     for j in range(k):
         m = jnp.min(binmin, axis=1)
         eq = binmin == m[:, None]
         pos = jnp.min(jnp.where(eq, binpos, cap), axis=1)
-        outd_ref[0, j, :] = m
-        outp_ref[0, j, :] = pos
+        # eq guard: untouched bins share binpos=0, so a bare binpos==pos
+        # match would sweep them in (emitting their -1 id) whenever the
+        # winner sits at column 0
+        hit = eq & (binpos == pos[:, None])
+        out_d.append(m)
+        out_i.append(jnp.min(jnp.where(hit, binid, big), axis=1))
         if j + 1 < k:
-            binmin = jnp.where(binpos == pos[:, None], jnp.inf, binmin)
+            binmin = jnp.where(hit, jnp.inf, binmin)
+    d = jnp.stack(out_d, axis=1)
+    i = jnp.stack(out_i, axis=1)
+    outd_ref[0] = d
+    outi_ref[0] = jnp.where(jnp.isinf(d), _INVALID, i)
 
 
 def _scan_kernel(
@@ -73,11 +106,12 @@ def _scan_kernel(
 ):
     refs = list(refs)
     storage_ref = refs.pop(0)
+    ids_ref = refs.pop(0)
     norms_ref = refs.pop(0) if has_norms else None
     keep_ref = refs.pop(0) if has_filter else None
     qv_ref = refs.pop(0)
     qaux_ref = refs.pop(0) if metric_kind != IP else None
-    outd_ref, outp_ref = refs
+    outd_ref, outi_ref = refs
 
     i = pl.program_id(0)
     size = ls_ref[bl_ref[i]]
@@ -106,10 +140,11 @@ def _scan_kernel(
     if has_filter:
         valid = valid & (keep_ref[0, 0][None, :] > 0)
     dist = jnp.where(valid, dist, jnp.inf)
+    ids_row = ids_ref[0, 0]                             # [cap] int32
     if approx and cap % 128 == 0 and cap > 128 and k <= 64:
-        _extract_topk_binned(dist, k, cap, outd_ref, outp_ref)
+        _extract_topk_binned(dist, ids_row, k, cap, outd_ref, outi_ref)
     else:
-        _extract_topk(dist, col, k, cap, outd_ref, outp_ref)
+        _extract_topk(dist, ids_row, k, outd_ref, outi_ref)
 
 
 @functools.partial(
@@ -118,6 +153,7 @@ def _scan_kernel(
 )
 def fused_list_scan_topk(
     storage,        # [C, cap, d] source dtype
+    indices,        # [C, cap] int32 stored global ids
     list_sizes,     # [C] int32
     bucket_list,    # [nb] int32
     qv,             # [nb, G, d] bf16 (pre-gathered query groups)
@@ -133,11 +169,11 @@ def fused_list_scan_topk(
     """Scan each bucket's list block against its query group and return the
     per-pair top-k in min-space.
 
-    Returns (out_d [nb, G, k] f32, out_pos [nb, G, k] int32) where out_pos
-    is the *column* within the list (caller maps to stored ids). For IP the
+    Returns (out_d [nb, G, k] f32, out_i [nb, G, k] int32) where out_i
+    holds the stored *global ids* (resolved in-kernel). For IP the
     distances are negated scores — negate back after the merge. Invalid
-    tail entries (list shorter than k after filtering) come back as +inf
-    with an arbitrary position — mask on inf.
+    tail entries (list shorter than k after filtering) come back as
+    (+inf, -1) — mask on either.
     """
     C, cap, d = storage.shape
     nb, G, _ = qv.shape
@@ -147,9 +183,10 @@ def fused_list_scan_topk(
     # 2-D per-row arrays are lifted to [*, 1, n] so each block equals the
     # full trailing dims (the Mosaic block rule: last two dims divisible by
     # (8, 128) or equal to the array's)
-    inputs = [storage]
+    inputs = [storage, indices.reshape(C, 1, cap)]
     in_specs = [
         pl.BlockSpec((1, cap, d), lambda i, bl, ls: (bl[i], 0, 0)),
+        pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0)),
     ]
     if has_norms:
         inputs.append(norms.reshape(C, 1, cap))
@@ -174,22 +211,21 @@ def fused_list_scan_topk(
         k=k, metric_kind=metric_kind, approx=approx,
         has_norms=has_norms, has_filter=has_filter,
     )
-    out_d, out_p = pl.pallas_call(
+    out_d, out_i = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(nb,),
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, k, G), lambda i, bl, ls: (i, 0, 0)),
-                pl.BlockSpec((1, k, G), lambda i, bl, ls: (i, 0, 0)),
+                pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
+                pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((nb, k, G), jnp.float32),
-            jax.ShapeDtypeStruct((nb, k, G), jnp.int32),
+            jax.ShapeDtypeStruct((nb, G, k), jnp.float32),
+            jax.ShapeDtypeStruct((nb, G, k), jnp.int32),
         ],
         interpret=interpret,
     )(bucket_list, list_sizes, *inputs)
-    # [nb, k, G] -> [nb, G, k]
-    return out_d.transpose(0, 2, 1), out_p.transpose(0, 2, 1)
+    return out_d, out_i

@@ -246,3 +246,20 @@ def test_search_fast_defaults(dataset):
     _, idx = ivf_flat.search(sp, index, q, k)
     _, want = naive_knn(q, x, k)
     assert eval_recall(np.asarray(idx), want) > 0.9
+
+
+def test_pallas_binned_short_list_ids(dataset):
+    """Regression: the binned (approx) extraction must emit real ids even
+    when the winner sits at list column 0 and the list is shorter than
+    cap (untouched bins share binpos=0 and must not leak their -1 id)."""
+    x, q = dataset
+    k = 10
+    index = _build(x, n_lists=64)  # short, uneven lists vs padded cap
+    sp = ivf_flat.SearchParams(n_probes=16, query_group=64, bucket_batch=4,
+                               compute_dtype="f32",
+                               local_recall_target=0.95,  # approx path
+                               scan_impl="pallas_interpret")
+    d, i = ivf_flat.search(sp, index, q[:50], k)
+    d, i = np.asarray(d), np.asarray(i)
+    assert not ((i == -1) & np.isfinite(d)).any()
+    assert (i >= 0).all()  # plenty of candidates here — no -1 expected
