@@ -26,6 +26,30 @@ Layer map (mirrors the reference's cpp/include/raft/<layer> — SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: index builds compile a handful of large
+# EM/scan programs (~70 s cold on the tunnelled TPU, ~0 warm); caching them
+# on disk makes every process after the first pay only runtime. Opt out
+# with RAFT_TPU_NO_COMPILE_CACHE=1.
+if (not _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE")
+        and not _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        and getattr(_jax.config, "jax_compilation_cache_dir", None) is None):
+    # never override a cache the user already configured
+    try:
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.environ.get(
+                "RAFT_TPU_COMPILE_CACHE",
+                _os.path.join(_os.path.expanduser("~"), ".raft_tpu_cache"),
+            ),
+        )
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
 from raft_tpu.core.resources import Resources, DeviceResources
 
 __all__ = ["Resources", "DeviceResources", "__version__"]

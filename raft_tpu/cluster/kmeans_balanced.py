@@ -327,30 +327,37 @@ def build_hierarchical(
     real work happens in full-dataset balancing EM iterations, which are a
     single compiled program. On TPU the full predict GEMM is cheap enough
     that the hierarchy's FLOP savings don't matter; compile time does.
+
+    The dataset NEVER crosses the host boundary: only small index/label
+    arrays do (a full-array ``np.asarray`` round-trip measured ~10 s of
+    tunnel traffic at 1M x 96 — it dominated every index build).
     """
-    x_np = np.asarray(x, dtype=np.float32)
-    n, d = x_np.shape
+    x_dev = jnp.asarray(x)
+    if x_dev.dtype != jnp.float32:
+        x_dev = x_dev.astype(jnp.float32)
+    n, d = x_dev.shape
     key = jax.random.PRNGKey(seed)
     rng = np.random.default_rng(seed)
 
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     if n_clusters <= n_meso or n <= 4 * n_clusters:
         centers, _ = build_clusters(
-            x_np, n_clusters, n_iters, key, metric,
+            x_dev, n_clusters, n_iters, key, metric,
             compute_dtype=compute_dtype,
         )
         return centers
 
-    # --- meso pass on a bounded subsample --------------------------------
+    # --- meso pass on a bounded subsample (device-side gather) -----------
     meso_sample = min(n, max(64 * n_meso, 1 << 14))
     sel = rng.choice(n, meso_sample, replace=False)
+    x_meso = x_dev[jnp.asarray(sel)]
     key, k_meso = jax.random.split(key)
     meso_centers, _ = build_clusters(
-        x_np[sel], n_meso, max(n_iters // 2, 4), k_meso, metric,
+        x_meso, n_meso, max(n_iters // 2, 4), k_meso, metric,
         compute_dtype=compute_dtype,
     )
-    meso_labels = np.asarray(
-        _predict_metric(jnp.asarray(x_np[sel]), meso_centers, int(metric),
+    meso_labels = np.asarray(                       # [meso_sample] — small
+        _predict_metric(x_meso, meso_centers, int(metric),
                         min(meso_sample, 1 << 16), compute_dtype)
     )
     meso_sizes = np.bincount(meso_labels, minlength=n_meso)
@@ -359,33 +366,31 @@ def build_hierarchical(
     # --- fine init: fixed-size subsample per mesocluster, ALL fine fits
     # batched into one compiled program (build_clusters_batched) — the
     # per-meso host loop of separate fits costs one dispatch round-trip
-    # per mesocluster, which dominates on a tunnelled device ------------
+    # per mesocluster, which dominates on a tunnelled device. Row picking
+    # happens on host over the small label array; rows are gathered on
+    # device in one shot. ------------------------------------------------
     c_max = int(fine_counts.max())
     S = max(32 * c_max, 256)  # one shared shape for all fine fits
     active = [m for m in range(n_meso) if fine_counts[m] > 0]
-    rows_all = np.empty((len(active), S, d), np.float32)
+    pick = np.empty((len(active), S), np.int64)
     for bi, m in enumerate(active):
         members = np.nonzero(meso_labels == m)[0]
         if members.size == 0:
-            rows_all[bi] = x_np[rng.choice(n, S, replace=n < S)]
+            pick[bi] = rng.choice(n, S, replace=n < S)
         else:
-            rows_all[bi] = x_np[
-                sel[rng.choice(members, S, replace=members.size < S)]
-            ]
+            pick[bi] = sel[rng.choice(members, S, replace=members.size < S)]
+    rows_all = x_dev[jnp.asarray(pick.reshape(-1))].reshape(len(active), S, d)
     key, sub = jax.random.split(key)
     # few iterations — this is only an init for the balancing phase
-    books = build_clusters_batched(
-        jnp.asarray(rows_all), c_max, 4, sub, int(metric)
-    )
-    books_np = np.asarray(books)                      # [B, c_max, d]
-    centers = jnp.asarray(np.concatenate(
-        [books_np[bi, : int(fine_counts[m])] for bi, m in enumerate(active)],
+    books = build_clusters_batched(rows_all, c_max, 4, sub, int(metric))
+    # slice each book's share on device; concatenate stays on device
+    centers = jnp.concatenate(
+        [books[bi, : int(fine_counts[m])] for bi, m in enumerate(active)],
         axis=0,
-    ))
+    )
     assert centers.shape[0] == n_clusters
 
     # --- full-dataset balancing EM (the real training) -------------------
-    x_dev = jnp.asarray(x_np)
     key, sub = jax.random.split(key)
     centers, _ = balancing_em_iters(
         x_dev, centers, max(n_iters // 2, 2), n_clusters, sub, metric,

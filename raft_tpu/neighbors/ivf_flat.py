@@ -474,9 +474,13 @@ def _ivf_search(
 
     if scan_impl.startswith("pallas"):
         # fused Pallas kernel: list blocks DMA'd by scalar-prefetch index,
-        # distances + top-k stay in VMEM (raft_tpu.ops.ivf_scan)
+        # distances + top-k stay in VMEM (raft_tpu.ops.ivf_scan); k <= 256
+        # per list (the R-deep binned extraction's capacity — the
+        # reference's fused path similarly caps at kMaxCapacity=256,
+        # ivf_pq_search.cuh:439 manage_local_topk)
         from raft_tpu.ops import ivf_scan
 
+        kl = min(kl, 256)
         qsafe_b = jnp.maximum(bucket_q, 0)
         qv = q32[qsafe_b].astype(mm)                         # [nb, G, d]
         if metric == DistanceType.InnerProduct:
@@ -603,6 +607,12 @@ def search(
     scan_impl = _resolve_scan_impl(
         str(search_params.scan_impl), cap, min(int(k), cap)
     )
+    if scan_impl.startswith("pallas") and k > n_probes * min(cap, 256):
+        raise ValueError(
+            f"k={k} exceeds the fused kernel's candidate pool "
+            f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
+            "n_probes or use scan_impl='xla'"
+        )
     group = adaptive_query_group(
         int(queries.shape[0]), n_probes, index.n_lists,
         int(search_params.query_group),
@@ -629,8 +639,10 @@ def search(
 
 def _resolve_scan_impl(requested: str, cap: int, kl: int) -> str:
     """Pick the scan backend: the fused Pallas kernel needs a TPU, a
-    lane-aligned list capacity and a small k; everything else runs the
-    XLA bucketized scan."""
+    lane-aligned list capacity and k <= 64 (exact in-kernel extraction)
+    — or k <= 256 on the approx path, where the R-deep lane binning
+    (ivf_scan._extract_topk_binned_deep) holds 512 candidates per list;
+    everything else runs the XLA bucketized scan."""
     if requested != "auto":
         return requested
     try:
@@ -638,6 +650,10 @@ def _resolve_scan_impl(requested: str, cap: int, kl: int) -> str:
     except Exception:  # noqa: BLE001 - backend probing must never fail search
         platform = "cpu"
     on_tpu = platform in ("tpu", "axon")
+    # k > 64 stays on the XLA scan even though the kernel's R-deep binned
+    # extraction supports k <= 256 (force with scan_impl="pallas"): the
+    # k-pass unrolled extraction measured ~7x slower end-to-end than the
+    # XLA path at k=130 (CAGRA self-search, SIFT-100k)
     if on_tpu and cap % 128 == 0 and kl <= 64:
         return "pallas"
     return "xla"

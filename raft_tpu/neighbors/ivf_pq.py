@@ -111,11 +111,13 @@ class SearchParams:
 
     n_probes: int = 20
     # Decode/scoring operand dtype ladder (the reference's LUT dtype ladder,
-    # ivf_pq_types.hpp lut_dtype fp32/fp16/fp8): "f32" | "bf16" | "f8"
-    # (float8_e4m3 decode, matmul still runs in the compute dtype). jnp
-    # dtypes are accepted. "f8"/"bf16" here lowers the decode precision even
-    # when compute_dtype is "f32".
-    lut_dtype: object = "f32"
+    # ivf_pq_types.hpp lut_dtype fp32/fp16/fp8): "auto" | "i8" | "f32" |
+    # "bf16" | "f8". "auto" (default) scans the int8 decoded-residual
+    # cache when the index carries one (the fast path; finer than the
+    # reference's fp8 LUT) and falls back to f32 decode. "i8" requires the
+    # cache. Explicit "f32"/"bf16"/"f8" force the decode-then-matmul scan
+    # at that precision (jnp dtypes accepted).
+    lut_dtype: object = "auto"
     # Distance accumulation/report dtype: "f32" | "bf16" (the reference's
     # internal_distance_dtype fp32/fp16 analog).
     internal_distance_dtype: object = "f32"
@@ -704,6 +706,7 @@ def _pq_search(
         # dequant scale folded into them (dots then equal q_res . recon)
         from raft_tpu.ops import ivf_scan
 
+        kl = min(kl, 256)  # in-kernel extraction budget (see ivf_flat)
         qsafe_b = jnp.maximum(bucket_q, 0)
         q_res = q_rot[qsafe_b] - centers_rot[bucket_list][:, None, :]
         qv = (q_res * recon_scale).astype(jnp.bfloat16)      # [nb, G, rot]
@@ -750,13 +753,21 @@ def _pq_search(
 
     def body(_, inp):
         bl, bq = inp  # [bb], [bb, group]
-        blk_codes = unpack_codes(codes[bl], p, pq_bits)  # [bb, cap, p]
         ids = indices[bl]
         sizes = list_sizes[bl]
         rn = rec_norms[bl]               # [bb, cap]
-        if codebook_kind == codebook_gen.PER_SUBSPACE:
+        if recon_cache is not None and lut_dtype in ("auto", "i8"):
+            # int8 decoded-residual cache: a contiguous block load + cast
+            # replaces the per-element codebook gather (the decode gather
+            # measured ~5x the block matmul at CAGRA-build shapes). Only
+            # taken when lut_dtype allows it — explicit f32/bf16/f8 get
+            # the true decode at that precision
+            recon = recon_cache[bl].astype(jnp.float32) * recon_scale
+        elif codebook_kind == codebook_gen.PER_SUBSPACE:
+            blk_codes = unpack_codes(codes[bl], p, pq_bits)  # [bb, cap, p]
             recon = _decode_gather(blk_codes, pq_centers, codebook_kind)
         else:
+            blk_codes = unpack_codes(codes[bl], p, pq_bits)
             recon = _decode_gather(
                 blk_codes, pq_centers, codebook_kind, bl[:, None]
             )                            # [bb, cap, rot_dim]
@@ -854,7 +865,7 @@ def search(
         index.pq_centers, index.codes, index.indices, index.list_sizes,
         index.rec_norms, None if bits is None else bits.bits,
         index.recon_cache, jnp.float32(index.recon_scale),
-    )
+    )  # recon_cache rides along; the body gates its use on lut_dtype
     from raft_tpu.neighbors.ivf_flat import (
         adaptive_query_group, _resolve_scan_impl,
     )
@@ -864,15 +875,29 @@ def search(
         int(search_params.query_group),
     )
     requested = str(search_params.scan_impl)
-    if index.recon_cache is None:
+    lut = _norm_dtype_knob(search_params.lut_dtype)
+    use_cache = index.recon_cache is not None and lut in ("auto", "i8")
+    if lut == "i8" and index.recon_cache is None:
+        raise ValueError(
+            "lut_dtype='i8' needs the decoded-residual cache; build with "
+            "cache_decoded=True (and within _CACHE_BUDGET)"
+        )
+    if not use_cache:
         if requested.startswith("pallas"):
             raise ValueError(
-                "scan_impl=%r needs the decoded-residual cache; build with "
-                "cache_decoded=True (and within _CACHE_BUDGET)" % requested
+                "scan_impl=%r needs the decoded-residual cache (build with "
+                "cache_decoded=True and keep lut_dtype='auto'/'i8')"
+                % requested
             )
         impl = "xla"
     else:
         impl = _resolve_scan_impl(requested, cap, min(k, cap))
+        if impl.startswith("pallas") and k > n_probes * min(cap, 256):
+            raise ValueError(
+                f"k={k} exceeds the fused kernel's candidate pool "
+                f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
+                "n_probes or use scan_impl='xla'"
+            )
     return _pq_search(
         arrays,
         int(k),
@@ -884,7 +909,7 @@ def search(
         0 if bits is None else int(bits.n_bits),
         str(search_params.compute_dtype),
         float(search_params.local_recall_target),
-        _norm_dtype_knob(search_params.lut_dtype),
+        lut,
         _norm_dtype_knob(search_params.internal_distance_dtype),
         int(index.pq_dim),
         int(index.pq_bits),
@@ -897,6 +922,8 @@ def _norm_dtype_knob(v) -> str:
     'f32' | 'bf16' | 'f8'."""
     if isinstance(v, str):
         s = v.lower()
+        if s in ("auto", "i8", "int8"):
+            return "auto" if s == "auto" else "i8"
         if s in ("f32", "float32", "fp32"):
             return "f32"
         if s in ("bf16", "bfloat16", "f16", "fp16", "float16"):

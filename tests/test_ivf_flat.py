@@ -263,3 +263,26 @@ def test_pallas_binned_short_list_ids(dataset):
     d, i = np.asarray(d), np.asarray(i)
     assert not ((i == -1) & np.isfinite(d)).any()
     assert (i >= 0).all()  # plenty of candidates here — no -1 expected
+
+
+def test_pallas_large_k_deep_binned(dataset):
+    """64 < k <= 256 on the fused approx path uses the R-deep lane
+    binning; its per-list loss is ~C(k,R+1)/128^R, so ids must still
+    near-match the exact XLA scan."""
+    x, q = dataset
+    k = 100
+    index = _build(x)
+    kw = dict(n_probes=16, query_group=64, bucket_batch=4,
+              compute_dtype="f32")
+    _, i_x = ivf_flat.search(
+        ivf_flat.SearchParams(scan_impl="xla", local_recall_target=1.0,
+                              **kw), index, q[:30], k)
+    _, i_p = ivf_flat.search(
+        ivf_flat.SearchParams(scan_impl="pallas_interpret",
+                              local_recall_target=0.95, **kw),
+        index, q[:30], k)
+    i_x, i_p = np.asarray(i_x), np.asarray(i_p)
+    overlap = np.mean([
+        len(set(i_x[r]) & set(i_p[r])) / k for r in range(i_x.shape[0])
+    ])
+    assert overlap > 0.9, overlap

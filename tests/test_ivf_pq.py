@@ -264,3 +264,28 @@ def test_cache_disabled_matches(dataset):
     assert index.recon_cache is None
     d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), index, q[:20], 5)
     assert np.asarray(i).shape == (20, 5)
+
+
+def test_lut_dtype_f32_forces_true_decode(dataset):
+    """Explicit lut_dtype='f32' must bypass the int8 cache (true decode),
+    and 'i8' must require the cache."""
+    x, q = dataset
+    index = _build(x)
+    kw = dict(n_probes=8, local_recall_target=1.0, compute_dtype="f32")
+    k = 10
+    d_c, i_c = ivf_pq.search(
+        ivf_pq.SearchParams(lut_dtype="auto", scan_impl="xla", **kw),
+        index, q[:50], k)
+    d_f, i_f = ivf_pq.search(
+        ivf_pq.SearchParams(lut_dtype="f32", scan_impl="xla", **kw),
+        index, q[:50], k)
+    # int8 cache freely reorders PQ near-ties; equal oracle recall is the
+    # functional requirement
+    _, want = naive_knn(q[:50], x, k)
+    rc = eval_recall(np.asarray(i_c), want)
+    rf = eval_recall(np.asarray(i_f), want)
+    assert rc > rf - 0.05, (rc, rf)
+    nocache = _build(x, cache_decoded=False)
+    with pytest.raises(ValueError):
+        ivf_pq.search(ivf_pq.SearchParams(lut_dtype="i8", **kw),
+                      nocache, q[:5], 5)
