@@ -52,12 +52,14 @@ def _sift_like(n, d, seed=0, intrinsic=16):
     (real SIFT has intrinsic dim ~15 in 128 ambient dims). A
     few-isolated-blobs mixture is *adversarial* for graph ANN (the KNN
     graph disconnects); this matches realistic ANN difficulty instead.
-    Delegates to the shared generator so config-driven runs see the same
-    bytes for the same spec."""
-    from raft_tpu.bench.run import synthetic_dataset
+    Generated ON DEVICE (synthetic_dataset_device): the dev tunnel moves
+    host arrays at ~20 MB/s, so host generation was charging minutes of
+    fake transfer time to every build. Ground truth is computed from
+    these same arrays, so recall stays consistent."""
+    from raft_tpu.bench.run import synthetic_dataset_device
 
-    base, _ = synthetic_dataset(n, d, n_queries=1, seed=seed,
-                                intrinsic_dim=intrinsic)
+    base, _ = synthetic_dataset_device(n, d, n_queries=1, seed=seed,
+                                       intrinsic_dim=intrinsic)
     return base
 
 

@@ -329,9 +329,9 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
     the per-batch slab, and the compressed codes ever live in HBM.
     """
     stream = batch_size is not None
-    if stream:
+    if stream and not isinstance(dataset, jax.Array):
         dataset = np.asarray(dataset)
-    else:
+    elif not stream:
         dataset = jnp.asarray(dataset)
     n, dim = dataset.shape
     n_lists = int(params.n_lists)
@@ -428,15 +428,35 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
 
     # streaming encode: fixed-shape batches keep one compiled encoder;
-    # only compressed codes accumulate on device
-    from raft_tpu.utils.batch import BatchLoadIterator
-
+    # only compressed codes accumulate on device. Device-resident
+    # datasets are sliced in place (a host round-trip through the
+    # BatchLoadIterator would cost minutes over the dev tunnel).
     parts_labels, parts_codes = [], []
-    for off, batch in BatchLoadIterator(dataset, int(batch_size),
-                                        pad_to_full=True):
-        lab, packed = encode(index, batch)
-        parts_labels.append(lab)
-        parts_codes.append(packed)
+    if isinstance(dataset, jax.Array):
+        bs = int(batch_size)
+        for off in range(0, n, bs):
+            # dynamic_slice clamps an out-of-bounds start, producing the
+            # shifted static-shape tail window the `keep` logic expects
+            batch = jax.lax.dynamic_slice_in_dim(
+                dataset, off, min(bs, n), axis=0,
+            )
+            lab, packed = encode(index, batch)
+            if off + bs > n and n >= bs:
+                # final window was shifted back to keep a static shape;
+                # keep only the genuinely-new tail rows
+                keep = n - off
+                lab = lab[-keep:]
+                packed = packed[-keep:]
+            parts_labels.append(lab)
+            parts_codes.append(packed)
+    else:
+        from raft_tpu.utils.batch import BatchLoadIterator
+
+        for off, batch in BatchLoadIterator(dataset, int(batch_size),
+                                            pad_to_full=True):
+            lab, packed = encode(index, batch)
+            parts_labels.append(lab)
+            parts_codes.append(packed)
     labels = jnp.concatenate(parts_labels)[:n]
     packed = jnp.concatenate(parts_codes)[:n]
     ids = jnp.arange(n, dtype=jnp.int32)

@@ -289,3 +289,22 @@ def test_lut_dtype_f32_forces_true_decode(dataset):
     with pytest.raises(ValueError):
         ivf_pq.search(ivf_pq.SearchParams(lut_dtype="i8", **kw),
                       nocache, q[:5], 5)
+
+
+def test_streaming_build_device_array(dataset):
+    """batch_size streaming over a DEVICE-resident dataset (sliced in
+    place, incl. the shifted static-shape tail window) equals the dense
+    build."""
+    import jax.numpy as jnp
+
+    x, q = dataset
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10)
+    dense = ivf_pq.build(params, x)
+    streamed = ivf_pq.build(params, jnp.asarray(x), batch_size=1792)  # 6000 % 1792 != 0
+    np.testing.assert_array_equal(
+        np.asarray(dense.list_sizes), np.asarray(streamed.list_sizes)
+    )
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, i_d = ivf_pq.search(sp, dense, q[:50], 10)
+    _, i_s = ivf_pq.search(sp, streamed, q[:50], 10)
+    np.testing.assert_array_equal(np.asarray(i_d), np.asarray(i_s))
