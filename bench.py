@@ -135,7 +135,8 @@ def bench_cagra_sift1m(results):
     )
     np.asarray(index.graph[0, 0])  # sync build
     results["cagra_build_s"] = round(time.time() - t0, 1)
-    sp = cagra.SearchParams()
+    # n_seeds=64: measured +20% QPS for -0.002 recall on this manifold
+    sp = cagra.SearchParams(n_seeds=64)
     dist, idx = cagra.search(sp, index, q, k)
     sub = 1000
     _, bf_idx = brute_force.knn(q[:sub], x, k)

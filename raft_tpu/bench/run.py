@@ -113,9 +113,11 @@ def synthetic_dataset_device(n, dim, n_queries, seed=0, intrinsic_dim=16,
     tunnelled dev TPU, host->device of a 10M-row dataset costs minutes at
     ~20 MB/s while real TPU hosts move it over PCIe in under a second —
     device-side generation keeps benchmarks about the framework, not the
-    tunnel. Generated in fixed-shape row blocks so transient HBM stays at
-    ``block`` rows regardless of n (one full-size program would OOM past
-    ~10M rows). Ground truth must be computed from the returned arrays."""
+    tunnel. Generated in fixed-shape row blocks so each generator
+    program's temporaries stay at ``block`` rows; the assembled output
+    (plus up to one extra copy during the final concatenate) still needs
+    ~2x the dataset's bytes of HBM headroom — size n accordingly. Ground
+    truth must be computed from the returned arrays."""
     import jax
     import jax.numpy as jnp
 

@@ -366,8 +366,11 @@ def bucketize_pairs(
     order = jnp.argsort(pair_l, stable=True)
     sl = pair_l[order]
     sq = pair_q[order]
-    counts = jnp.bincount(pair_l, length=C)
-    starts = jnp.cumsum(counts) - counts
+    # per-list counts from the sorted keys (binary search beats a
+    # 640k-element bincount scatter-add by ~7 ms at SIFT-1M shapes)
+    bounds = jnp.searchsorted(sl, jnp.arange(C + 1, dtype=jnp.int32))
+    counts = jnp.diff(bounds)
+    starts = bounds[:-1]
     rank_in_list = jnp.arange(total) - starts[sl]
     nb_per_list = -(-counts // group)  # ceil
     bucket_start = jnp.cumsum(nb_per_list) - nb_per_list
@@ -376,13 +379,26 @@ def bucketize_pairs(
 
     n_buckets = total // group + C + 1  # static upper bound on used buckets
     nb_pad = round_up_to_multiple(n_buckets, bucket_batch)
-    bucket_list = jnp.zeros((nb_pad,), jnp.int32).at[pair_bucket].set(sl)
-    bucket_q = (
-        jnp.full((nb_pad * group,), -1, jnp.int32)
-        .at[pair_bucket * group + pair_pos]
-        .set(sq)
-    ).reshape(nb_pad, group)
-    return bucket_list, bucket_q, pair_bucket, pair_pos, order, total, nb_pad
+    # bucket tables by GATHER, not scatter (element scatters measured 2x
+    # the equivalent gathers here): each list owns the contiguous bucket
+    # range [bucket_start[l], bucket_start[l] + nb_per_list[l]), so a
+    # bucket's list id is a binary search and its query slots read the
+    # sorted pair array at starts[l] + rel_bucket*group + pos
+    b_idx = jnp.arange(nb_pad, dtype=jnp.int32)
+    bl = (
+        jnp.searchsorted(bucket_start, b_idx, side="right").astype(jnp.int32)
+        - 1
+    )
+    bl = jnp.clip(bl, 0, C - 1)
+    rel_b = b_idx - bucket_start[bl]
+    src = (starts[bl] + rel_b * group)[:, None] + jnp.arange(
+        group, dtype=jnp.int32
+    )[None, :]
+    valid = src < (starts[bl] + counts[bl])[:, None]
+    bucket_q = jnp.where(
+        valid, sq[jnp.clip(src, 0, total - 1)], -1
+    )
+    return bl, bucket_q, pair_bucket, pair_pos, order, total, nb_pad
 
 
 def unbucketize_merge(
