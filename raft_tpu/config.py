@@ -1,0 +1,55 @@
+"""Global output-format hook (pylibraft config.py:20 ``set_output_as``
+analog).
+
+raft_tpu functions natively return ``jax.Array``. Consumers embedding the
+library in torch/numpy pipelines can install a process-wide converter so
+``raft_tpu.config.as_output(x)`` (used by the interop surfaces, e.g.
+``device_ndarray``) hands back their framework's arrays — zero-copy via
+DLPack where the frameworks allow it.
+
+    import raft_tpu.config as config
+    config.set_output_as("torch")       # or "numpy" | "jax" | callable
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+_output_as: Union[str, Callable] = "jax"
+
+
+def set_output_as(kind: Union[str, Callable]) -> None:
+    """Install the global output converter: "jax" (default, no-op),
+    "numpy", "torch", or any callable ``jax.Array -> Any``."""
+    global _output_as
+    if not callable(kind) and kind not in ("jax", "numpy", "torch"):
+        raise ValueError(
+            f"set_output_as expects 'jax' | 'numpy' | 'torch' | callable, "
+            f"got {kind!r}"
+        )
+    _output_as = kind
+
+
+def get_output_as() -> Union[str, Callable]:
+    return _output_as
+
+
+def as_output(x):
+    """Convert a jax array per the installed hook."""
+    if callable(_output_as):
+        return _output_as(x)
+    if _output_as == "jax":
+        return x
+    if _output_as == "numpy":
+        import numpy as np
+
+        return np.asarray(x)
+    # torch — zero-copy via DLPack when the device allows, else via host
+    import torch
+
+    try:
+        return torch.from_dlpack(x)
+    except Exception:  # noqa: BLE001 - cross-device fallback
+        import numpy as np
+
+        return torch.from_numpy(np.asarray(x))

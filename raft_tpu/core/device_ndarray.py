@@ -73,6 +73,14 @@ class device_ndarray:
     def jax_array(self) -> jax.Array:
         return self._array
 
+    def get(self):
+        """The array in the globally configured output format
+        (raft_tpu.config.set_output_as — pylibraft's output hook analog);
+        default: the underlying jax.Array."""
+        from raft_tpu.config import as_output
+
+        return as_output(self._array)
+
     def copy_to_host(self) -> np.ndarray:
         """Device → host numpy copy (device_ndarray.copy_to_host)."""
         return np.asarray(self._array)

@@ -748,6 +748,9 @@ def search(
     queries = jnp.asarray(queries)
     itopk = max(int(search_params.itopk_size), k)
     width = max(1, int(search_params.search_width))
+    n_seeds = int(search_params.n_seeds)
+    if n_seeds > 0:
+        n_seeds = max(n_seeds, k)   # at least k live candidates to return
     iters = int(search_params.max_iterations)
     if iters <= 0:
         # auto (reference search_plan.cuh: plan-derived): enough pickups to
@@ -769,7 +772,7 @@ def search(
             width,
             iters,
             int(index.metric),
-            int(search_params.n_seeds),
+            n_seeds,
         )
     return _beam_search(
         queries,
@@ -782,7 +785,7 @@ def search(
         iters,
         int(index.metric),
         "f32" if dtype == "auto" else dtype,
-        int(search_params.n_seeds),
+        n_seeds,
     )
 
 

@@ -352,7 +352,8 @@ def bucketize_pairs(
 
     The core of the TPU IVF search layout (shared by IVF-Flat and IVF-PQ):
     sort pairs by list id, split each list's pair run into buckets of
-    ``group`` queries, and scatter into dense [n_buckets, group] tables.
+    ``group`` queries, and GATHER the dense [n_buckets, group] tables from
+    the sorted pair array (element scatters measured 2x the gathers).
     ``n_buckets`` has the static bound total/group + C (each list wastes at
     most one partial bucket), so everything jits with static shapes.
 

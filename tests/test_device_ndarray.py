@@ -68,3 +68,30 @@ def test_search_pipeline_through_wrapper():
     q = device_ndarray(rng.standard_normal((20, 8)).astype(np.float32))
     d, i = brute_force.knn(cai_wrapper(q), cai_wrapper(x), 5)
     assert i.shape == (20, 5)
+
+
+def test_output_format_hook():
+    """config.set_output_as converts outputs globally (pylibraft
+    config.set_output_as analog)."""
+    import jax
+    import numpy as np
+
+    import raft_tpu.config as config
+    from raft_tpu.core.device_ndarray import device_ndarray
+
+    arr = device_ndarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    try:
+        assert isinstance(arr.get(), jax.Array)
+        config.set_output_as("numpy")
+        out = arr.get()
+        assert isinstance(out, np.ndarray)
+        np.testing.assert_array_equal(out, np.arange(6).reshape(2, 3))
+        config.set_output_as(lambda x: ("wrapped", x))
+        assert arr.get()[0] == "wrapped"
+        try:
+            config.set_output_as("cupy")
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+    finally:
+        config.set_output_as("jax")
