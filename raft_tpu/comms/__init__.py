@@ -27,6 +27,11 @@ from raft_tpu.comms.ops import (
     reducescatter,
 )
 from raft_tpu.comms.sharded import (
+    sharded_cagra_build,
+    sharded_cagra_search,
+    sharded_ivf_build,
+    sharded_ivf_pq_search,
+    sharded_ivf_row_search,
     sharded_ivf_search,
     sharded_knn,
     sharded_pairwise_distance,
@@ -46,6 +51,11 @@ __all__ = [
     "reducescatter",
     "device_sendrecv",
     "device_multicast_sendrecv",
+    "sharded_cagra_build",
+    "sharded_cagra_search",
+    "sharded_ivf_build",
+    "sharded_ivf_pq_search",
+    "sharded_ivf_row_search",
     "sharded_ivf_search",
     "sharded_knn",
     "sharded_pairwise_distance",

@@ -123,6 +123,7 @@ def sharded_ivf_search(
             int(k), n_probes, metric, group, bucket_batch, 0,
             str(search_params.compute_dtype),
             float(search_params.local_recall_target),
+            float(search_params.merge_recall_target),
             norms, None,
         )
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)  # [m, S*k]
@@ -135,6 +136,321 @@ def sharded_ivf_search(
     if has_norms:
         args.append(index.data_norms)
         in_specs.append(P(axis_name, None))
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)(*args)
+
+
+def sharded_ivf_pq_search(
+    search_params,
+    index,
+    queries,
+    k: int,
+    mesh: Mesh,
+    axis_name: str = "shard",
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate KNN with the IVF-PQ index's *lists* sharded over the
+    mesh — the DEEP-1B-scale model (the reference fits DEEP-1B in 24 GiB
+    per GPU via PQ and shards across GPUs via comms,
+    docs/source/using_raft_comms.rst): each device owns
+    ``n_lists / n_shards`` lists (centers, packed codes, norms, int8
+    cache all sharded on the list axis), probes its share, and the
+    per-shard top-ks are all-gathered + merged over ICI.
+
+    PER_CLUSTER codebooks shard with their lists; PER_SUBSPACE codebooks
+    and the rotation are replicated. Stored ids are global dataset row
+    ids, so no rank offset is needed.
+    """
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.ivf_flat import adaptive_query_group
+
+    queries = jnp.asarray(queries)
+    C = index.n_lists
+    nshards = mesh.shape[axis_name]
+    if C % nshards != 0:
+        raise ValueError(f"n_lists {C} not divisible by mesh axis {nshards}")
+    local_lists = C // nshards
+    n_probes = max(1, min(int(search_params.n_probes) // nshards, local_lists))
+    cap = index.codes.shape[1]
+    if k > n_probes * cap:
+        raise ValueError(
+            f"k={k} exceeds the per-shard candidate pool "
+            f"(n_probes/shard={n_probes} x cap={cap}); raise n_probes to at "
+            f"least {nshards * -(-k // max(cap, 1))} for a {nshards}-way mesh"
+        )
+    select_min = is_min_close(index.metric)
+    metric = int(index.metric)
+    group = adaptive_query_group(
+        int(queries.shape[0]), n_probes, index.n_lists,
+        int(search_params.query_group),
+    )
+    bucket_batch = int(search_params.bucket_batch)
+    per_cluster = int(index.codebook_kind) == ivf_pq.codebook_gen.PER_CLUSTER
+    has_cache = index.recon_cache is not None
+    lut = ivf_pq._norm_dtype_knob(search_params.lut_dtype)
+    if lut in ("auto", "i8") and not has_cache:
+        if lut == "i8":
+            raise ValueError("lut_dtype='i8' needs the decoded-residual cache")
+        lut = "f32"
+    internal = ivf_pq._norm_dtype_knob(search_params.internal_distance_dtype)
+
+    def local(q, centers, centers_rot, rotation, pq_centers, codes,
+              indices, list_sizes, rec_norms, *rest):
+        cache = rest[0] if has_cache else None
+        arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
+                  indices, list_sizes, rec_norms, None, cache,
+                  jnp.float32(index.recon_scale))
+        d, i = ivf_pq._pq_search(
+            arrays, int(k), n_probes, metric, group, bucket_batch,
+            int(index.codebook_kind), 0,
+            str(search_params.compute_dtype),
+            float(search_params.local_recall_target),
+            float(search_params.merge_recall_target),
+            lut, internal, int(index.pq_dim), int(index.pq_bits), "xla",
+        )
+        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
+        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
+        return merge_topk(gd, gi, k, select_min)
+
+    args = [queries, index.centers, index.centers_rot, index.rotation,
+            index.pq_centers, index.codes, index.indices, index.list_sizes,
+            index.rec_norms]
+    in_specs = [
+        P(),                          # queries replicated
+        P(axis_name, None),           # centers
+        P(axis_name, None),           # centers_rot
+        P(),                          # rotation replicated
+        P(axis_name, None, None) if per_cluster else P(),
+        P(axis_name, None, None),     # packed codes
+        P(axis_name, None),           # indices
+        P(axis_name),                 # list_sizes
+        P(axis_name, None),           # rec_norms
+    ]
+    if has_cache:
+        args.append(index.recon_cache)
+        in_specs.append(P(axis_name, None, None))
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)(*args)
+
+
+def sharded_cagra_build(
+    params,
+    dataset,
+    mesh: Mesh,
+    axis_name: str = "shard",
+):
+    """Row-sharded CAGRA: each shard builds an independent graph over its
+    dataset partition — the raft-dask per-worker-index model (each Dask
+    worker builds/owns an ANN index over its partition; queries broadcast,
+    results merged). Returns a ``cagra.Index`` whose arrays carry a
+    leading shard axis ([S, rows, ...]) with LOCAL graph ids.
+
+    The per-shard builds run sequentially on the default device (the
+    build pipeline is host-orchestrated); the stacked result is laid out
+    for ``sharded_cagra_search``'s shard_map.
+    """
+    from raft_tpu.neighbors import cagra
+
+    import dataclasses
+
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    nshards = mesh.shape[axis_name]
+    if n % nshards != 0:
+        raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
+    rows = n // nshards
+    # the packed inline layout would be discarded by the stacking below —
+    # skip building it per shard
+    params = dataclasses.replace(params, inline_codes=False)
+    subs = []
+    for s in range(nshards):
+        subs.append(cagra.build(params, dataset[s * rows:(s + 1) * rows]))
+    graphs = jnp.stack([s.graph for s in subs])          # [S, rows, deg]
+    datasets = jnp.stack([s.dataset for s in subs])      # [S, rows, d]
+    norms = (jnp.stack([s.data_norms for s in subs])
+             if subs[0].data_norms is not None else None)
+    return cagra.Index(dataset=datasets, graph=graphs,
+                       metric=subs[0].metric, data_norms=norms)
+
+
+def sharded_cagra_search(
+    search_params,
+    index,
+    queries,
+    k: int,
+    mesh: Mesh,
+    axis_name: str = "shard",
+) -> Tuple[jax.Array, jax.Array]:
+    """Beam search over a row-sharded CAGRA index (from
+    ``sharded_cagra_build``): queries are replicated, every device runs
+    the beam search on its own sub-graph, local ids get the shard's row
+    offset, and the per-shard top-ks are all-gathered + merged over ICI
+    (the knn_merge_parts-over-comms pattern,
+    detail/knn_merge_parts.cuh:140)."""
+    from raft_tpu.neighbors import cagra
+
+    queries = jnp.asarray(queries)
+    nshards = mesh.shape[axis_name]
+    S, rows, _ = index.dataset.shape
+    if S != nshards:
+        raise ValueError(f"index has {S} shards, mesh axis has {nshards}")
+    select_min = is_min_close(index.metric)
+    itopk, width, iters, n_seeds = cagra.search_plan(search_params, k)
+    has_norms = index.data_norms is not None
+
+    def local(q, ds, graph, *rest):
+        rank = jax.lax.axis_index(axis_name)
+        norms = rest[0][0] if has_norms else None
+        d, i = cagra._beam_search(
+            q, ds[0], graph[0], norms, int(k), itopk, width, iters,
+            int(index.metric), "f32", n_seeds,
+        )
+        i = jnp.where(i >= 0, i + (rank * rows).astype(i.dtype), -1)
+        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
+        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
+        return merge_topk(gd, gi, k, select_min)
+
+    args = [queries, index.dataset, index.graph]
+    in_specs = [P(), P(axis_name, None, None), P(axis_name, None, None)]
+    if has_norms:
+        args.append(index.data_norms)
+        in_specs.append(P(axis_name, None))
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)(*args)
+
+
+def sharded_ivf_build(
+    params,
+    dataset,
+    mesh: Mesh,
+    axis_name: str = "shard",
+):
+    """Sharded IVF-Flat build: coarse centers are trained ONCE on a
+    subsample (the reference trains on a fraction anyway,
+    kmeans_trainset_fraction), then every shard packs ITS dataset rows
+    into the shared list structure — the per-shard extend +
+    shared-centers pattern of the reference's multi-GPU builds. Returns
+    an ``ivf_flat.Index`` whose list arrays carry a leading shard axis
+    ([S, n_lists, cap, ...]) with GLOBAL row ids, consumable by
+    ``sharded_ivf_row_search``."""
+    from raft_tpu.neighbors import ivf_flat
+
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    nshards = mesh.shape[axis_name]
+    if n % nshards != 0:
+        raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
+    rows = n // nshards
+    subs = []
+    for s in range(nshards):
+        part = dataset[s * rows:(s + 1) * rows]
+        ids = jnp.arange(s * rows, (s + 1) * rows, dtype=jnp.int32)
+        if s == 0:
+            sub = ivf_flat.build(params, part, row_ids=ids)
+            empty = ivf_flat.Index(
+                centers=sub.centers,
+                storage=jnp.zeros((sub.n_lists, 0) + sub.storage.shape[2:],
+                                  sub.storage.dtype),
+                indices=jnp.zeros((sub.n_lists, 0), jnp.int32),
+                list_sizes=jnp.zeros((sub.n_lists,), jnp.int32),
+                metric=sub.metric, metric_arg=sub.metric_arg,
+                data_norms=(jnp.zeros((sub.n_lists, 0), jnp.float32)
+                            if sub.data_norms is not None else None),
+            )
+        else:
+            # every later shard packs its rows against shard-0's centers
+            # (shared coarse quantizer -> identical bucketing everywhere)
+            sub = ivf_flat.extend(empty, part, ids)
+        subs.append(sub)
+    cap = max(s.storage.shape[1] for s in subs)
+
+    def padcap(a, fill):
+        return jnp.pad(a, [(0, 0), (0, cap - a.shape[1])] +
+                       [(0, 0)] * (a.ndim - 2), constant_values=fill)
+
+    storage = jnp.stack([padcap(s.storage, 0) for s in subs])
+    indices = jnp.stack([padcap(s.indices, -1) for s in subs])
+    sizes = jnp.stack([s.list_sizes for s in subs])
+    centers = jnp.stack([s.centers for s in subs])
+    norms = (jnp.stack([padcap(s.data_norms, 0) for s in subs])
+             if subs[0].data_norms is not None else None)
+    from raft_tpu.neighbors.ivf_flat import Index as FlatIndex
+
+    return FlatIndex(centers=centers, storage=storage, indices=indices,
+                     list_sizes=sizes, metric=subs[0].metric,
+                     data_norms=norms)
+
+
+def sharded_ivf_row_search(
+    search_params,
+    index,
+    queries,
+    k: int,
+    mesh: Mesh,
+    axis_name: str = "shard",
+) -> Tuple[jax.Array, jax.Array]:
+    """Search a row-sharded IVF-Flat index (from ``sharded_ivf_build``):
+    every device probes its own full list structure (which holds only its
+    dataset partition's rows) with the FULL n_probes, then shard top-ks
+    are all-gathered + merged."""
+    from raft_tpu.neighbors import ivf_flat
+
+    queries = jnp.asarray(queries)
+    nshards = mesh.shape[axis_name]
+    S = index.centers.shape[0]
+    if S != nshards:
+        raise ValueError(f"index has {S} shards, mesh axis has {nshards}")
+    C = index.centers.shape[1]
+    n_probes = int(min(search_params.n_probes, C))
+    select_min = is_min_close(index.metric)
+    metric = int(index.metric)
+    group = ivf_flat.adaptive_query_group(
+        int(queries.shape[0]), n_probes, C, int(search_params.query_group),
+    )
+    has_norms = index.data_norms is not None
+
+    def local(q, centers, storage, indices, list_sizes, *rest):
+        norms = rest[0][0] if has_norms else None
+        d, i = ivf_flat._ivf_search(
+            q, centers[0], storage[0], indices[0], list_sizes[0],
+            int(k), n_probes, metric, group,
+            int(search_params.bucket_batch), 0,
+            str(search_params.compute_dtype),
+            float(search_params.local_recall_target),
+            float(search_params.merge_recall_target),
+            norms, None,
+        )
+        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
+        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
+        return merge_topk(gd, gi, k, select_min)
+
+    args = [queries, index.centers, index.storage, index.indices,
+            index.list_sizes]
+    in_specs = [P(), P(axis_name, None, None), P(axis_name, None, None, None),
+                P(axis_name, None, None), P(axis_name, None)]
+    if has_norms:
+        args.append(index.data_norms)
+        in_specs.append(P(axis_name, None, None))
 
     fn = shard_map(
         local,

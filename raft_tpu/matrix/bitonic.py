@@ -72,19 +72,19 @@ def sort_by_key(keys, *payloads, descending: bool = False):
     L = keys.shape[-1]
     if L & (L - 1):
         raise ValueError(f"bitonic length must be a power of two, got {L}")
-    if descending:
-        keys = -keys
     masks = _asc_masks(L)
     k = 2
     while k <= L:
         j = k // 2
         while j >= 1:
-            asc = jnp.asarray(masks[(k, j)])
+            # descending = flip every comparison direction (key negation
+            # would overflow INT_MIN and conflate +0.0/-0.0)
+            asc = jnp.asarray(
+                ~masks[(k, j)] if descending else masks[(k, j)]
+            )
             keys, payloads = _substage(keys, payloads, j, asc)
             j //= 2
         k *= 2
-    if descending:
-        keys = -keys
     return keys, payloads
 
 

@@ -29,27 +29,29 @@ Design — idiomatic TPU, not a port:
   the naive XLA formulation is bound by per-row HBM gathers (row-count
   bound: gathering 1 f32 norm costs the same as a 512-byte vector) and
   by sort/top_k/take_along_axis (which lower to serial per-row gathers).
-  Three TPU-specific redesigns, each measured:
+  The TPU redesigns, each measured:
 
-  - **Inline neighbor codes**: the index stores, per node, its graph
-    neighbors' vectors int8-quantized *contiguously* ([n, deg*d], the
-    DiskANN-style layout) plus their exact f32 norms [n, deg]. One
-    iteration then gathers ``width`` 4 KB rows per query instead of
-    ``width*deg`` scattered 512 B rows + as many scalar norm rows
-    (measured 2.4 ms vs 18 ms per iteration at m=10k). Traversal scores
-    are int8-approximate; the final buffer prefix is exactly rescored
-    from the f32 dataset before results are returned.
-  - **Scoring as VPU mult-sum** (``(vecs * q).sum(-1)``), which XLA
-    fuses into the gather consumer — the batched-matvec einsum
-    formulation costs 4x more (MXU batch-1 matmuls + relayouts).
-  - **Bitonic merge** (matrix/bitonic.py): the itopk buffer + candidate
-    merge is a reshape-based compare-exchange network carrying (id,
-    explored) payloads — 1.6 ms vs 10-12 ms for top_k + take_along_axis
-    or lax.sort at [10k, 256]. The reference's visited hash table
-    (hashmap.hpp:41) becomes windowed dedup on the sorted buffer:
-    duplicate ids have bitwise-equal distances, so they land adjacent
-    after the merge and collapse into one entry that keeps the explored
-    flag — same invariant, no hashing.
+  - **Packed inline neighbor rows**: the index stores, per node, ONE
+    int32 row ``[deg*d/4 int8-code words | deg norm bitcasts | deg
+    neighbor ids]`` (the DiskANN-style layout, fused). One iteration
+    gathers ``width`` contiguous ~4.5 KB rows per query instead of
+    ``3*width`` (codes + norms + graph) scattered row sets — measured
+    0.59 ms vs 4.1 ms per iteration at m=10k (and an int32-element
+    gather moves ~4x the bytes/s of an int8 one). Traversal scores are
+    int8-approximate; the final buffer prefix is exactly rescored from
+    the f32 dataset before results are returned.
+  - **Fused Pallas beam step** (ops/beam_step.py): scoring, bitonic
+    merge, windowed dedup, and next-parent pickup run in one kernel
+    with the buffer state resident in VMEM — the XLA formulation paid
+    ~36 HBM round trips per iteration for the compare-exchange network
+    alone. The reference's visited hash table (hashmap.hpp:41) becomes
+    windowed dedup on the sorted buffer: duplicate ids score
+    (near-)identically, so they land adjacent after the merge and
+    collapse into one entry that keeps the explored flag — same
+    invariant, no hashing.
+  - **Shared seed slab**: per-query random seeds cost m*n_seeds HBM
+    rows to score; a query-shared pseudo-random slab is one MXU matmul
+    (seeds are uniform either way — measured no recall change).
 """
 
 from __future__ import annotations
@@ -110,10 +112,10 @@ class SearchParams:
     itopk_size: int = 64
     search_width: int = 4          # parents expanded per iteration
     max_iterations: int = 0        # 0 -> auto
-    # traversal scoring: "auto" = inline int8 layout when the index has
-    # one (the fast path; final top-k is exactly rescored in f32), else
-    # scattered exact f32 gathers. "f32" | "bf16" force the scattered
-    # exact-gather path with that scoring dtype.
+    # traversal scoring: "auto" = packed int8 inline layout when the
+    # index has one (the fast path; final top-k is exactly rescored in
+    # f32), else scattered exact f32 gathers. "f32" | "bf16" force the
+    # scattered exact-gather path with that scoring dtype.
     compute_dtype: str = "auto"
     # random seed candidates scored per query at startup (0 = auto:
     # max(2*itopk, 128) — generous because sparse seeding under-covers
@@ -123,6 +125,11 @@ class SearchParams:
     # iteration counts is exploration-limited, not start-limited) while
     # adding build cost, so seeds stay random like the reference's.
     n_seeds: int = 0
+    # search backend: "auto" = the fused Pallas beam-step kernel on TPU
+    # when the index carries the inline int8 layout (score + bitonic
+    # merge + dedup + parent pick fused in VMEM, raft_tpu.ops.beam_step),
+    # else the XLA paths. "pallas" | "pallas_interpret" | "xla" force.
+    scan_impl: str = "auto"
     # reference knobs kept for API parity; the batched-SPMD kernel has no
     # CTA/team/hashmap notion (documented no-ops)
     algo: str = "auto"
@@ -136,19 +143,20 @@ class SearchParams:
 class Index:
     """CAGRA index = dataset + fixed-degree graph (cagra_types.hpp:133).
 
-    ``nbr_codes``/``nbr_norms`` are the optional inline search layout:
-    per node, its graph neighbors' vectors int8-quantized and stored
-    contiguously ([n, deg*d]) with their exact f32 norms ([n, deg]), so
-    beam-search expansion reads ``width`` contiguous 4 KB rows instead
-    of ``width*deg`` scattered ones (see module docstring). Rebuilt on
-    load; never serialized."""
+    ``nbr_pack`` is the optional inline search layout: per node, ONE
+    packed int32 row ``[deg*d/4 code words | deg norm bitcasts (L2) |
+    deg neighbor ids]`` holding its graph neighbors' vectors
+    int8-quantized plus their exact norms and ids, so beam-search
+    expansion gathers ``width`` contiguous ~4.5 KB rows per query
+    instead of ``3*width`` scattered ones (measured ~7x faster on v5e;
+    see ops/beam_step.py for the decode). Rebuilt on load; never
+    serialized."""
 
     dataset: jax.Array      # [n, d]
     graph: jax.Array        # [n, degree] int32
     metric: DistanceType
     data_norms: Optional[jax.Array] = None  # [n] f32 (L2 metrics)
-    nbr_codes: Optional[jax.Array] = None   # [n, deg*d] int8 inline layout
-    nbr_norms: Optional[jax.Array] = None   # [n, deg] f32 (L2 metrics)
+    nbr_pack: Optional[jax.Array] = None    # [n, W] int32 packed rows
     flat_codes: Optional[jax.Array] = None  # [n, d] int8 (seed scoring)
     code_scale: float = 1.0                 # int8 dequant scale
 
@@ -167,47 +175,84 @@ class Index:
 
 jax.tree_util.register_dataclass(
     Index,
-    data_fields=["dataset", "graph", "data_norms", "nbr_codes", "nbr_norms",
-                 "flat_codes"],
+    data_fields=["dataset", "graph", "data_norms", "nbr_pack", "flat_codes"],
     meta_fields=["metric", "code_scale"],
 )
 
-# inline layout is skipped when n * deg * d exceeds this budget (bytes);
-# the scattered-gather search path is used instead
+# inline layout is skipped when the packed table exceeds this budget
+# (bytes); the scattered-gather search path is used instead
 _INLINE_BUDGET = 6 << 30
 
+# queries per Pallas beam-step grid tile (the kernel's lane dimension)
+_QUERY_TILE = 128
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _inline_tables(dataset, graph, need_norms: bool):
-    """Build the inline neighbor layout: int8 codes [n, deg*d] (global
-    symmetric scale) + exact f32 neighbor norms [n, deg] + flat codes
-    [n, d] for seed scoring."""
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
+    """Build the packed inline layout: per node one int32 row
+    ``[deg*d/4 code words | deg norm bitcasts | deg ids]`` (norms
+    omitted for IP), plus flat int8 codes [n, d] for seed scoring.
+    Chunked over nodes to bound the [chunk, deg, d] gather transient.
+    Code words pack 4 bytes by shift-or (a narrowing
+    lax.bitcast_convert_type lowers to a catastrophic widened
+    intermediate on TPU) — the kernel decode (beam_step.py) mirrors the
+    byte order by construction."""
     n, d = dataset.shape
     deg = graph.shape[1]
     d32 = dataset.astype(jnp.float32)
     scale = jnp.maximum(jnp.max(jnp.abs(d32)), 1e-30) / 127.0
     codes = jnp.clip(jnp.round(d32 / scale), -127, 127).astype(jnp.int8)
-    g = jnp.maximum(graph, 0)
-    nbr_codes = codes[g].reshape(n, deg * d)
-    nbr_norms = None
-    if need_norms:
-        norms = jnp.sum(d32 * d32, axis=1)
-        nbr_norms = norms[g]
-    return nbr_codes, nbr_norms, codes, scale
+    norms = jnp.sum(d32 * d32, axis=1) if need_norms else None
+
+    a128 = lambda v: -(-v // 128) * 128
+    dw = deg * d // 4
+
+    def pack_chunk(gc):                        # [c, deg] raw graph rows
+        c = gc.shape[0]
+        g = jnp.maximum(gc, 0)
+        nbr = codes[g].reshape(c, deg * d)     # [c, deg*d] i8
+        b = nbr.astype(jnp.uint8).astype(jnp.uint32)
+        words = (
+            b[:, 0::4] | (b[:, 1::4] << 8) | (b[:, 2::4] << 16)
+            | (b[:, 3::4] << 24)
+        ).astype(jnp.int32)                    # [c, dw]
+        # every region is padded to a 128-lane multiple: the kernel's
+        # dynamic loads need 128-aligned lane offsets
+        pad_r = lambda x: jnp.pad(x, ((0, 0), (0, a128(x.shape[1]) - x.shape[1])))
+        parts = [pad_r(words)]
+        if need_norms:
+            parts.append(pad_r(
+                jax.lax.bitcast_convert_type(norms[g], jnp.int32)))
+        parts.append(pad_r(gc))                # raw ids: keep -1 padding
+        return jnp.concatenate(parts, axis=1)
+
+    if n <= chunk:
+        pack = pack_chunk(graph)
+    else:
+        npad = -(-n // chunk) * chunk
+        gp = jnp.pad(graph, ((0, npad - n), (0, 0)))
+        pack = jax.lax.map(
+            pack_chunk, gp.reshape(npad // chunk, chunk, deg)
+        ).reshape(npad, -1)[:n]
+    return pack, codes, scale
 
 
 def _attach_inline(index: Index, inline: bool) -> Index:
     n, d = index.dataset.shape
     deg = index.graph.shape[1]
-    if not inline or n * deg * d > _INLINE_BUDGET:
+    a128 = lambda v: -(-v // 128) * 128
+    # true packed-row bytes incl. the per-region 128-lane alignment pad
+    row_bytes = 4 * (a128(deg * d // 4) + 2 * a128(deg))
+    if not inline or d % 4 or n * row_bytes > _INLINE_BUDGET \
+            or n >= (1 << 30):   # beam kernel packs ids as (id<<1)|flag
         return index
     need_norms = index.metric != DistanceType.InnerProduct
-    nbr_codes, nbr_norms, flat_codes, scale = _inline_tables(
+    nbr_pack, flat_codes, scale = _pack_tables(
         index.dataset, index.graph, need_norms
     )
     return dataclasses.replace(
-        index, nbr_codes=nbr_codes, nbr_norms=nbr_norms,
-        flat_codes=flat_codes, code_scale=float(scale),
+        index, nbr_pack=nbr_pack, flat_codes=flat_codes,
+        code_scale=float(scale),
     )
 
 
@@ -642,14 +687,13 @@ def _beam_search(
     return _finalize(fd, fi, q32, metric)
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13))
-def _beam_search_inline(
-    queries,       # [m, d] f32
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _beam_search_pallas(
+    queries,       # [m0, d] f32
     dataset,       # [n, d] (exact rescore)
     graph,         # [n, deg] int32
     data_norms,    # [n] f32 or None (IP)
-    nbr_codes,     # [n, deg*d] int8
-    nbr_norms,     # [n, deg] f32 or None (IP)
+    nbr_pack,      # [n, W] int32 packed inline rows
     flat_codes,    # [n, d] int8
     code_scale,    # [] f32
     k: int,
@@ -658,70 +702,90 @@ def _beam_search_inline(
     iters: int,
     metric_val: int,
     n_seeds: int = 0,
+    interpret: bool = False,
 ):
-    """Inline-layout beam search: expansion gathers ``width`` contiguous
-    int8 rows (each a parent\'s full neighbor block) instead of
-    ``width*deg`` scattered vector + norm rows; traversal scores are
-    int8-approximate; the final buffer prefix is exactly rescored from
-    the f32 dataset."""
+    """Fused beam search: XLA gathers the packed int32 neighbor rows
+    (row gathers are XLA's strength; the int32 fused row measured ~7x
+    faster than separate int8-codes + norms + graph gathers); everything
+    else in the iteration — int8 decode + scoring, bitonic merge,
+    windowed dedup, parent pickup — runs in one Pallas kernel with the
+    itopk buffer resident in VMEM (ops/beam_step.py; the reference keeps
+    the same state in CTA shared memory,
+    search_single_cta_kernel-inl.cuh:585).
+
+    Seeds are a SHARED pseudo-random slab scored by one MXU matmul
+    instead of per-query row gathers (HBM gathers are row-count bound:
+    per-query seeds cost m*n_seeds rows ~ 4 ms at m=10k; the slab is
+    free). Seeds are uniform-random either way, so recall is unchanged.
+    """
+    from raft_tpu.ops.beam_step import beam_merge_step
+
     metric = DistanceType(metric_val)
     ip = metric == DistanceType.InnerProduct
     n, d = dataset.shape
     deg = graph.shape[1]
-    m = queries.shape[0]
-    q32 = queries.astype(jnp.float32)
-    qbf = q32.astype(jnp.bfloat16)
-    two_scale = 2.0 * code_scale
+    m0 = queries.shape[0]
+    G = _QUERY_TILE
+    m = -(-m0 // G) * G
+    q32 = jnp.pad(queries.astype(jnp.float32), ((0, m - m0), (0, 0)))
+    two_scale = (1.0 if ip else 2.0) * code_scale
+    qs = (q32 * two_scale).astype(jnp.bfloat16)
+    # per-byte-lane query layout for the in-kernel word decode:
+    # qrep[:, j, e*(d/4)+t] = qs[:, 4t+j]
+    dq = d // 4
+    qperm = jnp.transpose(qs.reshape(m, dq, 4), (0, 2, 1))   # [m, 4, d/4]
+    qrep = jnp.tile(qperm, (1, 1, deg))                      # [m, 4, dw]
 
-    # --- seeds: same scoring flavor as traversal (int8 codes for the
-    # cross term, exact stored norms), so a node rediscovered through the
-    # graph scores equal to its seed entry and windowed dedup collapses
-    # them. The final exact rescore guarantees unique output regardless.
+    # ---- shared seed slab, scored on the MXU -------------------------
     if n_seeds <= 0:
         n_seeds = max(2 * itopk, 128)
-    seeds = _seed_ids(m, n, n_seeds)
-    svec = flat_codes[seeds]                   # [m, ns, d] int8
-    sdots = (svec.astype(jnp.bfloat16) * qbf[:, None, :]).sum(
-        -1, dtype=jnp.float32
-    )
+    seed_ids = (
+        (jnp.arange(n_seeds, dtype=jnp.uint32) * jnp.uint32(2654435761)
+         + jnp.uint32(0x128394)) % jnp.uint32(n)
+    ).astype(jnp.int32)                                  # [S]
+    scodes = flat_codes[seed_ids].astype(jnp.bfloat16)   # [S, d]
+    sdots = jax.lax.dot_general(
+        qs, scodes,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # [m, S]
     if ip:
-        seed_d = -code_scale * sdots
+        seed_d = -sdots
     else:
-        seed_d = data_norms[seeds] - two_scale * sdots
-    buf_d, buf_i, buf_e = _sorted_buffer(seed_d, seeds, itopk)
+        seed_d = data_norms[seed_ids][None, :] - sdots
+    seed_i = jnp.broadcast_to(seed_ids[:, None], (n_seeds, m))
+
+    buf_d = jnp.full((itopk, m), jnp.inf, jnp.float32)
+    buf_i = jnp.full((itopk, m), -1, jnp.int32)
+    buf_e = jnp.zeros((itopk, m), jnp.int32)
+    buf_d, buf_i, buf_e, parents = beam_merge_step(
+        buf_d, buf_i, buf_e, cand_d=seed_d.T, cand_i=seed_i,
+        width=width, ip=ip, g=G, interpret=interpret,
+    )
 
     def body(_, state):
-        buf_d, buf_i, buf_e = state
-        parents, buf_e = _pick_parents(buf_d, buf_i, buf_e, width)
-        gp = jnp.maximum(parents, 0)
-        nbrs = graph[gp].reshape(m, width * deg)
-        blocks = nbr_codes[gp].reshape(m, width * deg, d)   # contiguous rows
-        dots = (blocks.astype(jnp.bfloat16) * qbf[:, None, :]).sum(
-            -1, dtype=jnp.float32
+        bd, bi, be, par = state
+        gp = jnp.maximum(par.T, 0)                       # [m, width]
+        blk = nbr_pack[gp]                               # [m, width, W]
+        return beam_merge_step(
+            bd, bi, be, qrep=qrep, pack=blk, parents=par,
+            deg=deg, d=d, width=width, ip=ip, g=G, interpret=interpret,
         )
-        if ip:
-            nbr_d = -code_scale * dots
-        else:
-            # exact stored norms, quantized cross term: the norm gather
-            # rides the same cheap [m, width]-row pattern as the codes
-            qn = nbr_norms[gp].reshape(m, width * deg)
-            nbr_d = qn - two_scale * dots
-        parent_ok = jnp.broadcast_to(
-            (parents >= 0)[:, :, None], (m, width, deg)
-        ).reshape(m, width * deg)
-        nbr_d = jnp.where(parent_ok, nbr_d, jnp.inf)
-        return _merge_step(buf_d, buf_i, buf_e, nbr_d, nbrs, itopk)
 
-    buf_d, buf_i, buf_e = jax.lax.fori_loop(
-        0, iters, body, (buf_d, buf_i, buf_e)
+    buf_d, buf_i, buf_e, parents = jax.lax.fori_loop(
+        0, iters, body, (buf_d, buf_i, buf_e, parents)
     )
 
-    # exact rescore also collapses any duplicate that slipped past the
-    # traversal dedup (equal exact distances sort adjacent).
-    R = min(itopk, max(64, _next_pow2(2 * k)))
-    ri = buf_i[:, :R]
-    rvec = dataset[jnp.maximum(ri, 0)].astype(jnp.float32)  # [m, R, d]
-    rdots = (rvec * q32[:, None, :]).sum(-1, dtype=jnp.float32)
+    # ---- exact f32 rescore of the buffer prefix ----------------------
+    # R rows/query of HBM gather (row-count bound): 2k-rounded is enough
+    # because the int8 traversal ranking is already ~exact at the top
+    # (measured: R=32 vs 64 at k=10 changes recall < 0.002, saves ~2 ms
+    # of the fixed cost at m=10k)
+    R = min(itopk, max(32, _next_pow2(2 * k)))
+    ri = buf_i.T[:m0, :R]
+    q0 = q32[:m0]
+    rvec = dataset[jnp.maximum(ri, 0)].astype(jnp.float32)  # [m0, R, d]
+    rdots = (rvec * q0[:, None, :]).sum(-1, dtype=jnp.float32)
     if ip:
         rd = -rdots
     else:
@@ -730,10 +794,40 @@ def _beam_search_inline(
     LR = _next_pow2(R)
     rd = _pad_cols(rd, LR, jnp.inf)
     ri = _pad_cols(ri, LR, -1)
-    re = jnp.zeros_like(ri, dtype=jnp.bool_)
     rd, (ri,) = sort_by_key(rd, ri)
     rd, ri = _exact_dedup_prefix(rd, ri, k)
-    return _finalize(rd, ri, q32, metric)
+    return _finalize(rd, ri, q0, metric)
+
+
+def _resolve_beam_impl(requested: str, index: Index,
+                       compute_dtype: str) -> str:
+    if requested != "auto":
+        return requested
+    # explicit f32/bf16 compute_dtype selects the scattered exact-gather
+    # path (the documented SearchParams contract)
+    if index.nbr_pack is None or compute_dtype != "auto":
+        return "xla"
+    try:
+        platform = jax.devices()[0].platform.lower()
+    except Exception:  # noqa: BLE001 - backend probing must never fail search
+        platform = "cpu"
+    return "pallas" if platform in ("tpu", "axon") else "xla"
+
+
+def search_plan(search_params: SearchParams, k: int):
+    """Derive (itopk, width, iters, n_seeds) from params + k (the
+    reference's search_plan, detail/cagra/search_plan.cuh:70). Shared
+    with the sharded search so the two stay in lockstep."""
+    itopk = max(int(search_params.itopk_size), k)
+    width = max(1, int(search_params.search_width))
+    n_seeds = int(search_params.n_seeds)
+    if n_seeds > 0:
+        n_seeds = max(n_seeds, k)   # at least k live candidates to return
+    iters = int(search_params.max_iterations)
+    if iters <= 0:
+        # auto: enough pickups to explore the whole buffer plus slack
+        iters = max(1 + itopk // width, 10)
+    return itopk, width, iters, n_seeds
 
 
 def search(
@@ -743,28 +837,30 @@ def search(
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched beam search (reference cagra.cuh:299 search). Uses the
-    inline int8 layout when the index carries one (built by default),
-    else the exact scattered-gather path."""
+    fused Pallas beam kernel over the packed inline layout when the
+    index carries one (built by default), else the exact
+    scattered-gather path."""
     queries = jnp.asarray(queries)
-    itopk = max(int(search_params.itopk_size), k)
-    width = max(1, int(search_params.search_width))
-    n_seeds = int(search_params.n_seeds)
-    if n_seeds > 0:
-        n_seeds = max(n_seeds, k)   # at least k live candidates to return
-    iters = int(search_params.max_iterations)
-    if iters <= 0:
-        # auto (reference search_plan.cuh: plan-derived): enough pickups to
-        # explore the whole buffer plus slack
-        iters = max(1 + itopk // width, 10)
+    itopk, width, iters, n_seeds = search_plan(search_params, k)
     dtype = str(search_params.compute_dtype)
-    if index.nbr_codes is not None and dtype == "auto":
-        return _beam_search_inline(
+    impl = _resolve_beam_impl(str(search_params.scan_impl), index, dtype)
+    if impl.startswith("pallas"):
+        if index.nbr_pack is None:
+            raise ValueError(
+                "scan_impl=%r needs the packed inline layout (build with "
+                "inline_codes=True; requires dim %% 4 == 0)" % impl
+            )
+        if dtype != "auto":
+            raise ValueError(
+                "scan_impl=%r scores int8 traversal distances; "
+                "compute_dtype must stay 'auto' (got %r)" % (impl, dtype)
+            )
+        return _beam_search_pallas(
             queries,
             index.dataset,
             index.graph,
             index.data_norms,
-            index.nbr_codes,
-            index.nbr_norms,
+            index.nbr_pack,
             index.flat_codes,
             jnp.float32(index.code_scale),
             int(k),
@@ -773,6 +869,7 @@ def search(
             iters,
             int(index.metric),
             n_seeds,
+            impl == "pallas_interpret",
         )
     return _beam_search(
         queries,
@@ -802,7 +899,7 @@ def save(path: str, index: Index) -> None:
     write_index_file(
         path, "cagra", _SERIAL_VERSION,
         {"metric": int(index.metric),
-         "inline_codes": index.nbr_codes is not None},
+         "inline_codes": index.nbr_pack is not None},
         arrays,
     )
 

@@ -107,10 +107,19 @@ class SearchParams:
     # accumulate in f32), "f32" = exact 6-pass. The reference's analog is
     # its fp16/fp8 LUT ladder (ivf_pq_types.hpp lut_dtype).
     compute_dtype: str = "bf16"
-    # recall target for the per-list approx top-k AND the final
-    # cross-probe merge (lax.approx_min_k /
-    # lane-binned Pallas extraction); >= 1.0 switches to exact selection
+    # recall target for the per-list approx top-k (lane-binned Pallas
+    # extraction / approx merge_topk); >= 1.0 switches to exact per-list
+    # selection. NOTE: each list's extraction also caps at 256 candidates
+    # per list on the fused Pallas path (the reference's kMaxCapacity=256,
+    # select_warpsort.cuh:100) — with k > 256 entries of one list's true
+    # top-k, the excess is unrecoverable; raise n_probes or force
+    # scan_impl="xla" for exact semantics.
     local_recall_target: float = 0.95
+    # recall target for the FINAL cross-probe merge. Default 1.0 = exact
+    # final selection, matching the reference (ivf_flat_search-inl.cuh:194
+    # runs exact select_k); set < 1.0 to use lax.approx_min_k there too
+    # (measured ~1.2x QPS at 0.95 for ~0.5% recall on SIFT-1M).
+    merge_recall_target: float = 1.0
     # scan backend: "auto" picks the fused Pallas kernel on TPU when the
     # index layout allows it, else the XLA bucketized scan. Explicit:
     # "pallas" | "pallas_interpret" (CPU-debug) | "xla"
@@ -431,7 +440,7 @@ def unbucketize_merge(
 
 @functools.partial(
     jax.jit,
-    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12),
+    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13),
     static_argnames=("scan_impl",),
 )
 def _ivf_search(
@@ -448,6 +457,7 @@ def _ivf_search(
     filter_nbits: int,
     compute_dtype: str = "bf16",
     local_recall_target: float = 0.95,
+    merge_recall_target: float = 1.0,
     data_norms=None,
     filter_bits=None,
     *,
@@ -528,8 +538,8 @@ def _ivf_search(
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
             n_probes, kl, k, select_min, sentinel,
-            approx=local_recall_target < 1.0,
-            recall_target=local_recall_target,
+            approx=merge_recall_target < 1.0,
+            recall_target=merge_recall_target,
         )
         out_i = jnp.where(out_d == sentinel, -1, out_i)
         if metric == DistanceType.L2SqrtExpanded:
@@ -586,8 +596,8 @@ def _ivf_search(
     out_d, out_i = unbucketize_merge(
         cand_d, cand_i, pair_bucket, pair_pos, order, total, m, n_probes,
         kl, k, select_min, sentinel,
-        approx=local_recall_target < 1.0,
-        recall_target=local_recall_target,
+        approx=merge_recall_target < 1.0,
+        recall_target=merge_recall_target,
     )
     # fewer than k valid candidates in the probed lists: report id -1, not
     # whatever id rode along at sentinel distance (the documented contract;
@@ -648,6 +658,7 @@ def search(
         0 if bits is None else int(bits.n_bits),
         str(search_params.compute_dtype),
         float(search_params.local_recall_target),
+        float(search_params.merge_recall_target),
         index.data_norms,
         None if bits is None else bits.bits,
         scan_impl=scan_impl,

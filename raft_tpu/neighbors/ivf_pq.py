@@ -125,9 +125,15 @@ class SearchParams:
     query_group: int = 256
     bucket_batch: int = 32
     compute_dtype: str = "bf16"        # matmul operand dtype (f32 accumulate)
-    # governs BOTH the per-list approx top-k and the final cross-probe
-    # merge (TPU partial-reduce); >= 1.0 runs both exactly
+    # recall target for the per-list approx top-k; >= 1.0 runs it exactly.
+    # The fused Pallas path also caps per-list extraction at 256
+    # candidates (the reference's kMaxCapacity analog) — see
+    # ivf_flat.SearchParams.local_recall_target.
     local_recall_target: float = 0.95
+    # recall target for the FINAL cross-probe merge. Default 1.0 = exact
+    # final selection, matching the reference's exact select_k merge
+    # (ivf_pq_search.cuh:587); < 1.0 opts into the approximate merge.
+    merge_recall_target: float = 1.0
     # "auto" = fused Pallas scan over the decoded-residual cache when the
     # index has one (TPU, lane-aligned cap, k<=64), else the XLA
     # decode-then-matmul scan; "pallas" | "pallas_interpret" | "xla" force
@@ -665,7 +671,8 @@ def _attach_cache(index: "Index") -> "Index":
 
 
 @functools.partial(
-    jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
+    jax.jit,
+    static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
 )
 def _pq_search(
     arrays,
@@ -678,6 +685,7 @@ def _pq_search(
     filter_nbits: int,
     compute_dtype: str = "bf16",
     local_recall_target: float = 0.95,
+    merge_recall_target: float = 1.0,
     lut_dtype: str = "f32",
     internal_dtype: str = "f32",
     pq_dim: int = 0,
@@ -729,12 +737,12 @@ def _pq_search(
         kl = min(kl, 256)  # in-kernel extraction budget (see ivf_flat)
         qsafe_b = jnp.maximum(bucket_q, 0)
         q_res = q_rot[qsafe_b] - centers_rot[bucket_list][:, None, :]
-        qv = (q_res * recon_scale).astype(jnp.bfloat16)      # [nb, G, rot]
+        qv = (q_res * recon_scale).astype(mm)                # [nb, G, rot]
         ip = metric == DistanceType.InnerProduct
         if ip:
             # dist contribution = -(q_rot . recon); the per-(query, list)
             # constant q_rot . c_l is added back after the kernel
-            qv = (q_rot[qsafe_b] * recon_scale).astype(jnp.bfloat16)
+            qv = (q_rot[qsafe_b] * recon_scale).astype(mm)
             mk, qaux = ivf_scan.IP, None
         else:
             mk, qaux = ivf_scan.L2, jnp.sum(q_res * q_res, axis=2)
@@ -763,8 +771,8 @@ def _pq_search(
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
             n_probes, kl, k, select_min, sentinel,
-            approx=local_recall_target < 1.0,
-            recall_target=local_recall_target,
+            approx=merge_recall_target < 1.0,
+            recall_target=merge_recall_target,
         )
         out_i = jnp.where(out_d == sentinel, -1, out_i)
         if metric == DistanceType.L2SqrtExpanded:
@@ -850,8 +858,8 @@ def _pq_search(
         cand_i.reshape(nb_pad, group, kl),
         pair_bucket, pair_pos, order, total, m, n_probes, kl, k,
         select_min, sentinel,
-        approx=local_recall_target < 1.0,
-        recall_target=local_recall_target,
+        approx=merge_recall_target < 1.0,
+        recall_target=merge_recall_target,
     )
     # fewer than k valid candidates: id must be -1 (documented contract);
     # otherwise refine re-scores filtered-out ids back into the top-k
@@ -929,6 +937,7 @@ def search(
         0 if bits is None else int(bits.n_bits),
         str(search_params.compute_dtype),
         float(search_params.local_recall_target),
+        float(search_params.merge_recall_target),
         lut,
         _norm_dtype_knob(search_params.internal_distance_dtype),
         int(index.pq_dim),

@@ -70,13 +70,17 @@ def test_cagra_inline_vs_scattered_paths():
          + 0.7 * rng.standard_normal((100, 32))).astype(np.float32)
     idx = cagra.build(cagra.IndexParams(
         intermediate_graph_degree=32, graph_degree=16), x)
-    assert idx.nbr_codes is not None and idx.flat_codes is not None
+    assert idx.nbr_pack is not None and idx.flat_codes is not None
     scat = cagra.Index(dataset=idx.dataset, graph=idx.graph,
                        metric=idx.metric, data_norms=idx.data_norms)
-    sp = cagra.SearchParams(itopk_size=64, search_width=4)
     k = 10
-    d_i, i_i = cagra.search(sp, idx, q, k)
-    d_s, i_s = cagra.search(sp, scat, q, k)
+    # force the packed/Pallas path for the inline index (on CPU "auto"
+    # would resolve both searches to the same scattered implementation)
+    d_i, i_i = cagra.search(
+        cagra.SearchParams(itopk_size=64, search_width=4,
+                           scan_impl="pallas_interpret"), idx, q, k)
+    d_s, i_s = cagra.search(
+        cagra.SearchParams(itopk_size=64, search_width=4), scat, q, k)
     _, want = naive_knn(q, x, k)
     assert eval_recall(np.asarray(i_i), want) > 0.9
     assert eval_recall(np.asarray(i_s), want) > 0.9

@@ -104,3 +104,78 @@ def test_sharded_pairwise(rng, eight_device_mesh):
     got = np.asarray(sharded_pairwise_distance(x, y, eight_device_mesh, metric="l1"))
     want = naive_pairwise(x, y, "l1")
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_sharded_ivf_pq_search(rng, eight_device_mesh):
+    from raft_tpu.comms import sharded_ivf_pq_search
+    from raft_tpu.neighbors import ivf_pq
+
+    n, m, d, k = 2048, 24, 32, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    params = ivf_pq.IndexParams(
+        n_lists=16, pq_dim=16, pq_bits=8, kmeans_n_iters=5,
+        kmeans_trainset_fraction=1.0,
+    )
+    index = ivf_pq.build(params, x)
+    sp = ivf_pq.SearchParams(
+        n_probes=16, query_group=8, local_recall_target=1.0
+    )
+    dist, idx = sharded_ivf_pq_search(sp, index, q, k, eight_device_mesh)
+    _, want = naive_knn(q, x, k)
+    # PQ distances are approximate: recall bound mirrors test_ivf_pq
+    assert eval_recall(np.asarray(idx), want) > 0.7
+    # agrees with the single-device search at the same effective probes
+    d1, i1 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, local_recall_target=1.0),
+        index, q, k)
+    assert eval_recall(np.asarray(idx), np.asarray(i1)) > 0.7
+
+
+def test_sharded_cagra_build_search(rng, eight_device_mesh):
+    from raft_tpu.comms import sharded_cagra_build, sharded_cagra_search
+    from raft_tpu.neighbors import cagra
+
+    centers = rng.uniform(-5, 5, (16, 32)).astype(np.float32)
+    n, m, k = 4096, 32, 10
+    x = (centers[rng.integers(0, 16, n)]
+         + 0.7 * rng.standard_normal((n, 32))).astype(np.float32)
+    q = (centers[rng.integers(0, 16, m)]
+         + 0.7 * rng.standard_normal((m, 32))).astype(np.float32)
+    params = cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16, inline_codes=False)
+    sidx = sharded_cagra_build(params, x, eight_device_mesh)
+    assert sidx.dataset.shape[0] == 8
+    sp = cagra.SearchParams(itopk_size=64)
+    dist, idx = sharded_cagra_search(sp, sidx, q, k, eight_device_mesh)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.9
+    # ids must be globally offset & unique per row
+    ii = np.asarray(idx)
+    for r in range(ii.shape[0]):
+        live = ii[r][ii[r] >= 0]
+        assert len(set(live.tolist())) == len(live)
+        assert live.max() < n
+
+
+def test_sharded_ivf_build_row_search(rng, eight_device_mesh):
+    from raft_tpu.comms import sharded_ivf_build, sharded_ivf_row_search
+    from raft_tpu.neighbors import ivf_flat
+
+    n, m, d, k = 4096, 24, 32, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    params = ivf_flat.IndexParams(
+        n_lists=16, kmeans_n_iters=5, kmeans_trainset_fraction=1.0
+    )
+    sidx = sharded_ivf_build(params, x, eight_device_mesh)
+    assert sidx.centers.shape[0] == 8
+    # all shards share shard-0's coarse centers
+    np.testing.assert_array_equal(np.asarray(sidx.centers[0]),
+                                  np.asarray(sidx.centers[3]))
+    sp = ivf_flat.SearchParams(
+        n_probes=16, query_group=8, local_recall_target=1.0
+    )
+    dist, idx = sharded_ivf_row_search(sp, sidx, q, k, eight_device_mesh)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(idx), want) > 0.99
