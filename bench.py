@@ -29,20 +29,33 @@ import time
 import numpy as np
 
 # A100/raft-24.02 reference throughput estimates for the north-star
-# configs (BASELINE.md "What the reference publishes": no numeric tables
-# exist, so these are FLOP/bandwidth roofline figures for an A100-80GB
-# [312 TF/s fp16 tensor, 2.0 TB/s HBM], consistent with the ann-benchmarks
-# raft-24.02 Pareto plot's order of magnitude).
+# configs. The reference publishes NO numeric tables (only the H100
+# recall-vs-QPS Pareto plot, docs/source/raft_ann_benchmarks.md:254) and
+# this environment has no network to fetch public runs, so every
+# denominator below is a FLOP/bandwidth roofline for an A100-80GB
+# [312 TF/s fp16 tensor, 2.0 TB/s HBM] with its derivation and
+# confidence documented per entry (BASELINE.md "Baseline provenance").
 _BASELINES = {
-    # 10k x 10k x 128 L2 + top-k: compute-bound at ~50% tensor peak
+    # 10k x 10k x 128 L2 + top-k = 33 GFLOP/batch; at ~50% tensor peak
+    # plus selection overhead -> ~2e6 QPS. Confidence MEDIUM (pure
+    # roofline; public GPU brute-force numbers at this shape are scarce).
     "bruteforce_sift10k_qps": 2.0e6,
-    # nlist=1024, nprobe=64, batch 10k, r@10>0.95: ~1/16 of dataset scanned
+    # nlist=1024, nprobe=64, batch 10k, r@10~0.95: scans ~1/16 of 512 MB
+    # per query batch -> HBM-bound ~4e5 QPS. Confidence MEDIUM-HIGH
+    # (consistent with the H100 Pareto plot's IVF-Flat band scaled to
+    # A100 bandwidth).
     "ivfflat_sift1m_qps": 4.0e5,
-    # pairwise 10k x 10k x 128 fp32: HBM-bound on the 400 MB output
+    # pairwise 10k x 10k x 128 f32: bound by the 400 MB output write,
+    # ~0.7x of 2 TB/s effective. Confidence HIGH (straight bandwidth).
     "pairwise_l2_gbps": 1400.0,
-    # DEEP-10M pq48x8, nprobe=128: LUT-gather bound
+    # DEEP-10M pq48x8, nprobe=128: LUT-gather bound; scaled from the
+    # reference's DEEP-100M positioning. Confidence LOW-MEDIUM (config
+    # scaled down from the published 100M benchmarks).
     "ivfpq_deep10m_qps": 2.0e5,
-    # CAGRA deg32 SIFT-1M, r@10~0.95 (the reference's flagship config)
+    # CAGRA deg32 SIFT-1M r@10~0.95 batch 10k: the CAGRA paper
+    # (arXiv:2308.15136, fig. batch-throughput) places A100 large-batch
+    # SIFT-1M throughput in the 5e5-1e6 band at 0.95. Confidence MEDIUM
+    # (anchored to the paper's published order of magnitude).
     "cagra_sift1m_qps": 6.0e5,
 }
 

@@ -299,7 +299,13 @@ def sharded_cagra_search(
     the beam search on its own sub-graph, local ids get the shard's row
     offset, and the per-shard top-ks are all-gathered + merged over ICI
     (the knn_merge_parts-over-comms pattern,
-    detail/knn_merge_parts.cuh:140)."""
+    detail/knn_merge_parts.cuh:140).
+
+    NOTE: the per-shard search is the exact scattered-gather path, not
+    the fused Pallas beam kernel (per-shard packed tables would need
+    stacked [S, rows, W] layouts threaded through shard_map — a known
+    follow-up); expect single-chip CAGRA QPS ratios to understate the
+    sharded path accordingly."""
     from raft_tpu.neighbors import cagra
 
     queries = jnp.asarray(queries)

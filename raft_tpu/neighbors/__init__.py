@@ -18,7 +18,8 @@ from raft_tpu.neighbors.common import (
     knn_merge_parts,
     merge_topk,
 )
-from raft_tpu.neighbors.refine import refine
+from raft_tpu.neighbors.refine import refine, refine_host
+from raft_tpu.neighbors import stream
 
 __all__ = [
     "ball_cover",
@@ -29,6 +30,8 @@ __all__ = [
     "ivf_flat",
     "ivf_pq",
     "refine",
+    "refine_host",
+    "stream",
     "BitsetFilter",
     "IndexParams",
     "NoneSampleFilter",

@@ -204,8 +204,7 @@ def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
     codes = jnp.clip(jnp.round(d32 / scale), -127, 127).astype(jnp.int8)
     norms = jnp.sum(d32 * d32, axis=1) if need_norms else None
 
-    a128 = lambda v: -(-v // 128) * 128
-    dw = deg * d // 4
+    from raft_tpu.ops.beam_step import _a128 as a128
 
     def pack_chunk(gc):                        # [c, deg] raw graph rows
         c = gc.shape[0]
@@ -215,9 +214,9 @@ def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
         words = (
             b[:, 0::4] | (b[:, 1::4] << 8) | (b[:, 2::4] << 16)
             | (b[:, 3::4] << 24)
-        ).astype(jnp.int32)                    # [c, dw]
-        # every region is padded to a 128-lane multiple: the kernel's
-        # dynamic loads need 128-aligned lane offsets
+        ).astype(jnp.int32)                    # [c, deg*d/4]
+        # region order + 128-lane padding follow beam_step.packed_row_layout
+        # (the one definition shared with the kernel decode)
         pad_r = lambda x: jnp.pad(x, ((0, 0), (0, a128(x.shape[1]) - x.shape[1])))
         parts = [pad_r(words)]
         if need_norms:
@@ -240,13 +239,15 @@ def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
 def _attach_inline(index: Index, inline: bool) -> Index:
     n, d = index.dataset.shape
     deg = index.graph.shape[1]
-    a128 = lambda v: -(-v // 128) * 128
+    from raft_tpu.ops.beam_step import packed_row_layout
+
+    need_norms = index.metric != DistanceType.InnerProduct
     # true packed-row bytes incl. the per-region 128-lane alignment pad
-    row_bytes = 4 * (a128(deg * d // 4) + 2 * a128(deg))
+    row_bytes = (4 * packed_row_layout(deg, d, not need_norms)[3]
+                 if d % 4 == 0 else 0)
     if not inline or d % 4 or n * row_bytes > _INLINE_BUDGET \
             or n >= (1 << 30):   # beam kernel packs ids as (id<<1)|flag
         return index
-    need_norms = index.metric != DistanceType.InnerProduct
     nbr_pack, flat_codes, scale = _pack_tables(
         index.dataset, index.graph, need_norms
     )
@@ -267,17 +268,35 @@ def build_knn_graph(
     metric: DistanceType,
     refine_rate: float = 2.0,
     query_batch: int = 16384,
+    min_degree: Optional[int] = None,
 ) -> jax.Array:
     """Raw KNN graph via IVF-PQ self-search + exact refine (reference
     detail/cagra/cagra_build.cuh:43; params heuristic :60-68; batch loop
-    :103-155). Returns [n, intermediate_degree] int32 (self excluded)."""
+    :103-155). Returns [n, min(intermediate_degree, 63)] int32 when the
+    fast path applies (below), else [n, intermediate_degree]; self
+    excluded. ``min_degree`` (the final graph degree) bounds how far the
+    fast path may trim the column count."""
     from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.refine import refine
 
     dataset = jnp.asarray(dataset)
     n, d = dataset.shape
     k = int(intermediate_degree) + 1          # +1: drop self afterwards
+    # The fused Pallas IVF scan auto-dispatches only at k <= 64 (its
+    # exact in-kernel extraction budget); k=65 searches fall back to the
+    # XLA decode-scan, measured 5x slower (2.53 s vs 0.50 s per 16k-query
+    # batch at SIFT-1M). When 63 candidate columns still satisfy the
+    # final graph degree, search k=64 and drop self (-> 63 exact-reranked
+    # neighbors) to keep the whole self-search on the fast path; optimize
+    # prunes to graph_degree anyway, so 64-vs-63 intermediate candidates
+    # is noise. Configs needing >= 64 final columns keep the exact k
+    # (slower XLA scan) — correctness over speed.
+    if k > 64 and min_degree is not None and min_degree <= 63:
+        k = 64       # None (direct callers) keeps the exact column count
+    k = min(k, n)    # tiny datasets: refine k cannot exceed n candidates
     gpu_top_k = min(n, max(k, int(k * refine_rate)))
+    if k <= 64 and gpu_top_k > 64:
+        gpu_top_k = 64                        # stay on the fused path
 
     # reference heuristic: n_lists ~ n/2500, pq_dim ~ d/2 rounded up
     n_lists = int(np.clip(n // 2500, 16, 1024))
@@ -306,8 +325,9 @@ def build_knn_graph(
     for start in range(0, n, query_batch):
         q = dataset[start:start + query_batch]
         _, cand = ivf_pq.search(sp, index, q, gpu_top_k)
-        if gpu_top_k > k:
-            _, cand = refine(dataset, q, cand, k, metric)
+        # always exact-rerank: optimize consumes RANK order, and PQ ranks
+        # are approximate even when gpu_top_k == k (0.13 s per 16k batch)
+        _, cand = refine(dataset, q, cand, k, metric)
         rows.append(cand)
     graph = jnp.concatenate(rows, axis=0)     # [n, k]
 
@@ -315,7 +335,8 @@ def build_knn_graph(
     self_col = graph == jnp.arange(n, dtype=graph.dtype)[:, None]
     # stable push of self (or worst candidate) to the end, then cut
     order = jnp.argsort(self_col.astype(jnp.int32), axis=1, stable=True)
-    graph = jnp.take_along_axis(graph, order, axis=1)[:, : int(intermediate_degree)]
+    keep = min(int(intermediate_degree), k - 1)
+    graph = jnp.take_along_axis(graph, order, axis=1)[:, :keep]
     return graph.astype(jnp.int32)
 
 
@@ -451,7 +472,8 @@ def build(params: IndexParams, dataset) -> Index:
         knn = nn_descent.build(nd_params, dataset).graph
     else:
         knn = build_knn_graph(
-            dataset, int(params.intermediate_graph_degree), metric
+            dataset, int(params.intermediate_graph_degree), metric,
+            min_degree=int(params.graph_degree),
         )
     graph = optimize(knn, int(params.graph_degree))
     norms = None
@@ -620,7 +642,7 @@ def _finalize(out_d, out_i, q32, metric):
     return out_d, out_i
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10, 12))
 def _beam_search(
     queries,       # [m, d] f32
     dataset,       # [n, d]
@@ -633,6 +655,8 @@ def _beam_search(
     metric_val: int,
     compute_dtype: str = "f32",
     n_seeds: int = 0,
+    filter_bits=None,
+    filter_nbits: int = 0,
 ):
     """Scattered-gather beam search (exact scoring; used when the index
     has no inline layout). Selection/merge are bitonic networks — see
@@ -680,14 +704,22 @@ def _beam_search(
     # valued datasets tie bitwise between DISTINCT points, which can split
     # a duplicate run past the loop's window-2 reach
     L = _next_pow2(itopk)
-    fd = _pad_cols(jnp.where(buf_i < 0, jnp.inf, buf_d), L, jnp.inf)
+    fd = jnp.where(buf_i < 0, jnp.inf, buf_d)
+    if filter_nbits:
+        # prefilter applies at result extraction only — traversal stays
+        # unfiltered like the reference (cagra.cuh:373 filtered search)
+        from raft_tpu.neighbors.common import filter_keep
+
+        fd = jnp.where(filter_keep(filter_bits, filter_nbits, buf_i),
+                       fd, jnp.inf)
+    fd = _pad_cols(fd, L, jnp.inf)
     fi = _pad_cols(buf_i, L, -1)
     fd, (fi,) = sort_by_key(fd, fi)
     fd, fi = _exact_dedup_prefix(fd, fi, k)
     return _finalize(fd, fi, q32, metric)
 
 
-@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13))
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13, 15))
 def _beam_search_pallas(
     queries,       # [m0, d] f32
     dataset,       # [n, d] (exact rescore)
@@ -703,6 +735,8 @@ def _beam_search_pallas(
     metric_val: int,
     n_seeds: int = 0,
     interpret: bool = False,
+    filter_bits=None,
+    filter_nbits: int = 0,
 ):
     """Fused beam search: XLA gathers the packed int32 neighbor rows
     (row gathers are XLA's strength; the int32 fused row measured ~7x
@@ -782,6 +816,10 @@ def _beam_search_pallas(
     # (measured: R=32 vs 64 at k=10 changes recall < 0.002, saves ~2 ms
     # of the fixed cost at m=10k)
     R = min(itopk, max(32, _next_pow2(2 * k)))
+    if filter_nbits:
+        # with a prefilter, rescore the whole buffer so enough unfiltered
+        # candidates survive result extraction
+        R = itopk
     ri = buf_i.T[:m0, :R]
     q0 = q32[:m0]
     rvec = dataset[jnp.maximum(ri, 0)].astype(jnp.float32)  # [m0, R, d]
@@ -791,6 +829,13 @@ def _beam_search_pallas(
     else:
         rd = (rvec * rvec).sum(-1) - 2.0 * rdots
     rd = jnp.where(ri < 0, jnp.inf, rd)
+    if filter_nbits:
+        # prefilter applies at result extraction only — traversal stays
+        # unfiltered like the reference (cagra.cuh:373 filtered search)
+        from raft_tpu.neighbors.common import filter_keep
+
+        rd = jnp.where(filter_keep(filter_bits, filter_nbits, ri),
+                       rd, jnp.inf)
     LR = _next_pow2(R)
     rd = _pad_cols(rd, LR, jnp.inf)
     ri = _pad_cols(ri, LR, -1)
@@ -835,12 +880,25 @@ def search(
     index: Index,
     queries,
     k: int,
+    prefilter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched beam search (reference cagra.cuh:299 search). Uses the
     fused Pallas beam kernel over the packed inline layout when the
     index carries one (built by default), else the exact
-    scattered-gather path."""
+    scattered-gather path.
+
+    ``prefilter`` (a core.Bitset or BitsetFilter) restricts RESULTS to
+    set bits; graph traversal stays unfiltered, mirroring the
+    reference's cagra filtered search (cagra.cuh:373-404,
+    sample_filter_types.hpp). With aggressive filters raise
+    ``itopk_size`` so enough unfiltered candidates survive."""
+    from raft_tpu.neighbors.common import as_filter
+
     queries = jnp.asarray(queries)
+    filt = as_filter(prefilter)
+    bits = getattr(filt, "bitset", None)
+    fbits = None if bits is None else bits.bits
+    fnbits = 0 if bits is None else int(bits.n_bits)
     itopk, width, iters, n_seeds = search_plan(search_params, k)
     dtype = str(search_params.compute_dtype)
     impl = _resolve_beam_impl(str(search_params.scan_impl), index, dtype)
@@ -870,6 +928,8 @@ def search(
             int(index.metric),
             n_seeds,
             impl == "pallas_interpret",
+            fbits,
+            fnbits,
         )
     return _beam_search(
         queries,
@@ -883,6 +943,8 @@ def search(
         int(index.metric),
         "f32" if dtype == "auto" else dtype,
         n_seeds,
+        fbits,
+        fnbits,
     )
 
 

@@ -1,0 +1,144 @@
+"""Independent reader for hnswlib v0 index files.
+
+Counterpart check for ``cagra.serialize_to_hnswlib`` (reference export:
+detail/cagra/cagra_serialize.cuh serialize_to_hnswlib, consumed
+base-layer-only by bench/ann/src/raft/raft_cagra_hnswlib_wrapper.h:96).
+The real hnswlib is not installable in this environment, so this module
+re-implements ``HierarchicalNSW::loadIndex``'s on-disk contract from the
+hnswlib source (hnswalg.h loadIndex: header scalars in declaration
+order, then ``cur_element_count`` fixed-stride level-0 records of
+[linklist | data | label], then per-node level ints) — deliberately
+DRIVEN BY THE HEADER FIELDS (size_data_per_element_, offsetData_,
+label_offset_) rather than recomputing the writer's arithmetic, so a
+writer/layout disagreement shows up as a parse failure instead of a
+symmetric pass.
+
+Also provides a greedy base-layer search so tests can prove the loaded
+structure is actually navigable, not just byte-identical.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class HnswIndex:
+    data: np.ndarray        # [n, dim] f32
+    links: np.ndarray       # [n, maxM0] int32 (-1 padded)
+    labels: np.ndarray      # [n] int64
+    entrypoint: int
+    maxM0: int
+    M: int
+    ef_construction: int
+
+
+def load_hnswlib_index(path: str, dim: int) -> HnswIndex:
+    """Parse an hnswlib v0 file (base layer). ``dim`` is external input,
+    as in hnswlib (the file does not store it — the space does)."""
+    with open(path, "rb") as f:
+        def u64():
+            return struct.unpack("<Q", f.read(8))[0]
+
+        offset_level0 = u64()
+        max_elements = u64()
+        cur_count = u64()
+        size_data_per_element = u64()
+        label_offset = u64()
+        offset_data = u64()
+        (maxlevel,) = struct.unpack("<i", f.read(4))
+        (entrypoint,) = struct.unpack("<I", f.read(4))
+        maxM = u64()
+        maxM0 = u64()
+        M = u64()
+        (mult,) = struct.unpack("<d", f.read(8))
+        ef_construction = u64()
+
+        # structural consistency (loadIndex asserts the same relations)
+        if offset_level0 != 0:
+            raise ValueError(f"offsetLevel0 must be 0, got {offset_level0}")
+        data_size = dim * 4
+        if label_offset + 8 != size_data_per_element:
+            raise ValueError("label region does not end the element record")
+        if offset_data + data_size != label_offset:
+            raise ValueError("data region does not abut the label region")
+        if offset_data < 4 + 4 * maxM0:
+            raise ValueError("link region too small for maxM0 links")
+        if cur_count > max_elements:
+            raise ValueError("cur_element_count exceeds max_elements")
+
+        raw = f.read(cur_count * size_data_per_element)
+        if len(raw) != cur_count * size_data_per_element:
+            raise ValueError("truncated level-0 records")
+        levels = np.frombuffer(f.read(cur_count * 4), dtype="<i4")
+        if levels.size != cur_count:
+            raise ValueError("truncated element_levels")
+        if maxlevel == 0 and levels.any():
+            raise ValueError("maxlevel=0 but nonzero element levels present")
+
+    rec = np.frombuffer(raw, dtype=np.uint8).reshape(
+        cur_count, size_data_per_element
+    )
+    # linklist: uint16 count (hnswlib setListCount) in the first 2 bytes
+    counts = rec[:, :2].copy().view("<u2")[:, 0].astype(np.int64)
+    if (counts > maxM0).any():
+        raise ValueError("link count exceeds maxM0")
+    links_raw = rec[:, 4:4 + 4 * maxM0].copy().view("<i4").reshape(
+        cur_count, maxM0
+    ).astype(np.int32)
+    lane = np.arange(maxM0)[None, :]
+    links = np.where(lane < counts[:, None], links_raw, -1)
+    if ((links >= int(cur_count)) | ((links < 0) & (links != -1))).any():
+        raise ValueError("link target out of range")
+    data = rec[:, offset_data:offset_data + data_size].copy().view(
+        "<f4"
+    ).reshape(cur_count, dim)
+    labels = rec[:, label_offset:label_offset + 8].copy().view(
+        "<i8"
+    )[:, 0].copy()
+    return HnswIndex(
+        data=data, links=links, labels=labels, entrypoint=int(entrypoint),
+        maxM0=int(maxM0), M=int(M), ef_construction=int(ef_construction),
+    )
+
+
+def greedy_search(index: HnswIndex, query: np.ndarray, k: int,
+                  ef: int = 64, max_hops: int = 500):
+    """Base-layer best-first search (hnswlib searchBaseLayerST's
+    algorithm in plain numpy/heapq) — proves the exported graph is
+    navigable the way hnswlib would navigate it."""
+    import heapq
+
+    q = np.asarray(query, np.float32)
+
+    def dist(i):
+        d = index.data[i] - q
+        return float(d @ d)
+
+    ep = index.entrypoint
+    visited = {ep}
+    cand = [(dist(ep), ep)]              # min-heap of candidates
+    top = [(-cand[0][0], ep)]            # max-heap (neg) of best ef
+    hops = 0
+    while cand and hops < max_hops:
+        d_c, c = heapq.heappop(cand)
+        if top and d_c > -top[0][0] and len(top) >= ef:
+            break
+        for nb in index.links[c]:
+            if nb < 0 or nb in visited:
+                continue
+            visited.add(nb)
+            d_n = dist(nb)
+            if len(top) < ef or d_n < -top[0][0]:
+                heapq.heappush(cand, (d_n, nb))
+                heapq.heappush(top, (-d_n, nb))
+                if len(top) > ef:
+                    heapq.heappop(top)
+        hops += 1
+    best = sorted(((-nd, i) for nd, i in top))[:k]
+    ids = np.array([index.labels[i] for _, i in best], np.int64)
+    ds = np.array([d for d, _ in best], np.float32)
+    return ds, ids

@@ -340,6 +340,26 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
     elif not stream:
         dataset = jnp.asarray(dataset)
     n, dim = dataset.shape
+
+    # coarse centers train on a subsample (build.cuh: build_clusters)
+    frac = float(params.kmeans_trainset_fraction)
+    if 0 < frac < 1.0 and int(n * frac) >= int(params.n_lists):
+        trainset = jnp.asarray(dataset[:: max(int(1.0 / frac), 1)])
+    else:
+        trainset = jnp.asarray(dataset)
+    index = _quantizer_index(params, trainset, dim)
+    if not params.add_data_on_build:
+        return index
+    if not stream:
+        return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+    return _stream_encode(params, index, dataset, n, int(batch_size))
+
+
+def _quantizer_index(params: IndexParams, trainset, dim: int) -> Index:
+    """Train all quantizers (coarse centers, rotation, PQ codebooks) on
+    ``trainset`` and return the EMPTY index (reference ivf_pq_build.cuh
+    steps: build_clusters, make_rotation_matrix:122, select_residuals:166,
+    train_per_subset:395 / train_per_cluster:472)."""
     n_lists = int(params.n_lists)
     pq_dim = int(params.pq_dim) or _auto_pq_dim(dim)
     pq_len = -(-dim // pq_dim)
@@ -347,12 +367,6 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
     K = 1 << int(params.pq_bits)
     key = jax.random.PRNGKey(0)
 
-    # 1. coarse centers on a trainset (build.cuh: build_clusters)
-    frac = float(params.kmeans_trainset_fraction)
-    if 0 < frac < 1.0 and int(n * frac) >= n_lists:
-        trainset = jnp.asarray(dataset[:: max(int(1.0 / frac), 1)])
-    else:
-        trainset = jnp.asarray(dataset)
     kb = KMeansBalancedParams(
         n_clusters=n_lists,
         n_iters=int(params.kmeans_n_iters),
@@ -428,15 +442,18 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         pq_bits=int(params.pq_bits),
         cache_decoded=bool(params.cache_decoded),
     )
-    if not params.add_data_on_build:
-        return index
-    if not stream:
-        return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+    return index
 
-    # streaming encode: fixed-shape batches keep one compiled encoder;
-    # only compressed codes accumulate on device. Device-resident
-    # datasets are sliced in place (a host round-trip through the
-    # BatchLoadIterator would cost minutes over the dev tunnel).
+
+def _stream_encode(params: IndexParams, index: Index, dataset, n: int,
+                   batch_size: int) -> Index:
+    """Streaming encode over a materialized (host or device) dataset:
+    fixed-shape batches keep one compiled encoder; only compressed codes
+    accumulate on device. Device-resident datasets are sliced in place
+    (a host round-trip through the BatchLoadIterator would cost minutes
+    over the dev tunnel)."""
+    n_lists = index.n_lists
+    pq_dim = index.pq_dim
     parts_labels, parts_codes = [], []
     if isinstance(dataset, jax.Array):
         bs = int(batch_size)
@@ -485,6 +502,245 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         list_sizes=list_sizes,
         rec_norms=rec_norms,
     ))
+
+
+def build_streamed(
+    params: IndexParams,
+    make_batches,
+    n: int,
+    dim: int,
+    trainset,
+    keep_codes: bool = True,
+    cap_rows: Optional[int] = None,
+    verbose: bool = False,
+) -> Index:
+    """Build from a RE-ITERABLE stream of fixed-shape device batches —
+    the path for datasets too large for HBM *or host RAM* (DEEP-100M at
+    f32 is 38 GB; the reference handles this scale by mmap +
+    batch_load_iterator, ann_utils.cuh:397 + dataset.hpp:45).
+
+    ``make_batches()`` must return a fresh iterator of [batch, dim]
+    device arrays each call (iterated twice: label-count pass, then
+    encode+scatter pass); the final batch may be zero-padded — only the
+    first ``n`` total rows are stored. ``trainset`` is the
+    quantizer-training subsample (device array).
+
+    Memory model: accumulators are written in place per batch via buffer
+    donation, so peak HBM is the final index plus ONE batch's transients
+    — the materialized [n, n_words] code slab of the `build(batch_size=)`
+    path never exists. With ``keep_codes=False`` the packed codes
+    themselves are dropped and only the int8 decoded-residual cache is
+    stored (codes and cache together exceed HBM at 100M scale); such an
+    index searches via the fused cache path only.
+    """
+    from raft_tpu.neighbors.ivf_flat import _aligned_cap
+
+    import time as _time
+
+    _t0 = _time.time()
+    index = _quantizer_index(params, jnp.asarray(trainset), int(dim))
+    jax.block_until_ready(index.pq_centers)
+    trainset = None   # free before the accumulators go up (HBM headroom)
+    if verbose:
+        print(f"[build_streamed] quantizers: {_time.time()-_t0:.0f} s",
+              flush=True)
+    C = index.n_lists
+    pq_dim = index.pq_dim
+    pq_bits = int(params.pq_bits)
+    nw = packed_words(pq_dim, pq_bits)
+    rot = index.rot_dim
+    kb = KMeansBalancedParams(
+        n_clusters=C,
+        metric=(
+            DistanceType.InnerProduct
+            if params.metric == DistanceType.InnerProduct
+            else DistanceType.L2Expanded
+        ),
+    )
+
+    # ---- pass 1: labels for every row (4 B/row; reused in pass 2) ----
+    # throttle: async dispatch would otherwise enqueue EVERY generated
+    # batch ahead of execution (batches alive until consumed -> tens of
+    # GB of queued inputs); a tiny host fetch forces real completion
+    # (block_until_ready does not reliably sync on the tunnel platform)
+    parts = []
+    for bi, batch in enumerate(make_batches()):
+        parts.append(kmeans_balanced.predict(kb, index.centers, batch))
+        if bi % 8 == 7:
+            np.asarray(parts[-1][0])
+    labels_all = jnp.concatenate(parts)
+    del parts
+    total = labels_all.shape[0]
+    labels_all = jnp.where(
+        jnp.arange(total) < n, labels_all, C   # padding rows -> dropped
+    ).astype(jnp.int32)
+    counts = jnp.zeros((C + 1,), jnp.int32).at[labels_all].add(1)[:C]
+    cap = _aligned_cap(int(counts.max()))
+    if cap_rows is not None and cap > cap_rows:
+        # bounded list capacity: overflow rows of outlier lists are
+        # DROPPED (the accumulator's slot bound), trading a small stored
+        # fraction for an HBM-sized index — callers see the truncation in
+        # list_sizes.sum(); padding-vs-max-list imbalance at 100M scale
+        # otherwise inflates the codes array past HBM
+        cap = _aligned_cap(int(cap_rows))
+    if verbose:
+        dropped = int(jnp.maximum(counts - cap, 0).sum())
+        try:
+            st = jax.devices()[0].memory_stats()
+            mem = f" hbm_in_use={st.get('bytes_in_use', 0)/2**30:.2f}G"
+        except Exception:  # noqa: BLE001
+            mem = ""
+        print(f"[build_streamed] pass1 labels: {_time.time()-_t0:.0f} s "
+              f"cap={cap} dropped={dropped}{mem}", flush=True)
+
+    want_cache = bool(params.cache_decoded) and C * cap * rot <= _CACHE_BUDGET
+    if not keep_codes and not want_cache:
+        raise ValueError(
+            "keep_codes=False requires the decoded-residual cache "
+            "(cache_decoded=True and C*cap*rot_dim within _CACHE_BUDGET)"
+        )
+    scale = jnp.maximum(jnp.max(jnp.abs(index.pq_centers)), 1e-30) / 127.0
+
+    # ---- pass 2: encode + donated scatter into the final layout ------
+    # accumulators stay FLAT [C*cap, ...] through the loop: a 2-D-indexed
+    # scatter on [C, cap, ...] makes XLA relayout-copy the whole multi-GB
+    # operand per call, while the 1-D row scatter aliases the donated
+    # buffer; the final 3-D view is a donated in-jit reshape (bitcast)
+    acc_codes = jnp.zeros((C * cap, nw if keep_codes else 0), jnp.uint32)
+    acc_cache = jnp.zeros((C * cap, rot if want_cache else 0), jnp.int8)
+    acc_norms = jnp.zeros((C * cap,), jnp.float32)
+    acc_ids = jnp.full((C * cap,), -1, jnp.int32)
+    fill = jnp.zeros((C,), jnp.int32)
+    off = 0
+    nbatch = 0
+    for batch in make_batches():
+        bs = batch.shape[0]
+        lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
+        acc_codes, acc_cache, acc_norms, acc_ids, fill = (
+            _scatter_encode_batch(
+                acc_codes, acc_cache, acc_norms, acc_ids, fill,
+                batch, lab, jnp.int32(off), scale,
+                index.centers_rot, index.rotation, index.pq_centers,
+                C, cap, int(index.codebook_kind), pq_dim, pq_bits,
+                keep_codes, want_cache,
+            )
+        )
+        nbatch += 1
+        if nbatch % 4 == 0:
+            np.asarray(fill[0])        # throttle the async queue (above)
+        if verbose and nbatch == 1:
+            np.asarray(fill[0])
+            print("[build_streamed] first scatter ok", flush=True)
+        off += bs
+
+    out = dataclasses.replace(
+        index,
+        codes=_donated_reshape3(acc_codes, C, cap),
+        indices=_donated_reshape2(acc_ids, C, cap),
+        list_sizes=jnp.minimum(fill, cap),
+        rec_norms=_donated_reshape2(acc_norms, C, cap),
+        recon_cache=(_donated_reshape3(acc_cache, C, cap)
+                     if want_cache else None),
+        recon_scale=float(scale) if want_cache else 1.0,
+    )
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(1, 2))
+def _donated_reshape3(a, C: int, cap: int):
+    """Leading-dim split reshape that ALIASES the (donated) input — the
+    op-by-op equivalent copies the multi-GB accumulator."""
+    return a.reshape(C, cap, -1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(1, 2))
+def _donated_reshape2(a, C: int, cap: int):
+    return a.reshape(C, cap)
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2, 3, 4),
+    static_argnums=(12, 13, 14, 15, 16, 17, 18),
+)
+def _scatter_encode_batch(
+    acc_codes, acc_cache, acc_norms, acc_ids, fill,
+    batch, labels, id0, scale, centers_rot, rotation, pq_centers,
+    C: int, cap: int, codebook_kind: int, pq_dim: int, pq_bits: int,
+    keep_codes: bool, want_cache: bool,
+):
+    """Encode one batch and scatter rows into their final list slots
+    (donated accumulators -> in-place updates; the _pack_lists slotting
+    logic, offset by the running per-list fill). Accumulators are FLAT
+    [C*cap, ...]: 1-D row scatters alias the donated buffers, where
+    2-D-indexed scatters forced an 8.5 GB relayout copy per call."""
+    bs, dim = batch.shape
+    pq_len = rotation.shape[0] // pq_dim
+    K = pq_centers.shape[1]
+    x32 = batch.astype(jnp.float32)
+    x_rot = dist_dot(x32, rotation.T)
+    res = (x_rot - centers_rot[jnp.minimum(labels, C - 1)]).reshape(
+        bs, pq_dim, pq_len
+    )
+    lab_safe = jnp.minimum(labels, C - 1)
+    if codebook_kind == codebook_gen.PER_SUBSPACE:
+        codes = _encode_subspace(res, pq_centers, K)
+        flat_idx = codes.astype(jnp.int32) + (
+            jnp.arange(pq_dim, dtype=jnp.int32) * K
+        )
+    else:
+        codes = _encode_per_cluster(res, lab_safe, pq_centers)
+        flat_idx = codes.astype(jnp.int32) + (lab_safe * K)[:, None]
+    # ||recon||^2 = sum_s ||book_s[code_s]||^2 — a norm-TABLE gather whose
+    # minor dim is pq_dim, not pq_len (a [bs, p, len] decode transient is
+    # lane-padded len -> 128 by the TPU layout: 64x memory at len=2)
+    book_norms = jnp.sum(
+        pq_centers.astype(jnp.float32) ** 2, axis=-1
+    ).reshape(-1)
+    rnorm = jnp.sum(jnp.take(book_norms, flat_idx, axis=0), axis=-1)
+
+    ids_global = id0 + jnp.arange(bs, dtype=jnp.int32)
+    # slot assignment: stable sort by label, rank within the batch run,
+    # offset by the accumulated fill (labels == C drop out of bounds)
+    order = jnp.argsort(labels, stable=True)
+    sl = labels[order]
+    counts_b = jnp.zeros((C + 1,), jnp.int32).at[labels].add(1)[:C]
+    starts = jnp.cumsum(counts_b) - counts_b
+    sl_safe = jnp.minimum(sl, C - 1)
+    pos = (jnp.arange(bs) - starts[sl_safe]) + fill[sl_safe]
+    # dropped rows (label C padding / list overflow): out-of-bounds slots
+    # make the scatter update drop
+    slot = jnp.where((sl < C) & (pos < cap), sl * cap + pos, C * cap)
+
+    if keep_codes:
+        packed = pack_codes(codes, pq_bits)
+        acc_codes = acc_codes.at[slot].set(packed[order])
+    if want_cache:
+        # full decode, chunked: the [chunk, p, len] transient is
+        # lane-padded len -> 128, so chunks stay small
+        chunk = 1 << 13
+        npad = -(-bs // chunk) * chunk
+        cpad = jnp.pad(codes, ((0, npad - bs), (0, 0)))
+        lpad = jnp.pad(lab_safe, (0, npad - bs))
+
+        def dec(inp):
+            cb, lb = inp
+            if codebook_kind == codebook_gen.PER_SUBSPACE:
+                r = _decode_gather(cb, pq_centers, codebook_kind)
+            else:
+                r = _decode_gather(cb, pq_centers, codebook_kind, lb)
+            return jnp.clip(jnp.round(r / scale), -127, 127).astype(jnp.int8)
+
+        q = jax.lax.map(
+            dec,
+            (cpad.reshape(npad // chunk, chunk, pq_dim),
+             lpad.reshape(npad // chunk, chunk)),
+        ).reshape(npad, -1)[:bs]
+        acc_cache = acc_cache.at[slot].set(q[order])
+    acc_norms = acc_norms.at[slot].set(rnorm[order])
+    acc_ids = acc_ids.at[slot].set(ids_global[order])
+    fill = fill + counts_b
+    return acc_codes, acc_cache, acc_norms, acc_ids, fill
 
 
 def encode(index: Index, vectors) -> Tuple[jax.Array, jax.Array]:
@@ -917,8 +1173,16 @@ def search(
                 "cache_decoded=True and keep lut_dtype='auto'/'i8')"
                 % requested
             )
+        if index.codes.shape[2] == 0:
+            raise ValueError(
+                "this index was built with keep_codes=False (cache-only); "
+                "decode-path scoring needs the packed codes — search with "
+                "lut_dtype='auto' and the cache scan instead"
+            )
         impl = "xla"
     else:
+        # cache-only indexes are fine on BOTH impls here: the XLA body
+        # also scores from recon_cache when lut_dtype is auto/i8
         impl = _resolve_scan_impl(requested, cap, min(k, cap))
         if impl.startswith("pallas") and k > n_probes * min(cap, 256):
             raise ValueError(

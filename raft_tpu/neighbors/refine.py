@@ -80,3 +80,69 @@ def _refine(dataset, queries, candidates, k: int, metric_val: int):
     sentinel = sentinel_for(metric, compute)
     d = jnp.where(valid, d, sentinel)
     return merge_topk(d, candidates.astype(jnp.int32), k, is_min_close(metric))
+
+
+def refine_host(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric="sqeuclidean",
+    n_threads: int = 0,
+) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Host-side exact re-ranking over numpy (or ``np.memmap``) data —
+    the analog of the reference's OpenMP ``refine_host``
+    (cpp/include/raft/neighbors/detail/refine_host-inl.hpp), used when
+    the dataset lives on the host (e.g. file-backed / larger than HBM).
+
+    ``dataset`` is indexed row-wise only (memmap-friendly); work is
+    split over ``n_threads`` Python threads (numpy releases the GIL in
+    the BLAS/reduction kernels, mirroring the reference's OpenMP loop).
+    """
+    import concurrent.futures as _cf
+    import os as _os
+
+    import numpy as np
+
+    metric = resolve_metric(metric)
+    if metric not in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                      DistanceType.InnerProduct):
+        raise ValueError(f"refine_host supports L2/IP metrics, got {metric!r}")
+    q = np.asarray(queries, dtype=np.float32)
+    cand = np.asarray(candidates)
+    m, c = cand.shape
+    if k > c:
+        raise ValueError(f"k={k} > n_candidates={c}")
+    if n_threads <= 0:
+        n_threads = min(32, _os.cpu_count() or 1)
+    out_d = np.empty((m, k), np.float32)
+    out_i = np.empty((m, k), np.int32)
+    minimize = metric != DistanceType.InnerProduct
+
+    def work(lo, hi):
+        for i in range(lo, hi):
+            ids = cand[i]
+            valid = ids >= 0
+            rows = np.asarray(dataset[ids[valid].astype(np.int64)],
+                              dtype=np.float32)
+            dots = rows @ q[i]
+            if metric == DistanceType.InnerProduct:
+                d = dots
+            else:
+                d = (rows * rows).sum(1) - 2.0 * dots + q[i] @ q[i]
+                np.maximum(d, 0.0, out=d)
+                if metric == DistanceType.L2SqrtExpanded:
+                    np.sqrt(d, out=d)
+            full = np.full(c, np.inf if minimize else -np.inf, np.float32)
+            full[valid] = d
+            order = np.argsort(full if minimize else -full, kind="stable")[:k]
+            out_d[i] = full[order]
+            out_i[i] = np.where(np.isfinite(full[order]), ids[order], -1)
+
+    chunk = max(1, -(-m // n_threads))
+    with _cf.ThreadPoolExecutor(max_workers=n_threads) as ex:
+        futs = [ex.submit(work, lo, min(lo + chunk, m))
+                for lo in range(0, m, chunk)]
+        for f in futs:
+            f.result()
+    return out_d, out_i

@@ -1,0 +1,84 @@
+"""Out-of-core (host/file-resident) search orchestration.
+
+Analog of the reference's ``batch_load_iterator``-driven paths
+(cpp/include/raft/spatial/knn/detail/ann_utils.cuh:397; the ANN bench
+harness mmaps datasets, cpp/bench/ann/src/common/dataset.hpp:45-128):
+queries stream host→device in double-buffered batches (the native
+prefetcher keeps disk IO ahead of the transfers for file sources), each
+batch runs the regular device search, and results land in preallocated
+host arrays. The device only ever holds one query batch + the index.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Tuple
+
+import numpy as np
+
+from raft_tpu.utils.batch import BatchLoadIterator, FileBatchLoadIterator
+
+
+def search_stream(
+    search_fn: Callable,
+    batches: Iterable[Tuple[int, "object"]],
+    n_queries: int,
+    k: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run ``search_fn(query_batch) -> (dists, ids)`` over an iterator of
+    ``(offset, device_batch)`` pairs (``BatchLoadIterator`` /
+    ``FileBatchLoadIterator``), assembling host result arrays.
+
+    Batches may be zero-padded to a fixed shape (``pad_to_full=True`` —
+    one compiled program for every batch); rows beyond ``n_queries`` are
+    dropped.
+    """
+    out_d = np.empty((n_queries, k), np.float32)
+    out_i = np.empty((n_queries, k), np.int32)
+    for offset, batch in batches:
+        d, i = search_fn(batch)
+        rows = min(batch.shape[0], n_queries - offset)
+        out_d[offset:offset + rows] = np.asarray(d[:rows], np.float32)
+        out_i[offset:offset + rows] = np.asarray(i[:rows])
+    return out_d, out_i
+
+
+def search_file(
+    module,
+    search_params,
+    index,
+    queries_path: str,
+    k: int,
+    batch_rows: int = 8192,
+    **search_kwargs,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Stream a ``.fbin``-family query file through ``module.search``
+    (ivf_flat / ivf_pq / cagra / brute_force-style modules) in fixed-size
+    device batches. The file never materializes on the host in full."""
+    it = FileBatchLoadIterator(queries_path, batch_rows, pad_to_full=True)
+
+    def fn(batch):
+        return module.search(search_params, index, batch, k,
+                             **search_kwargs)
+
+    return search_stream(fn, it, it.shape[0], k)
+
+
+def search_host_array(
+    module,
+    search_params,
+    index,
+    queries: np.ndarray,
+    k: int,
+    batch_rows: int = 8192,
+    **search_kwargs,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Same streaming pattern over a host-resident array (numpy or
+    ``np.memmap``) — the double-buffered ``BatchLoadIterator`` overlaps
+    host→device copies with the previous batch's search."""
+    it = BatchLoadIterator(queries, batch_rows, pad_to_full=True)
+
+    def fn(batch):
+        return module.search(search_params, index, batch, k,
+                             **search_kwargs)
+
+    return search_stream(fn, it, queries.shape[0], k)

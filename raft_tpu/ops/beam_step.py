@@ -52,6 +52,24 @@ def _next_pow2(x: int) -> int:
     return 1 << (int(x) - 1).bit_length()
 
 
+def _a128(v: int) -> int:
+    return -(-v // 128) * 128
+
+
+def packed_row_layout(deg: int, d: int, ip: bool = False):
+    """THE single definition of the packed inline row layout, shared by
+    the builder (cagra._pack_tables), the HBM-budget check
+    (cagra._attach_inline) and the kernel decode below: returns
+    ``(dw, o_norm, o_id, W)`` — code-word count, norm-region offset,
+    id-region offset, total int32 row width. Every region is padded to a
+    128-lane multiple (dynamic lane loads need aligned offsets); IP rows
+    carry no norm region."""
+    dw = deg * (d // 4)
+    o_norm = _a128(dw)
+    o_id = o_norm + (0 if ip else _a128(deg))
+    return dw, o_norm, o_id, o_id + _a128(deg)
+
+
 def _sort_rows(kd, payloads, LL: int):
     """Bitonic sort along axis 0 (sublanes) of [LL, G] arrays; payloads
     ride the same compare-exchange.
@@ -174,10 +192,8 @@ def _beam_step_kernel(
         cd_ref, ci_ref = refs[4:]                  # [C, G] VMEM scratch
         C = width * deg
         W = pack_ref.shape[1] // width
-        dw = deg * (d // 4)
-        a128 = lambda v: -(-v // 128) * 128
-        o_norm = a128(dw)                          # region offsets (packed
-        o_id = o_norm + (0 if ip else a128(deg))   # rows are 128-aligned)
+        dw, o_norm, o_id, _W = packed_row_layout(deg, d, ip)
+        a128 = _a128
         qr = qrep_ref[...]                         # [G, 4, dw]
         # per-32-lane-segment reduction as a one-hot MXU matmul (a
         # minor-dim split reshape + sum is an unsupported Mosaic

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""DEEP-100M IVF-PQ north star (BASELINE.json config #4): 100M x 96,
+pq_dim=64, n_probes=128, k=10 — run once per round on the real chip,
+artifact committed as DEEP100M_r{N}.json.
+
+The reference demonstrates this scale via mmap + batch_load_iterator
+(python/raft-ann-bench/.../conf/deep-100M.json; dataset.hpp:45-128); at
+f32 the dataset is 38 GB — bigger than HBM *and* than what the dev
+tunnel could upload in hours — so batches are GENERATED on device from
+a fixed seed (the bench-wide synthetic manifold recipe) and streamed
+through ``ivf_pq.build_streamed``'s donated-scatter encoder; ground
+truth runs the same generator through a streaming brute-force merge.
+
+Usage: python scripts/deep100m.py [out.json] [--n 100000000]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    out_path = args[0] if args else "DEEP100M.json"
+    n = 100_000_000
+    if "--n" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--n") + 1])
+    d, nq, k = 96, 10_000, 10
+    bs = 500_000
+    n_lists = 32768 if n > 20_000_000 else 4096
+    n_probes = 128
+
+    from raft_tpu.bench.run import _gen_device_block
+    from raft_tpu.bench.harness import scan_qps_time, compute_recall
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.common import merge_topk
+
+    gen = _gen_device_block(bs, d, 16)
+    key0 = jax.random.PRNGKey(71)
+    nb = -(-n // bs)
+
+    def make_batches():
+        for b in range(nb):
+            yield gen(jax.random.fold_in(key0, b))
+
+    qgen = _gen_device_block(nq, d, 16)
+    queries = qgen(jax.random.fold_in(key0, 10_000))
+    jax.block_until_ready(queries)
+
+    res = {"config": {"n": n, "dim": d, "n_lists": n_lists,
+                      "pq_dim": 64, "pq_bits": 8, "n_probes": n_probes,
+                      "k": k, "batch_rows": bs}}
+
+    # ---- build ---------------------------------------------------------
+    # trainset: 4M rows (125 rows/list at 32k lists); codes-only at this
+    # scale — the int8 cache (>=12.8 GB) cannot share HBM with the codes
+    params = ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=64, pq_bits=8, kmeans_n_iters=10,
+        cache_decoded=False,
+    )
+    t0 = time.time()
+
+    def make_trainset():
+        return jnp.concatenate(
+            [gen(jax.random.fold_in(key0, b)) for b in range(8)]
+        )   # 4M rows at bs=500k
+
+    # cap lists at 1.4x the mean: the codes accumulator must fit HBM
+    # beside the batch transients; outlier-list overflow rows are dropped
+    # (reported in stored_rows). The trainset is passed as a temporary so
+    # build_streamed can free it before the accumulators go up.
+    index = ivf_pq.build_streamed(
+        params, make_batches, n, d, make_trainset(),
+        cap_rows=int(1.4 * n / n_lists), verbose=True,
+    )
+    jax.block_until_ready(index.list_sizes)
+    build_s = time.time() - t0
+    sizes = np.asarray(index.list_sizes)
+    res["build_s"] = round(build_s, 1)
+    res["cap"] = int(index.codes.shape[1])
+    res["list_size_mean"] = float(sizes.mean())
+    res["list_size_max"] = int(sizes.max())
+    res["stored_rows"] = int(sizes.sum())
+    print(f"build: {build_s:.0f} s  cap={res['cap']} "
+          f"stored={res['stored_rows']}", flush=True)
+
+    # ---- ground truth: streaming exact brute force ---------------------
+    t0 = time.time()
+    sub = 1000
+    qs = queries[:sub]
+    qn = jnp.sum(qs.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+
+    @jax.jit
+    def partial_knn(batch, off):
+        b32 = batch.astype(jnp.float32)
+        dots = jnp.dot(qs, b32.T, preferred_element_type=jnp.float32)
+        dist = qn + jnp.sum(b32 * b32, axis=1)[None, :] - 2.0 * dots
+        dd, ii = jax.lax.top_k(-dist, k)
+        return -dd, ii + off
+
+    cur_d = jnp.full((sub, k), jnp.inf)
+    cur_i = jnp.full((sub, k), -1, jnp.int32)
+    for b in range(nb):
+        bd, bi = partial_knn(gen(jax.random.fold_in(key0, b)),
+                             jnp.int32(b * bs))
+        gd = jnp.concatenate([cur_d, bd], axis=1)
+        gi = jnp.concatenate([cur_i, bi], axis=1)
+        cur_d, cur_i = merge_topk(gd, gi, k, True)
+        if b % 8 == 7:
+            np.asarray(cur_i[0, 0])    # throttle the async queue
+    # mask padded tail rows (ids >= n)
+    cur_i = np.asarray(jnp.where(cur_i < n, cur_i, -1))
+    res["groundtruth_s"] = round(time.time() - t0, 1)
+    print(f"groundtruth: {res['groundtruth_s']} s", flush=True)
+
+    # ---- search --------------------------------------------------------
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bf16")
+    dist, idx = ivf_pq.search(sp, index, queries, k)
+    np.asarray(idx[0, 0])
+    t0 = time.time()
+    _, idx2 = ivf_pq.search(sp, index, jnp.roll(queries, 1, axis=0), k)
+    np.asarray(idx2[0, 0])
+    rough_s = max(time.time() - t0, 0.1)
+    recall = compute_recall(np.asarray(idx[:sub]), cur_i)
+    n2 = int(np.clip(45.0 / rough_s, 2, 13))
+    n1 = max(1, n2 // 3)
+    s = scan_qps_time(lambda qq, ix: ivf_pq.search(sp, ix, qq, k),
+                      queries, n1=n1, n2=n2, operands=index)
+    res["qps"] = round(nq / s, 1)
+    res["recall_at_10"] = round(float(recall), 4)
+    print(f"qps={res['qps']} recall={res['recall_at_10']}", flush=True)
+
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

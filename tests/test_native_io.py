@@ -66,3 +66,55 @@ def test_streaming_pq_build_from_file(tmp_path):
         ivf_pq.IndexParams(n_lists=16, pq_dim=8), full, batch_size=1024
     )
     assert index.size == 4000
+
+
+def test_refine_host_matches_device(tmp_path):
+    """Threaded host refine == device refine (the reference's OpenMP
+    refine_host parity, detail/refine_host-inl.hpp)."""
+    import numpy as np
+    from raft_tpu.neighbors import refine, refine_host
+
+    rng = np.random.default_rng(4)
+    n, d, m, c, k = 3000, 48, 128, 32, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    cand = rng.integers(0, n, (m, c)).astype(np.int32)
+    cand[:, 5] = -1                      # invalid slots
+    hd, hi = refine_host(x, q, cand, k)
+    dd, di = refine(x, q, cand, k)
+    np.testing.assert_array_equal(hi, np.asarray(di))
+    np.testing.assert_allclose(hd, np.asarray(dd), rtol=1e-4, atol=1e-4)
+    # memmap-backed dataset (the host variant's reason to exist)
+    path = tmp_path / "base.npy"
+    np.save(path, x)
+    mm = np.load(path, mmap_mode="r")
+    md, mi = refine_host(mm, q, cand, k)
+    np.testing.assert_array_equal(mi, hi)
+
+
+def test_search_file_streaming(tmp_path):
+    """File-backed query set larger than one batch streams through the
+    regular search and matches the in-memory result."""
+    import numpy as np
+    from raft_tpu.bench.datasets import write_bin
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.neighbors.stream import search_file, search_host_array
+
+    rng = np.random.default_rng(5)
+    n, d, m, k = 4000, 32, 700, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    path = str(tmp_path / "queries.fbin")
+    write_bin(path, q)
+    index = brute_force.build(x, "sqeuclidean")
+
+    class _Mod:
+        @staticmethod
+        def search(sp, index, batch, k):
+            return brute_force.search(index, batch, k)
+
+    sd, si = search_file(_Mod, None, index, path, k, batch_rows=256)
+    dd, di = brute_force.search(index, q, k)
+    np.testing.assert_array_equal(si, np.asarray(di))
+    hd2, hi2 = search_host_array(_Mod, None, index, q, k, batch_rows=256)
+    np.testing.assert_array_equal(hi2, np.asarray(di))
