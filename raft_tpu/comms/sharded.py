@@ -177,7 +177,12 @@ def sharded_ivf_pq_search(
         raise ValueError(f"n_lists {C} not divisible by mesh axis {nshards}")
     local_lists = C // nshards
     n_probes = max(1, min(int(search_params.n_probes) // nshards, local_lists))
-    cap = index.codes.shape[1]
+    if index.codes.ndim != 3:
+        raise ValueError(
+            "flat-codes (100M-scale streamed) indexes are single-device "
+            "only for now: sharding needs per-device [C, cap, nw] blocks"
+        )
+    cap = index.indices.shape[1]
     if k > n_probes * cap:
         raise ValueError(
             f"k={k} exceeds the per-shard candidate pool "

@@ -582,7 +582,10 @@ def _ivf_search(
             approx=local_recall_target < 1.0,
             recall_target=local_recall_target,
         )  # [bb, group, kl]
-        return None, (ld, lsel)
+        # flattened minor dims: the scan's stacked output otherwise pads
+        # kl to 128 lanes (12.8x HBM at k=10)
+        return None, (ld.reshape(ld.shape[0], -1),
+                      lsel.reshape(lsel.shape[0], -1))
 
     xs = (
         bucket_list.reshape(-1, bucket_batch),

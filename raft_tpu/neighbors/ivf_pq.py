@@ -633,9 +633,16 @@ def build_streamed(
             print("[build_streamed] first scatter ok", flush=True)
         off += bs
 
+    # the [C, cap, nw] native TPU layout is transposed relative to the
+    # flat bytes (small minor dims get split/packed), so materializing it
+    # costs a full-array relayout copy — fine at GB scale, impossible at
+    # 100M scale. Big code arrays stay FLAT [C*cap, nw]; every consumer
+    # (search, extend, serialize) handles both forms.
+    big_codes = keep_codes and C * cap * nw * 4 > (2 << 30)
     out = dataclasses.replace(
         index,
-        codes=_donated_reshape3(acc_codes, C, cap),
+        codes=(acc_codes if big_codes
+               else _donated_reshape3(acc_codes, C, cap)),
         indices=_donated_reshape2(acc_ids, C, cap),
         list_sizes=jnp.minimum(fill, cap),
         rec_norms=_donated_reshape2(acc_norms, C, cap),
@@ -740,6 +747,17 @@ def _scatter_encode_batch(
     acc_norms = acc_norms.at[slot].set(rnorm[order])
     acc_ids = acc_ids.at[slot].set(ids_global[order])
     fill = fill + counts_b
+    # pin the 2-D accumulators to row-major: XLA's scatter layout
+    # assignment otherwise drifts them to a transposed layout, which
+    # turns the final [C, cap, ...] view into an 8.5 GB relayout copy
+    # (row-major -> the view is a pure bitcast)
+    try:
+        from jax.experimental.layout import Layout, with_layout_constraint
+
+        acc_codes = with_layout_constraint(acc_codes, Layout((0, 1)))
+        acc_cache = with_layout_constraint(acc_cache, Layout((0, 1)))
+    except Exception:  # noqa: BLE001 - layout API absent on some backends
+        pass
     return acc_codes, acc_cache, acc_norms, acc_ids, fill
 
 
@@ -817,7 +835,7 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
     # host round-trip)
     C = index.n_lists
     nw = packed_words(index.pq_dim, index.pq_bits)
-    old_cap = index.codes.shape[1]
+    old_cap = index.indices.shape[1]
     if old_cap > 0 and index.size > 0:
         old_codes = index.codes.reshape(-1, nw)
         old_ids = index.indices.reshape(-1)
@@ -909,8 +927,9 @@ def _recon_cache_scan(codes_packed, pq_centers, codebook_kind: int,
 
 def _attach_cache(index: "Index") -> "Index":
     """(Re)build the decoded-residual cache when enabled and affordable."""
-    C, cap, _ = index.codes.shape
-    if (not index.cache_decoded or cap == 0
+    C = index.n_lists
+    cap = index.indices.shape[1]
+    if (not index.cache_decoded or cap == 0 or index.codes.ndim != 3
             or C * cap * index.rot_dim > _CACHE_BUDGET):
         return dataclasses.replace(index, recon_cache=None)
     cache, scale = _recon_cache_scan(
@@ -952,7 +971,9 @@ def _pq_search(
      list_sizes, rec_norms, filter_bits, recon_cache, recon_scale) = arrays
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
-    C, cap, _nw = codes.shape
+    C, cap = indices.shape   # codes may be FLAT [C*cap, nw] (streamed
+    # 100M-scale builds: the 3-D native layout would need a multi-GB
+    # relayout copy) or the regular [C, cap, nw]
     p = pq_dim
     rot_dim = rotation.shape[0]
     q32 = queries.astype(jnp.float32)
@@ -1047,14 +1068,20 @@ def _pq_search(
             # taken when lut_dtype allows it — explicit f32/bf16/f8 get
             # the true decode at that precision
             recon = recon_cache[bl].astype(jnp.float32) * recon_scale
-        elif codebook_kind == codebook_gen.PER_SUBSPACE:
-            blk_codes = unpack_codes(codes[bl], p, pq_bits)  # [bb, cap, p]
-            recon = _decode_gather(blk_codes, pq_centers, codebook_kind)
         else:
-            blk_codes = unpack_codes(codes[bl], p, pq_bits)
-            recon = _decode_gather(
-                blk_codes, pq_centers, codebook_kind, bl[:, None]
-            )                            # [bb, cap, rot_dim]
+            if codes.ndim == 2:
+                # flat streamed codes: gather each probed list's row range
+                rows = bl[:, None] * cap + jnp.arange(cap)[None, :]
+                blk_raw = codes[rows]                  # [bb, cap, nw]
+            else:
+                blk_raw = codes[bl]
+            blk_codes = unpack_codes(blk_raw, p, pq_bits)  # [bb, cap, p]
+            if codebook_kind == codebook_gen.PER_SUBSPACE:
+                recon = _decode_gather(blk_codes, pq_centers, codebook_kind)
+            else:
+                recon = _decode_gather(
+                    blk_codes, pq_centers, codebook_kind, bl[:, None]
+                )                        # [bb, cap, rot_dim]
         if decode_via_f8:
             # scaled round-trip through e4m3 (the reference's fp8 LUT
             # stores a shared exponent bias, ivf_pq_fp_8bit.cuh) —
@@ -1098,11 +1125,16 @@ def _pq_search(
         if internal_dtype == "bf16":
             # lower-precision internal distances (reference fp16 analog)
             dist = dist.astype(jnp.bfloat16).astype(jnp.float32)
-        return None, merge_topk(
+        ld, li = merge_topk(
             dist, jnp.broadcast_to(ids[:, None, :], dist.shape), kl, select_min,
             approx=local_recall_target < 1.0,
             recall_target=local_recall_target,
         )
+        # flatten [bb, group, kl] -> [bb, group*kl]: the scan's stacked
+        # output otherwise pads the kl minor dim to 128 lanes (12.8x HBM
+        # at k=10 — 5.2 GB at the DEEP-100M config)
+        bb = ld.shape[0]
+        return None, (ld.reshape(bb, -1), li.reshape(bb, -1))
 
     xs = (
         bucket_list.reshape(-1, bucket_batch),
@@ -1137,7 +1169,7 @@ def search(
     (the reference benchmarks do the same)."""
     queries = jnp.asarray(queries)
     n_probes = int(min(search_params.n_probes, index.n_lists))
-    cap = index.codes.shape[1]
+    cap = index.indices.shape[1]
     if cap == 0:
         raise ValueError("index is empty — build with add_data_on_build or extend")
     if k > n_probes * cap:
@@ -1173,7 +1205,7 @@ def search(
                 "cache_decoded=True and keep lut_dtype='auto'/'i8')"
                 % requested
             )
-        if index.codes.shape[2] == 0:
+        if index.codes.shape[-1] == 0:
             raise ValueError(
                 "this index was built with keep_codes=False (cache-only); "
                 "decode-path scoring needs the packed codes — search with "
@@ -1240,12 +1272,17 @@ def _norm_dtype_knob(v) -> str:
 
 
 def save(path: str, index: Index) -> None:
+    cap = index.indices.shape[1]
+    codes_h = np.asarray(index.codes)
+    if codes_h.ndim == 2:
+        # flat streamed layout: host reshape is free (row-major bytes)
+        codes_h = codes_h.reshape(index.n_lists, cap, -1)
     arrays = {
         "centers": np.asarray(index.centers),
         "centers_rot": np.asarray(index.centers_rot),
         "rotation": np.asarray(index.rotation),
         "pq_centers": np.asarray(index.pq_centers),
-        "codes": np.asarray(index.codes),
+        "codes": codes_h,
         "indices": np.asarray(index.indices),
         "list_sizes": np.asarray(index.list_sizes),
         "rec_norms": np.asarray(index.rec_norms),

@@ -25,6 +25,7 @@ from raft_tpu.distance.pairwise import _pairwise
 from raft_tpu.distance.types import DistanceType, resolve_metric
 from raft_tpu.sparse.types import CSR
 from raft_tpu.utils.math import cdiv
+from raft_tpu.utils.precision import dist_dot
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -46,27 +47,35 @@ def _densify_rows(indices, vals, row_lens, block_rows: int, n_cols: int):
     return dense[:, :n_cols]
 
 
-def densify_block(csr: CSR, r0: int, r1: int) -> jax.Array:
-    """Densify rows [r0, r1) of a CSR matrix. Host-orchestrated: the block's
-    nnz span comes from indptr on the host, the scatter runs jitted. The
-    entry slice is padded to the next power of two (padding scatters into
-    the dropped guard column) so block nnz variation doesn't recompile
-    ``_densify_rows`` per block."""
+def densify_block(csr: CSR, r0: int, r1: int, c0: int = 0,
+                  c1: Optional[int] = None) -> jax.Array:
+    """Densify rows [r0, r1) x columns [c0, c1) of a CSR matrix.
+    Host-orchestrated: the block's nnz span comes from indptr on the
+    host, the scatter runs jitted. The entry slice is padded to the next
+    power of two (padding scatters into the dropped guard column) so
+    block nnz variation doesn't recompile ``_densify_rows`` per block.
+    Entries outside the column range scatter into the guard column —
+    the column blocking that keeps vocab-sized dims off HBM."""
     indptr = np.asarray(csr.indptr)
     lo, hi = int(indptr[r0]), int(indptr[r1])
     block_rows = r1 - r0
     row_lens = csr.indptr[r0 + 1 : r1 + 1] - csr.indptr[r0:r1]
     L = hi - lo
     nnz, n_cols = csr.indices.shape[0], csr.shape[1]
+    if c1 is None:
+        c1 = n_cols
+    width = c1 - c0
     if nnz == 0 or L == 0:
-        return jnp.zeros((block_rows, n_cols), csr.vals.dtype)
+        return jnp.zeros((block_rows, width), csr.vals.dtype)
     Lpad = max(1 << (L - 1).bit_length(), 8)
     span = lo + np.arange(Lpad)
     take = jnp.asarray(np.minimum(span, max(nnz - 1, 0)), jnp.int32)
     valid = jnp.asarray(span < hi)
-    indices = jnp.where(valid, csr.indices[take], n_cols)
-    vals = jnp.where(valid, csr.vals[take], 0)
-    return _densify_rows(indices, vals, row_lens, block_rows, n_cols)
+    idx = csr.indices[take]
+    in_range = valid & (idx >= c0) & (idx < c1)
+    indices = jnp.where(in_range, idx - c0, width)
+    vals = jnp.where(in_range, csr.vals[take], 0)
+    return _densify_rows(indices, vals, row_lens, block_rows, width)
 
 
 def check_sparse_metric(metric) -> DistanceType:
@@ -86,6 +95,7 @@ def pairwise_distance(
     metric="euclidean",
     metric_arg: float = 2.0,
     block_rows: Optional[int] = None,
+    col_block: Optional[int] = None,
 ) -> jax.Array:
     """Full [m, n] distance matrix between sparse row sets.
 
@@ -99,6 +109,21 @@ def pairwise_distance(
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"feature dims differ: {x.shape} vs {y.shape}")
     m, n = x.shape[0], y.shape[0]
+    D = x.shape[1]
+    # vocab-sized feature dims: full-row densification collapses, switch
+    # to the column-blocked engine (combine rules per metric)
+    if col_block is None and D > 16384:
+        col_block = 8192
+    if col_block is not None and col_block < D:
+        if metric not in (_COLBLOCK_DOT | _COLBLOCK_ADD | _COLBLOCK_MAX):
+            raise ValueError(
+                f"{metric} has no column-chunk combine rule; supported "
+                "high-dim metrics: L2*/IP/Cosine/L1/Canberra/Linf"
+            )
+        br = block_rows or max(
+            64, min(max(m, n), (64 << 20) // max(4 * col_block, 1)))
+        return _pairwise_colblocked(x, y, metric, float(metric_arg),
+                                    br, int(col_block))
     if block_rows is None:
         # ~64 MiB of densified block per side
         block_rows = max(64, min(m, (64 << 20) // max(4 * x.shape[1], 1)))
@@ -118,4 +143,82 @@ def pairwise_distance(
                 _pairwise(xb, yb, int(metric), float(metric_arg), None, None)
             )
         out.append(row[0] if len(row) == 1 else jnp.concatenate(row, axis=1))
+    return jnp.concatenate(out, axis=0)
+
+
+# metrics the column-blocked (high-dim) engine supports, by combine rule
+_COLBLOCK_DOT = frozenset({
+    DistanceType.InnerProduct, DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded, DistanceType.L2Unexpanded,
+    DistanceType.CosineExpanded,
+})
+_COLBLOCK_ADD = frozenset({DistanceType.L1, DistanceType.Canberra})
+_COLBLOCK_MAX = frozenset({DistanceType.Linf})
+
+
+def _pairwise_colblocked(x: CSR, y: CSR, metric: DistanceType,
+                         metric_arg: float, block_rows: int,
+                         col_block: int) -> jax.Array:
+    """High-dimensional sparse pairwise distances: densify [rows, cols]
+    TILES (bounded by block_rows x col_block regardless of the feature
+    dim) and combine partial results across column chunks — the TPU
+    answer to the reference's COO-SpMV strategies for vocab-sized dims
+    (sparse/distance/detail/coo_spmv.cuh). Expanded metrics accumulate
+    MXU dot blocks + per-chunk norms; additive metrics (L1, Canberra)
+    sum chunk distances; Linf maxes them. Column chunks iterate OUTER of
+    y blocks so each x tile densifies once per (row-block, col-chunk)."""
+    m, n = x.shape[0], y.shape[0]
+    D = x.shape[1]
+    dot_like = metric in _COLBLOCK_DOT
+    combine_max = metric in _COLBLOCK_MAX
+    ip = metric == DistanceType.InnerProduct
+    out = []
+    ycuts = list(range(0, n, block_rows))
+    for r0 in range(0, m, block_rows):
+        r1 = min(r0 + block_rows, m)
+        accs = [None] * len(ycuts)
+        yn2s = [None] * len(ycuts)
+        xn2 = None
+        for d0 in range(0, D, col_block):
+            d1 = min(d0 + col_block, D)
+            xb = densify_block(x, r0, r1, d0, d1).astype(jnp.float32)
+            if dot_like and not ip:
+                px = jnp.sum(xb * xb, axis=1)
+                xn2 = px if xn2 is None else xn2 + px
+            for bi, c0 in enumerate(ycuts):
+                c1 = min(c0 + block_rows, n)
+                yb = densify_block(y, c0, c1, d0, d1).astype(jnp.float32)
+                if dot_like:
+                    part = dist_dot(xb, yb.T)
+                    accs[bi] = part if accs[bi] is None else accs[bi] + part
+                    if not ip:
+                        py = jnp.sum(yb * yb, axis=1)
+                        yn2s[bi] = (py if yn2s[bi] is None
+                                    else yn2s[bi] + py)
+                else:
+                    part = _pairwise(xb, yb, int(metric),
+                                     float(metric_arg), None, None)
+                    if accs[bi] is None:
+                        accs[bi] = part
+                    elif combine_max:
+                        accs[bi] = jnp.maximum(accs[bi], part)
+                    else:
+                        accs[bi] = accs[bi] + part
+        rows = []
+        for bi in range(len(ycuts)):
+            acc, yn2 = accs[bi], yn2s[bi]
+            if not dot_like or ip:
+                blk = acc
+            elif metric == DistanceType.CosineExpanded:
+                denom = jnp.sqrt(
+                    jnp.maximum(xn2[:, None] * yn2[None, :], 1e-30))
+                blk = 1.0 - acc / denom
+            else:
+                blk = jnp.maximum(
+                    xn2[:, None] + yn2[None, :] - 2.0 * acc, 0.0)
+                if metric == DistanceType.L2SqrtExpanded:
+                    blk = jnp.sqrt(blk)
+            rows.append(blk)
+        out.append(rows[0] if len(rows) == 1
+                   else jnp.concatenate(rows, axis=1))
     return jnp.concatenate(out, axis=0)

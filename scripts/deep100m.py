@@ -38,7 +38,7 @@ def main():
     n_probes = 128
 
     from raft_tpu.bench.run import _gen_device_block
-    from raft_tpu.bench.harness import scan_qps_time, compute_recall
+    from raft_tpu.bench.harness import compute_recall
     from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.common import merge_topk
 
@@ -84,7 +84,7 @@ def main():
     build_s = time.time() - t0
     sizes = np.asarray(index.list_sizes)
     res["build_s"] = round(build_s, 1)
-    res["cap"] = int(index.codes.shape[1])
+    res["cap"] = int(index.indices.shape[1])
     res["list_size_mean"] = float(sizes.mean())
     res["list_size_max"] = int(sizes.max())
     res["stored_rows"] = int(sizes.sum())
@@ -121,20 +121,28 @@ def main():
     print(f"groundtruth: {res['groundtruth_s']} s", flush=True)
 
     # ---- search --------------------------------------------------------
-    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bf16")
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bf16",
+                             local_recall_target=1.0)
     dist, idx = ivf_pq.search(sp, index, queries, k)
     np.asarray(idx[0, 0])
-    t0 = time.time()
-    _, idx2 = ivf_pq.search(sp, index, jnp.roll(queries, 1, axis=0), k)
-    np.asarray(idx2[0, 0])
-    rough_s = max(time.time() - t0, 0.1)
     recall = compute_recall(np.asarray(idx[:sub]), cur_i)
-    n2 = int(np.clip(45.0 / rough_s, 2, 13))
-    n1 = max(1, n2 // 3)
-    s = scan_qps_time(lambda qq, ix: ivf_pq.search(sp, ix, qq, k),
-                      queries, n1=n1, n2=n2, operands=index)
-    res["qps"] = round(nq / s, 1)
     res["recall_at_10"] = round(float(recall), 4)
+    print(f"recall={recall:.4f}", flush=True)
+    # single-shot timing: one 10k-query search runs tens of seconds at
+    # this scale, so the scan-chained two-point method cannot fit under
+    # the platform's ~2 min program watchdog; per-call timing with a
+    # forced result fetch is the honest fallback (distinct query rolls
+    # defeat the platform result cache). Dispatch+RTT rides along, which
+    # UNDER-reports QPS slightly at this timescale.
+    times = []
+    for r in (1, 2):
+        t0 = time.time()
+        _, ii = ivf_pq.search(sp, index, jnp.roll(queries, r, axis=0), k)
+        np.asarray(ii[0, 0])
+        times.append(time.time() - t0)
+    s = float(np.mean(times))
+    res["qps"] = round(nq / s, 1)
+    res["timing"] = "single-shot mean of 2 (watchdog-bounded)"
     print(f"qps={res['qps']} recall={res['recall_at_10']}", flush=True)
 
     with open(out_path, "w") as f:
