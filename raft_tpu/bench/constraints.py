@@ -1,0 +1,74 @@
+"""Benchmark parameter-validity constraints.
+
+Analog of the reference's `raft-ann-bench` `constraints/` module
+(python/raft-ann-bench/src/raft-ann-bench/constraints/__init__.py): the
+orchestrator calls these before launching a (build_param, search_param)
+case and SKIPS invalid combinations instead of crashing mid-sweep —
+essential when sweeping Cartesian parameter grids.
+"""
+
+from __future__ import annotations
+
+
+def ivf_pq_build(build_param: dict, dim: int) -> bool:
+    """Mirror of the reference's raft_ivf_pq_build_constraints: pq_dim
+    must divide into the (rounded) rotated dim and stay <= dim."""
+    pq_dim = int(build_param.get("pq_dim", 0))
+    if pq_dim == 0:
+        return True
+    return 0 < pq_dim <= dim
+
+
+def ivf_pq_search(search_param: dict, build_param: dict, k: int) -> bool:
+    """raft_ivf_pq_search_constraints analog: probes within the list
+    count, and forced fused scans need k within the kernel's 256-per-list
+    extraction budget."""
+    n_probes = int(search_param.get("n_probes", 20))
+    n_lists = int(build_param.get("n_lists", 1024))
+    if not 0 < n_probes <= n_lists:
+        return False
+    if str(search_param.get("scan_impl", "auto")).startswith("pallas"):
+        return k <= 256
+    return True
+
+
+def ivf_flat_search(search_param: dict, build_param: dict, k: int) -> bool:
+    n_probes = int(search_param.get("n_probes", 20))
+    n_lists = int(build_param.get("n_lists", 1024))
+    return 0 < n_probes <= n_lists
+
+
+def cagra_build(build_param: dict, dim: int) -> bool:
+    """raft_cagra_build_constraints analog: graph_degree <= intermediate."""
+    g = int(build_param.get("graph_degree", 32))
+    ig = int(build_param.get("intermediate_graph_degree", 64))
+    return 0 < g <= ig
+
+
+def cagra_search(search_param: dict, build_param: dict, k: int) -> bool:
+    """hnswlib/CAGRA-style: itopk >= k; the fused beam kernel bounds
+    search_width x graph_degree by VMEM (~128 candidates/iteration)."""
+    itopk = int(search_param.get("itopk_size", 64))
+    width = int(search_param.get("search_width", 4))
+    deg = int(build_param.get("graph_degree", 32))
+    return itopk >= k and width * deg <= 256
+
+
+_BUILD = {"ivf_pq": ivf_pq_build, "cagra": cagra_build}
+_SEARCH = {
+    "ivf_pq": ivf_pq_search,
+    "ivf_flat": ivf_flat_search,
+    "cagra": cagra_search,
+}
+
+
+def check_case(algo: str, build_param: dict, search_param: dict,
+               dim: int, k: int) -> bool:
+    """True when the (build, search) combination is worth launching."""
+    b = _BUILD.get(algo)
+    if b is not None and not b(build_param, dim):
+        return False
+    s = _SEARCH.get(algo)
+    if s is not None and not s(search_param, build_param, k):
+        return False
+    return True

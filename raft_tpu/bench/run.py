@@ -221,6 +221,9 @@ def get_groundtruth(cfg: dict, base, queries, k: int) -> np.ndarray:
 # --- algo adapters ---------------------------------------------------------
 
 
+_HOST_ALGOS = frozenset({"hnswlib_cpu"})
+
+
 def _make_case(algo: str, metric: str, build_param: dict, search_param: dict,
                base, k: int):
     """Returns (build_fn, search_q) closures for one (build, search) pair;
@@ -269,6 +272,48 @@ def _make_case(algo: str, metric: str, build_param: dict, search_param: dict,
             lambda: ball_cover.build(base, metric=metric, **build_param),
             lambda ix, q: ball_cover.knn_query(ix, q, k, **search_param),
         )
+    if algo == "hnswlib_cpu":
+        # competitor wrapper (the reference benches hnswlib via
+        # cpp/bench/ann/src/hnswlib/): the real library is not
+        # installable here, so the CAGRA graph is exported to the
+        # hnswlib format and searched with hnswlib's base-layer
+        # algorithm on the host (neighbors/hnswlib_io.py) — a CPU
+        # single-thread baseline, honest about what it is
+        import tempfile
+
+        import numpy as _np
+
+        from raft_tpu.neighbors import cagra
+        from raft_tpu.neighbors.hnswlib_io import (
+            greedy_search, load_hnswlib_index,
+        )
+
+        ef = int(search_param.get("ef", 96))
+
+        def _build():
+            import os as _os
+
+            params = cagra.IndexParams(metric=metric, **build_param)
+            idx = cagra.build(params, base)
+            fd, path = tempfile.mkstemp(suffix=".hnsw")
+            _os.close(fd)
+            try:
+                cagra.serialize_to_hnswlib(path, idx)
+                return load_hnswlib_index(path, dim=base.shape[1])
+            finally:
+                _os.unlink(path)
+
+        def _search(ix, q):
+            qh = _np.asarray(q)
+            ds = _np.full((qh.shape[0], k), _np.inf, _np.float32)
+            ids = _np.full((qh.shape[0], k), -1, _np.int64)
+            for i in range(qh.shape[0]):
+                di, ii = greedy_search(ix, qh[i], k, ef=max(ef, k))
+                ds[i, : len(ii)] = di[: k]
+                ids[i, : len(ii)] = ii[: k]
+            return jnp.asarray(ds), jnp.asarray(ids)
+
+        return _build, _search
     raise ValueError(f"unknown algo {algo!r}")
 
 
@@ -284,7 +329,15 @@ def run_config(cfg: dict, iters: int = 10) -> List[BenchResult]:
         bp = index_def.get("build_param", {})
         index = None
         build_s = 0.0
+        from raft_tpu.bench.constraints import check_case
+
+        if not check_case(algo, bp, {}, int(base.shape[1]), k):
+            print(f"[bench] skip invalid build {algo} {bp}")
+            continue
         for si, sp in enumerate(index_def.get("search_params", [{}])):
+            if not check_case(algo, bp, sp, int(base.shape[1]), k):
+                print(f"[bench] skip invalid case {algo} {bp} {sp}")
+                continue
             build_fn, search_q = _make_case(algo, metric, bp, sp, base, k)
             if index is None:
                 # build once per index definition, like the reference's
@@ -306,22 +359,30 @@ def run_config(cfg: dict, iters: int = 10) -> List[BenchResult]:
             q_dev = jnp.asarray(queries)
             dist, idx = search_q(index, q_dev)
             recall = compute_recall(np.asarray(idx), gt)
-            try:
-                search_s = scan_qps_time(
-                    lambda qq, ix: search_q(ix, qq),
-                    q_dev, n1=max(2, iters // 4), n2=max(4, iters),
-                    operands=index,
-                )
-            except (jax.errors.TracerBoolConversionError,
-                    jax.errors.ConcretizationTypeError):
-                # algos with host-side adaptive loops (ball_cover's
-                # certification rounds) can't run inside the scan; fall
-                # back to the pipelined host timer
+            if algo in _HOST_ALGOS:
+                # pure-host competitors can't jit at all; plain host timer
                 from raft_tpu.bench.harness import time_fn
 
                 search_s = time_fn(
-                    lambda: search_q(index, q_dev)[1], iters=iters
+                    lambda: search_q(index, q_dev)[1], iters=max(1, iters // 4)
                 )
+            else:
+                try:
+                    search_s = scan_qps_time(
+                        lambda qq, ix: search_q(ix, qq),
+                        q_dev, n1=max(2, iters // 4), n2=max(4, iters),
+                        operands=index,
+                    )
+                except (jax.errors.TracerBoolConversionError,
+                        jax.errors.ConcretizationTypeError):
+                    # algos with host-side adaptive loops (ball_cover's
+                    # certification rounds) can't run inside the scan;
+                    # fall back to the pipelined host timer
+                    from raft_tpu.bench.harness import time_fn
+
+                    search_s = time_fn(
+                        lambda: search_q(index, q_dev)[1], iters=iters
+                    )
             r = BenchResult(
                 name=f"{index_def['name']}#{si}",
                 build_s=build_s,

@@ -310,11 +310,12 @@ def build_knn_graph(
             else DistanceType.L2Expanded
         ),
         kmeans_n_iters=10,
-        # full-dataset coarse training measured FASTER end-to-end than a
-        # 256-rows/list subsample at n=1M (359 s vs 499 s): better
-        # centers -> tighter list balance -> smaller cap -> faster
-        # self-search batches, outweighing the kmeans savings
-        kmeans_trainset_fraction=min(1.0, max(0.1, 10000.0 * n_lists / n)),
+        # r2 measured full-dataset coarse training faster END-TO-END when
+        # the self-search was the slow XLA path (balance dominated). With
+        # the fused k<=64 self-search, a half-dataset trainset gives the
+        # SAME list cap (2944 at 1M) for 20 s less kmeans (49 s vs 70 s,
+        # steady batch 0.55 s vs 0.50 s)
+        kmeans_trainset_fraction=min(0.5, max(0.1, 10000.0 * n_lists / n)),
     )
     index = ivf_pq.build(params, dataset)
     sp = ivf_pq.SearchParams(

@@ -59,3 +59,19 @@ def test_chunked_groundtruth():
     want = np.argsort(d, 1)[:, :5]
     overlap = np.mean([len(set(gt[i]) & set(want[i])) / 5 for i in range(40)])
     assert overlap > 0.99
+
+
+def test_constraints_skip_invalid_cases():
+    from raft_tpu.bench.constraints import check_case
+
+    assert check_case("cagra", {"graph_degree": 32}, {"itopk_size": 64},
+                      128, 10)
+    assert not check_case("cagra", {"graph_degree": 64,
+                                    "intermediate_graph_degree": 32}, {},
+                          128, 10)
+    assert not check_case("cagra", {"graph_degree": 64},
+                          {"search_width": 8}, 128, 10)
+    assert not check_case("ivf_pq", {"n_lists": 64}, {"n_probes": 128}, 96,
+                          10)
+    assert check_case("ivf_flat", {"n_lists": 64}, {"n_probes": 64}, 96, 10)
+    assert not check_case("ivf_pq", {"pq_dim": 200}, {}, 96, 10)
