@@ -205,12 +205,17 @@ def sharded_ivf_pq_search(
         lut = "f32"
     internal = ivf_pq._norm_dtype_knob(search_params.internal_distance_dtype)
 
+    cache_i4 = has_cache and index.recon_cache.dtype == jnp.uint32
+
     def local(q, centers, centers_rot, rotation, pq_centers, codes,
               indices, list_sizes, rec_norms, *rest):
-        cache = rest[0] if has_cache else None
+        rest = list(rest)
+        cache = rest.pop(0) if has_cache else None
+        scales = rest.pop(0) if cache_i4 else None
+        qnorms = rest.pop(0) if cache_i4 else None
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
                   indices, list_sizes, rec_norms, None, cache,
-                  jnp.float32(index.recon_scale))
+                  jnp.float32(index.recon_scale), scales, qnorms)
         d, i = ivf_pq._pq_search(
             arrays, int(k), n_probes, metric, group, bucket_batch,
             int(index.codebook_kind), 0,
@@ -240,6 +245,13 @@ def sharded_ivf_pq_search(
     if has_cache:
         args.append(index.recon_cache)
         in_specs.append(P(axis_name, None, None))
+    if cache_i4:
+        args.append(index.cache_scales)        # [C, rot] per-list scales
+        in_specs.append(P(axis_name, None))
+        qn = (index.cache_qnorms if index.cache_qnorms is not None
+              else index.rec_norms)
+        args.append(qn)
+        in_specs.append(P(axis_name, None))
 
     fn = shard_map(
         local,

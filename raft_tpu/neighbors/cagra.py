@@ -66,6 +66,7 @@ import numpy as np
 
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.matrix.bitonic import sort_by_key
+from raft_tpu.neighbors.common import merge_topk
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.utils.precision import dist_dot
 
@@ -627,6 +628,50 @@ def _exact_dedup_prefix(fd, fi, k: int):
     return pd[:, :k], pi[:, :k]
 
 
+def _side_accumulate(res_d, res_i, dvals, ids, kr: int, window: int = 8):
+    """Merge scored candidates into the filtered-search side result
+    buffer and collapse duplicate ids (a node is scored once per parent
+    that lists it; copies carry bit-identical distances, so they sort
+    adjacent — without this collapse the top-kr fills with copies of a
+    handful of near nodes and recall craters)."""
+    rd, ri = merge_topk(
+        jnp.concatenate([res_d, dvals], axis=1),
+        jnp.concatenate([res_i, ids], axis=1),
+        kr, True,
+    )
+    dup = jnp.zeros(ri.shape, bool)
+    for s in range(1, window + 1):
+        eq = (ri[:, s:] == ri[:, :-s]) & (ri[:, s:] >= 0)
+        dup = dup | jnp.pad(eq, ((0, 0), (s, 0)))
+    rd = jnp.where(dup, jnp.inf, rd)
+    ri = jnp.where(dup, -1, ri)
+    return rd, ri
+
+
+def _filter_penalty_vector(filter_bits, filter_nbits: int, n: int, scale):
+    """Dense per-node penalty [n] f32: 0 where the bit is set, ``scale``
+    where filtered. Built by expanding the bitset words elementwise (no
+    gather — a per-id bit gather here would cost a row-count-bound HBM
+    pass per call).
+
+    Both callers pass ``scale=jnp.inf``: the penalty only marks
+    filtered candidates for exclusion from the SIDE result buffer
+    (``_side_accumulate``), never the traversal buffer, so +inf is
+    exactly right. A finite scale would only matter for an in-buffer
+    penalty design — prototyped and rejected: valid results evict the
+    penalized frontier from the shared ranked buffer and recall
+    plateaus at 0.64-0.76 under dense filters."""
+    w = filter_bits.shape[0]
+    bits = (filter_bits[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    flat = bits.reshape(w * 32)
+    if w * 32 < n:
+        flat = jnp.pad(flat, (0, n - w * 32))
+    keep = flat[:n] != 0
+    if filter_nbits < n:
+        keep = keep & (jnp.arange(n) < filter_nbits)
+    return jnp.where(keep, 0.0, jnp.asarray(scale, jnp.float32))
+
+
 def _finalize(out_d, out_i, q32, metric):
     """Restore the dropped ||q||^2 term / signs and mask invalid slots."""
     ip = metric == DistanceType.InnerProduct
@@ -674,6 +719,25 @@ def _beam_search(
     data = dataset.astype(mm)
     qmm = q32.astype(mm)
 
+    side = filter_nbits > 0
+    if side:
+        # filtered search, side-accumulation design: traversal runs
+        # fully UNFILTERED (the best exploration policy — a single
+        # ranked buffer cannot hold both the filtered result set and
+        # the traversal frontier without one evicting the other;
+        # measured recall plateaus of 0.64-0.76 at 90% filter density
+        # for in-buffer penalty/expulsion schemes), and every scored
+        # candidate that passes the filter is merged into a separate
+        # top-kr result buffer. This realizes the reference's intent
+        # (filtered nodes expand, never occupy result slots;
+        # search_single_cta_kernel-inl.cuh:725-772) without its
+        # slot-contention: measured 0.997/0.996 vs the reference
+        # semantics' 0.94/0.76 at 50%/90% density.
+        pen = _filter_penalty_vector(filter_bits, filter_nbits, n, jnp.inf)
+        kr = max(4 * k, 64)
+        res_d = jnp.full((m, kr), jnp.inf, jnp.float32)
+        res_i = jnp.full((m, kr), -1, jnp.int32)
+
     def score(ids):                            # [m, c] -> [m, c] (min-close)
         vecs = data[ids]                       # [m, c, d] (mm dtype)
         dots = (vecs * qmm[:, None, :]).sum(-1, dtype=jnp.float32)
@@ -681,13 +745,23 @@ def _beam_search(
             return -dots
         return data_norms[ids] - 2.0 * dots    # ||q||^2 constant: dropped
 
+    def side_merge(res_d, res_i, ids, dvals):
+        vd = dvals + pen[ids]                  # filtered -> +inf
+        return _side_accumulate(res_d, res_i, vd, ids, kr)
+
     if n_seeds <= 0:
         n_seeds = max(2 * itopk, 128)
     seeds = _seed_ids(m, n, n_seeds)
-    buf_d, buf_i, buf_e = _sorted_buffer(score(seeds), seeds, itopk)
+    seed_d = score(seeds)
+    buf_d, buf_i, buf_e = _sorted_buffer(seed_d, seeds, itopk)
+    if side:
+        res_d, res_i = side_merge(res_d, res_i, seeds, seed_d)
 
     def body(_, state):
-        buf_d, buf_i, buf_e = state
+        if side:
+            buf_d, buf_i, buf_e, res_d, res_i = state
+        else:
+            buf_d, buf_i, buf_e = state
         parents, buf_e = _pick_parents(buf_d, buf_i, buf_e, width)
         nbrs = graph[jnp.maximum(parents, 0)].reshape(m, width * deg)
         nbr_d = score(nbrs)
@@ -695,7 +769,25 @@ def _beam_search(
             (parents >= 0)[:, :, None], (m, width, deg)
         ).reshape(m, width * deg)
         nbr_d = jnp.where(parent_ok, nbr_d, jnp.inf)
-        return _merge_step(buf_d, buf_i, buf_e, nbr_d, nbrs, itopk)
+        out = _merge_step(buf_d, buf_i, buf_e, nbr_d, nbrs, itopk)
+        if side:
+            res_d, res_i = side_merge(res_d, res_i, nbrs, nbr_d)
+            return (*out, res_d, res_i)
+        return out
+
+    if side:
+        buf_d, buf_i, buf_e, res_d, res_i = jax.lax.fori_loop(
+            0, iters, body, (buf_d, buf_i, buf_e, res_d, res_i)
+        )
+        # the filtered result set lives in the side buffer — already
+        # sorted by merge_topk; dedup (a node is scored once per parent
+        # that lists it) and extract
+        LR = _next_pow2(kr)
+        fd = _pad_cols(jnp.where(res_i < 0, jnp.inf, res_d), LR, jnp.inf)
+        fi = _pad_cols(res_i, LR, -1)
+        fd, (fi,) = sort_by_key(fd, fi)
+        fd, fi = _exact_dedup_prefix(fd, fi, k)
+        return _finalize(fd, fi, q32, metric)
 
     buf_d, buf_i, buf_e = jax.lax.fori_loop(
         0, iters, body, (buf_d, buf_i, buf_e)
@@ -706,13 +798,6 @@ def _beam_search(
     # a duplicate run past the loop's window-2 reach
     L = _next_pow2(itopk)
     fd = jnp.where(buf_i < 0, jnp.inf, buf_d)
-    if filter_nbits:
-        # prefilter applies at result extraction only — traversal stays
-        # unfiltered like the reference (cagra.cuh:373 filtered search)
-        from raft_tpu.neighbors.common import filter_keep
-
-        fd = jnp.where(filter_keep(filter_bits, filter_nbits, buf_i),
-                       fd, jnp.inf)
     fd = _pad_cols(fd, L, jnp.inf)
     fi = _pad_cols(buf_i, L, -1)
     fd, (fi,) = sort_by_key(fd, fi)
@@ -760,6 +845,15 @@ def _beam_search_pallas(
     n, d = dataset.shape
     deg = graph.shape[1]
     m0 = queries.shape[0]
+    side = filter_nbits > 0
+    if side:
+        # filtered search, side-accumulation design (see _beam_search):
+        # traversal stays fully unfiltered; each iteration's scored
+        # candidates come back from the kernel (emit_cands) and the
+        # filter-passing ones merge into a separate top-kr result
+        # buffer. Costs one [width*deg, m] penalty gather + merge per
+        # iteration — filtered mode only.
+        pen = _filter_penalty_vector(filter_bits, filter_nbits, n, jnp.inf)
     G = _QUERY_TILE
     m = -(-m0 // G) * G
     q32 = jnp.pad(queries.astype(jnp.float32), ((0, m - m0), (0, 0)))
@@ -789,6 +883,14 @@ def _beam_search_pallas(
     else:
         seed_d = data_norms[seed_ids][None, :] - sdots
     seed_i = jnp.broadcast_to(seed_ids[:, None], (n_seeds, m))
+    if side:
+        kr = max(4 * k, 64)
+        res_d = jnp.full((m, kr), jnp.inf, jnp.float32)
+        res_i = jnp.full((m, kr), -1, jnp.int32)
+        sids = jnp.broadcast_to(seed_ids[None, :], (m, n_seeds))
+        res_d, res_i = _side_accumulate(
+            res_d, res_i, seed_d + pen[seed_ids][None, :], sids, kr
+        )
 
     buf_d = jnp.full((itopk, m), jnp.inf, jnp.float32)
     buf_i = jnp.full((itopk, m), -1, jnp.int32)
@@ -799,29 +901,49 @@ def _beam_search_pallas(
     )
 
     def body(_, state):
-        bd, bi, be, par = state
+        if side:
+            bd, bi, be, par, rd_, ri_ = state
+        else:
+            bd, bi, be, par = state
         gp = jnp.maximum(par.T, 0)                       # [m, width]
         blk = nbr_pack[gp]                               # [m, width, W]
-        return beam_merge_step(
+        out = beam_merge_step(
             bd, bi, be, qrep=qrep, pack=blk, parents=par,
             deg=deg, d=d, width=width, ip=ip, g=G, interpret=interpret,
+            emit_cands=side,
         )
+        if side:
+            bd, bi, be, par, cd, ci = out
+            cid = ci.T                                   # [m, C]
+            vd = cd.T + pen[jnp.maximum(cid, 0)]         # filtered -> inf
+            vd = jnp.where(cid < 0, jnp.inf, vd)
+            rd_, ri_ = _side_accumulate(rd_, ri_, vd, cid, kr)
+            return bd, bi, be, par, rd_, ri_
+        return out
 
-    buf_d, buf_i, buf_e, parents = jax.lax.fori_loop(
-        0, iters, body, (buf_d, buf_i, buf_e, parents)
-    )
+    if side:
+        buf_d, buf_i, buf_e, parents, res_d, res_i = jax.lax.fori_loop(
+            0, iters, body, (buf_d, buf_i, buf_e, parents, res_d, res_i)
+        )
+    else:
+        buf_d, buf_i, buf_e, parents = jax.lax.fori_loop(
+            0, iters, body, (buf_d, buf_i, buf_e, parents)
+        )
 
     # ---- exact f32 rescore of the buffer prefix ----------------------
     # R rows/query of HBM gather (row-count bound): 2k-rounded is enough
     # because the int8 traversal ranking is already ~exact at the top
     # (measured: R=32 vs 64 at k=10 changes recall < 0.002, saves ~2 ms
     # of the fixed cost at m=10k)
-    R = min(itopk, max(32, _next_pow2(2 * k)))
-    if filter_nbits:
-        # with a prefilter, rescore the whole buffer so enough unfiltered
-        # candidates survive result extraction
-        R = itopk
-    ri = buf_i.T[:m0, :R]
+    if side:
+        # the filtered result set lives in the side buffer: rescore all
+        # of it exactly (penalized/unfilled tail entries are inf-masked
+        # to -1 first, so only filter-passing ids are rescored)
+        R = kr
+        ri = jnp.where(jnp.isinf(res_d), -1, res_i)[:m0]
+    else:
+        R = min(itopk, max(32, _next_pow2(2 * k)))
+        ri = buf_i.T[:m0, :R]
     q0 = q32[:m0]
     rvec = dataset[jnp.maximum(ri, 0)].astype(jnp.float32)  # [m0, R, d]
     rdots = (rvec * q0[:, None, :]).sum(-1, dtype=jnp.float32)
@@ -830,13 +952,6 @@ def _beam_search_pallas(
     else:
         rd = (rvec * rvec).sum(-1) - 2.0 * rdots
     rd = jnp.where(ri < 0, jnp.inf, rd)
-    if filter_nbits:
-        # prefilter applies at result extraction only — traversal stays
-        # unfiltered like the reference (cagra.cuh:373 filtered search)
-        from raft_tpu.neighbors.common import filter_keep
-
-        rd = jnp.where(filter_keep(filter_bits, filter_nbits, ri),
-                       rd, jnp.inf)
     LR = _next_pow2(R)
     rd = _pad_cols(rd, LR, jnp.inf)
     ri = _pad_cols(ri, LR, -1)
@@ -889,10 +1004,19 @@ def search(
     scattered-gather path.
 
     ``prefilter`` (a core.Bitset or BitsetFilter) restricts RESULTS to
-    set bits; graph traversal stays unfiltered, mirroring the
-    reference's cagra filtered search (cagra.cuh:373-404,
-    sample_filter_types.hpp). With aggressive filters raise
-    ``itopk_size`` so enough unfiltered candidates survive."""
+    set bits via SIDE-ACCUMULATION: graph traversal runs fully
+    unfiltered (filtered nodes are expanded like any other, so the beam
+    reaches allowed regions through filtered ones), while every scored
+    candidate passing the filter is merged into a separate deduplicated
+    top-4k result buffer that filtered nodes can never enter. This is a
+    deliberate departure from the reference's expel-and-retry in-kernel
+    filtering (search_single_cta_kernel-inl.cuh:725-772), whose shared
+    itopk buffer lets filtered nodes crowd out results — measured here:
+    side-accumulation 0.997/0.996 recall vs reference semantics
+    0.94/0.76 at 50%/90% filter density (SIFT-like 10k set). For
+    extremely dense filters (>99%) raise ``itopk_size`` /
+    ``max_iterations`` so unfiltered traversal explores far enough to
+    touch the sparse allowed set."""
     from raft_tpu.neighbors.common import as_filter
 
     queries = jnp.asarray(queries)

@@ -170,7 +170,7 @@ def _pick_rows(kd, kie, width: int):
 def _beam_step_kernel(
     *refs,
     L: int, deg: int, d: int, width: int, window: int, ip: bool,
-    scored: bool,
+    scored: bool, emit_cands: bool = False,
 ):
     refs = list(refs)
     bd_ref = refs.pop(0)        # [L, G] f32
@@ -188,8 +188,13 @@ def _beam_step_kernel(
         qrep_ref = refs.pop(0)   # [G, 4, dw] bf16 (pre-scaled + tiled)
         pack_ref = refs.pop(0)   # [G, width*W] i32 packed rows (flat)
         par_ref_in = refs.pop(0)  # [width, G] i32 previous parents
-        obd_ref, obi_ref, obe_ref, par_ref = refs[:4]
-        cd_ref, ci_ref = refs[4:]                  # [C, G] VMEM scratch
+        if emit_cands:
+            (obd_ref, obi_ref, obe_ref, par_ref,
+             ocd_ref, oci_ref) = refs[:6]
+            cd_ref, ci_ref = refs[6:]              # [C, G] VMEM scratch
+        else:
+            obd_ref, obi_ref, obe_ref, par_ref = refs[:4]
+            cd_ref, ci_ref = refs[4:]              # [C, G] VMEM scratch
         C = width * deg
         W = pack_ref.shape[1] // width
         dw, o_norm, o_id, _W = packed_row_layout(deg, d, ip)
@@ -251,6 +256,11 @@ def _beam_step_kernel(
             jax.lax.fori_loop(0, width, score_one, 0)
         cd = cd_ref[...]
         ci = ci_ref[...]
+        if emit_cands:
+            # expose this iteration's scored candidates (filtered-search
+            # side accumulation collects them outside the kernel)
+            ocd_ref[...] = cd
+            oci_ref[...] = ci
 
     LL = _next_pow2(L + C)
     pad = LL - L - C
@@ -281,7 +291,8 @@ def _beam_step_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("deg", "d", "width", "window", "ip", "g", "interpret"),
+    static_argnames=("deg", "d", "width", "window", "ip", "g", "interpret",
+                     "emit_cands"),
 )
 def beam_merge_step(
     buf_d,          # [L, m] f32  (sorted, transposed)
@@ -300,6 +311,7 @@ def beam_merge_step(
     ip: bool = False,
     g: int = 128,
     interpret: bool = False,
+    emit_cands: bool = False,
 ):
     """One fused beam-search step over transposed state.
 
@@ -312,6 +324,11 @@ def beam_merge_step(
     Returns (buf_d, buf_i, buf_e, parents [width, m]); the output
     buffer is distance-sorted, deduplicated, truncated to L slots, with
     the picked parents marked explored. m must be a multiple of ``g``.
+
+    ``emit_cands`` (packed-scoring mode only) additionally returns the
+    iteration's raw scored candidates (cand_d [C, m] f32, cand_i
+    [C, m] i32) so filtered search can side-accumulate valid results
+    outside the kernel while traversal itself stays unfiltered.
     """
     L, m = buf_d.shape
     scored = cand_d is not None
@@ -342,10 +359,11 @@ def beam_merge_step(
         ]
         dd = d
 
+    emit = emit_cands and not scored
     kernel = functools.partial(
         _beam_step_kernel,
         L=L, deg=deg, d=dd, width=width, window=window, ip=ip,
-        scored=scored,
+        scored=scored, emit_cands=emit,
     )
     scratch = []
     if not scored:
@@ -354,22 +372,31 @@ def beam_merge_step(
             pltpu.VMEM((C, g), jnp.float32),
             pltpu.VMEM((C, g), jnp.int32),
         ]
+    out_specs = [
+        pl.BlockSpec((L, g), col),
+        pl.BlockSpec((L, g), col),
+        pl.BlockSpec((L, g), col),
+        pl.BlockSpec((width, g), col),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((L, m), jnp.float32),
+        jax.ShapeDtypeStruct((L, m), jnp.int32),
+        jax.ShapeDtypeStruct((L, m), jnp.int32),
+        jax.ShapeDtypeStruct((width, m), jnp.int32),
+    ]
+    if emit:
+        C = width * deg
+        out_specs += [pl.BlockSpec((C, g), col), pl.BlockSpec((C, g), col)]
+        out_shape += [
+            jax.ShapeDtypeStruct((C, m), jnp.float32),
+            jax.ShapeDtypeStruct((C, m), jnp.int32),
+        ]
     return pl.pallas_call(
         kernel,
         grid=(nsteps,),
         in_specs=in_specs,
         scratch_shapes=scratch,
-        out_specs=[
-            pl.BlockSpec((L, g), col),
-            pl.BlockSpec((L, g), col),
-            pl.BlockSpec((L, g), col),
-            pl.BlockSpec((width, g), col),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((L, m), jnp.float32),
-            jax.ShapeDtypeStruct((L, m), jnp.int32),
-            jax.ShapeDtypeStruct((L, m), jnp.int32),
-            jax.ShapeDtypeStruct((width, m), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(*inputs)

@@ -143,6 +143,7 @@ def _extract_topk_binned_deep(dist, ids_row, k: int, cap: int,
 def _scan_kernel(
     bl_ref, ls_ref, *refs,
     k: int, metric_kind: int, approx: bool, has_norms: bool, has_filter: bool,
+    packed_i4: bool = False,
 ):
     refs = list(refs)
     storage_ref = refs.pop(0)
@@ -151,17 +152,42 @@ def _scan_kernel(
     keep_ref = refs.pop(0) if has_filter else None
     qv_ref = refs.pop(0)
     qaux_ref = refs.pop(0) if metric_kind != IP else None
-    outd_ref, outi_ref = refs
+    if packed_i4:
+        outd_ref, outi_ref, recon_ref = refs
+    else:
+        outd_ref, outi_ref = refs
 
     i = pl.program_id(0)
     size = ls_ref[bl_ref[i]]
     qv = qv_ref[0]                                      # [G, d] mm dtype
-    blk = storage_ref[0].astype(qv.dtype)               # [cap, d]
-    dots = jax.lax.dot_general(
-        qv, blk,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                   # [G, cap]
+    if packed_i4:
+        # packed int4 block [nw, cap] uint32 (transposed: components on
+        # sublanes, rows on lanes — the Mosaic-dense layout for narrow
+        # per-row payloads). Unpack 8 signed nibbles per word with the
+        # 2-op sign-extending decode ((w << s) >> 28) and write component
+        # rows into the [d, cap] VMEM scratch; one MXU matmul then scores
+        # the whole block. Per-component dequant scales are folded into
+        # ``qv`` by the caller, so decoded values stay the raw [-8, 7]
+        # integers (exact in bf16).
+        blk_w = storage_ref[0].astype(jnp.int32)        # [nw, cap]
+        nw = blk_w.shape[0]
+        for wi in range(nw):
+            word = blk_w[wi, :]                          # [cap] i32
+            for j in range(8):
+                vals = (word << (28 - 4 * j)) >> 28      # [-8, 7]
+                recon_ref[wi * 8 + j, :] = vals.astype(qv.dtype)
+        dots = jax.lax.dot_general(
+            qv, recon_ref[...],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # [G, cap]
+    else:
+        blk = storage_ref[0].astype(qv.dtype)           # [cap, d]
+        dots = jax.lax.dot_general(
+            qv, blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # [G, cap]
     G, cap = dots.shape
     if metric_kind == L2:
         dist = jnp.maximum(
@@ -191,10 +217,10 @@ def _scan_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "metric_kind", "approx", "interpret"),
+    static_argnames=("k", "metric_kind", "approx", "interpret", "packed_i4"),
 )
 def fused_list_scan_topk(
-    storage,        # [C, cap, d] source dtype
+    storage,        # [C, cap, d] source dtype | [C, d//8, cap] u32 (packed_i4)
     indices,        # [C, cap] int32 stored global ids
     list_sizes,     # [C] int32
     bucket_list,    # [nb] int32
@@ -207,6 +233,7 @@ def fused_list_scan_topk(
     metric_kind: int,
     approx: bool = True,
     interpret: bool = False,
+    packed_i4: bool = False,
 ):
     """Scan each bucket's list block against its query group and return the
     per-pair top-k in min-space.
@@ -216,8 +243,21 @@ def fused_list_scan_topk(
     distances are negated scores — negate back after the merge. Invalid
     tail entries (list shorter than k after filtering) come back as
     (+inf, -1) — mask on either.
+
+    ``packed_i4``: storage holds signed int4 components packed 8-per-u32,
+    TRANSPOSED to [C, d//8, cap] so blocks are Mosaic-dense (components on
+    sublanes, rows on lanes) — the in-kernel-decode PQ scan (reference
+    ivf_pq_compute_similarity-inl.cuh scores compressed codes in-registers;
+    here the compressed form is the int4 reconstruction and the decode is
+    a shift/mask VPU prologue feeding one MXU matmul). Per-component
+    dequant scales must be pre-folded into ``qv`` (and ``norms`` hold the
+    dequantized-vector norms), so the kernel itself is scale-free.
     """
-    C, cap, d = storage.shape
+    if packed_i4:
+        C, nw_c, cap = storage.shape
+        d = nw_c * 8
+    else:
+        C, cap, d = storage.shape
     nb, G, _ = qv.shape
     has_norms = norms is not None
     has_filter = keep is not None
@@ -227,7 +267,10 @@ def fused_list_scan_topk(
     # (8, 128) or equal to the array's)
     inputs = [storage, indices.reshape(C, 1, cap)]
     in_specs = [
-        pl.BlockSpec((1, cap, d), lambda i, bl, ls: (bl[i], 0, 0)),
+        pl.BlockSpec(
+            (1, nw_c, cap) if packed_i4 else (1, cap, d),
+            lambda i, bl, ls: (bl[i], 0, 0),
+        ),
         pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0)),
     ]
     if has_norms:
@@ -251,7 +294,7 @@ def fused_list_scan_topk(
     kernel = functools.partial(
         _scan_kernel,
         k=k, metric_kind=metric_kind, approx=approx,
-        has_norms=has_norms, has_filter=has_filter,
+        has_norms=has_norms, has_filter=has_filter, packed_i4=packed_i4,
     )
     out_d, out_i = pl.pallas_call(
         kernel,
@@ -263,6 +306,9 @@ def fused_list_scan_topk(
                 pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
                 pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
             ],
+            scratch_shapes=(
+                [pltpu.VMEM((d, cap), qv.dtype)] if packed_i4 else []
+            ),
         ),
         out_shape=[
             jax.ShapeDtypeStruct((nb, G, k), jnp.float32),
