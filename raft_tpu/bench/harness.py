@@ -11,6 +11,7 @@ recall against ground truth, and reports QPS / latency / build time.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -134,6 +135,48 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13,
         _ = float(r2(queries, jnp.int32(4), operands))
         per_iter = (time.perf_counter() - t3) / n2
     return per_iter
+
+
+def latency_percentiles(search_step, queries, batch: int,
+                        n_calls: int = 50, operands=None) -> dict:
+    """Per-call latency distribution for small-batch serving (the
+    reference's `--mode latency` measurement,
+    docs/source/raft_ann_benchmarks.md:240-254): each timed call
+    dispatches ONE ``batch``-sized query slice and blocks for its
+    result — end-to-end serving latency including dispatch, which is
+    what a latency SLO sees (unlike scan-chained throughput timing,
+    which amortizes dispatch away). Distinct slices defeat result
+    caching. Returns seconds: {p50, p95, mean, batch, n_calls}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    m = queries.shape[0]
+    if m < batch:
+        raise ValueError(f"need >= {batch} queries, got {m}")
+    jitted = jax.jit(
+        search_step if operands is None
+        else functools.partial(search_step, ops=operands)
+    )
+    # warmup/compile on an off-rotation slice
+    qs = jnp.roll(queries, 1, axis=0)[:batch]
+    jax.block_until_ready(jitted(qs))
+    times = []
+    for c in range(n_calls):
+        q = jax.lax.dynamic_slice_in_dim(
+            queries, (c * batch) % max(m - batch, 1), batch)
+        t0 = time.perf_counter()
+        out = jitted(q)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    arr = np.sort(np.asarray(times))
+    return {
+        "p50": float(np.percentile(arr, 50)),
+        "p95": float(np.percentile(arr, 95)),
+        "mean": float(arr.mean()),
+        "batch": batch,
+        "n_calls": n_calls,
+    }
 
 
 def run_case(

@@ -317,7 +317,11 @@ def _make_case(algo: str, metric: str, build_param: dict, search_param: dict,
     raise ValueError(f"unknown algo {algo!r}")
 
 
-def run_config(cfg: dict, iters: int = 10) -> List[BenchResult]:
+def run_config(cfg: dict, iters: int = 10,
+               mode: str = "throughput") -> List[BenchResult]:
+    """``mode``: "throughput" (scan-chained batch QPS, default) or
+    "latency" (reference raft_ann_benchmarks.md:240-254 `--mode latency`:
+    per-call p50/p95 at batch 1 and 10; qps is then batch/p50)."""
     dcfg = cfg["dataset"]
     k = int(dcfg.get("k", 10))
     metric = dcfg.get("distance", "sqeuclidean")
@@ -359,6 +363,33 @@ def run_config(cfg: dict, iters: int = 10) -> List[BenchResult]:
             q_dev = jnp.asarray(queries)
             dist, idx = search_q(index, q_dev)
             recall = compute_recall(np.asarray(idx), gt)
+            if mode == "latency":
+                from raft_tpu.bench.harness import latency_percentiles
+
+                lat = {}
+                for b in (1, 10):
+                    lat[f"b{b}"] = latency_percentiles(
+                        lambda q, ops: search_q(ops, q), q_dev, b,
+                        n_calls=max(10, iters * 3), operands=index,
+                    )
+                p50_10 = lat["b10"]["p50"]
+                r = BenchResult(
+                    name=f"{index_def['name']}#{si}",
+                    build_s=build_s,
+                    search_s=p50_10 / 10.0,
+                    qps=10.0 / p50_10,
+                    recall=recall,
+                    k=k,
+                    n_queries=queries.shape[0],
+                    extra={"algo": algo, "mode": "latency",
+                           **{f"lat.{bk}.{mk}": round(mv, 6)
+                              for bk, d_ in lat.items()
+                              for mk, mv in d_.items()},
+                           **{f"s.{kk}": vv for kk, vv in sp.items()}},
+                )
+                results.append(r)
+                print(json.dumps(r.row()), flush=True)
+                continue
             if algo in _HOST_ALGOS:
                 # pure-host competitors can't jit at all; plain host timer
                 from raft_tpu.bench.harness import time_fn
@@ -431,10 +462,12 @@ def main(argv=None) -> None:
     ap.add_argument("--output", default=".")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--plot", action="store_true")
+    ap.add_argument("--mode", choices=("throughput", "latency"),
+                    default="throughput")
     args = ap.parse_args(argv)
     cfg = json.load(open(args.config))
     os.makedirs(args.output, exist_ok=True)
-    results = run_config(cfg, iters=args.iters)
+    results = run_config(cfg, iters=args.iters, mode=args.mode)
     stem = os.path.splitext(os.path.basename(args.config))[0]
     export_csv(results, os.path.join(args.output, f"{stem}.csv"))
     with open(os.path.join(args.output, f"{stem}.json"), "w") as fp:

@@ -26,10 +26,16 @@ from raft_tpu.comms.ops import (
     reduce,
     reducescatter,
 )
+from raft_tpu.comms.session import (
+    CommsSession,
+    get_comm_state,
+    session_handle,
+)
 from raft_tpu.comms.sharded import (
     sharded_cagra_build,
     sharded_cagra_search,
     sharded_ivf_build,
+    sharded_ivf_pq_build,
     sharded_ivf_pq_search,
     sharded_ivf_row_search,
     sharded_ivf_search,
@@ -54,6 +60,10 @@ __all__ = [
     "sharded_cagra_build",
     "sharded_cagra_search",
     "sharded_ivf_build",
+    "CommsSession",
+    "get_comm_state",
+    "session_handle",
+    "sharded_ivf_pq_build",
     "sharded_ivf_pq_search",
     "sharded_ivf_row_search",
     "sharded_ivf_search",

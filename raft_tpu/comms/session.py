@@ -1,0 +1,107 @@
+"""Comms session registry — the raft-dask session-management analog.
+
+The reference keeps a per-worker registry of initialized comms sessions
+(``Comms.init`` broadcasts an NCCL uniqueId, each dask worker stores
+``{sessionId: {nccl, ucx, handle, ...}}`` and consumers fetch the
+worker-local handle by sessionId:
+python/raft-dask/raft_dask/common/comms.py:173 ``Comms.init``,
+:248 ``local_handle``, :269 ``get_raft_comm_state``).
+
+On TPU the roles map as: one JAX *process* is one worker; the process
+group is established once by ``raft_tpu.bootstrap.init_multihost``
+(jax.distributed — the runtime owns rank discovery, so there is no
+uniqueId exchange); a *session* is then a named (mesh, axis) binding
+with its injected-comms handle. Multiple sessions can coexist per
+process (e.g. a global mesh session and a sub-mesh session), matching
+the multiple-dask-session model.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+from jax.sharding import Mesh
+
+# per-process session registry: the `_raft_comm_state` worker attribute
+# of the reference (one process == one worker here)
+_comm_state: dict = {}
+
+
+class CommsSession:
+    """Session-scoped comms initializer (reference ``Comms``,
+    raft_dask/common/comms.py:84).
+
+    >>> s = CommsSession(mesh)        # or CommsSession() for all devices
+    >>> s.init()
+    >>> h = local_handle(s.sessionId) # DeviceResources with comms bound
+    >>> ... shard_map(lambda x: h.comms.allreduce(x), ...)
+    >>> s.destroy()
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 axis_name: str = "shard", seed: int = 0,
+                 verbose: bool = False):
+        self.sessionId = uuid.uuid4().hex
+        self._mesh = mesh
+        self.axis_name = axis_name
+        self.seed = seed
+        self.verbose = verbose
+        self.initialized = False
+
+    def init(self) -> "CommsSession":
+        """Create this session's worker-local state: the bound mesh, the
+        ``Comms`` facade, and a handle with the comms injected (the
+        reference's ``_func_init_all`` on every worker)."""
+        from raft_tpu.comms.comms import Comms, default_mesh
+        from raft_tpu.core.resources import DeviceResources
+
+        if self.initialized:
+            import warnings
+
+            warnings.warn("Comms have already been initialized.")
+            return self
+        mesh = (self._mesh if self._mesh is not None
+                else default_mesh(axis_name=self.axis_name))
+        comms = Comms(mesh, self.axis_name)
+        handle = DeviceResources(seed=self.seed, mesh=mesh)
+        handle.set_comms(comms)
+        state = get_comm_state(self.sessionId)
+        state.update({"mesh": mesh, "comms": comms, "handle": handle})
+        self.initialized = True
+        if self.verbose:
+            print(f"comms session {self.sessionId} initialized "
+                  f"({mesh.shape[self.axis_name]} devices)")
+        return self
+
+    def destroy(self) -> None:
+        """Drop the session's registry state (``_func_destroy_all``)."""
+        _comm_state.pop(self.sessionId, None)
+        self.initialized = False
+
+    def __enter__(self) -> "CommsSession":
+        return self.init()
+
+    def __exit__(self, *exc) -> None:
+        self.destroy()
+
+
+def get_comm_state(sessionId: Optional[str]) -> dict:
+    """Worker-local state dict for a session, created (timestamp-only)
+    if absent; with ``sessionId=None`` returns all sessions — mirroring
+    ``get_raft_comm_state`` (raft_dask/common/comms.py:269)."""
+    if sessionId is None:
+        return _comm_state
+    if sessionId not in _comm_state:
+        _comm_state[sessionId] = {"ts": time.time()}
+    return _comm_state[sessionId]
+
+
+def session_handle(sessionId: str):
+    """The worker-local handle for an initialized session, or None —
+    the raft-dask ``local_handle(sessionId)`` (comms.py:248). Named
+    ``session_handle`` because ``raft_tpu.comms.local_handle`` already
+    provides the sessionless mesh->handle shortcut."""
+    state = get_comm_state(sessionId)
+    return state.get("handle")

@@ -263,6 +263,78 @@ def sharded_ivf_pq_search(
     return jax.jit(fn)(*args)
 
 
+def sharded_ivf_pq_build(
+    params,
+    dataset,
+    mesh: Mesh,
+    axis_name: str = "shard",
+):
+    """Sharded IVF-PQ build: quantizers (coarse centers, rotation, PQ
+    codebooks) are trained ONCE on a subsample, then each device encodes
+    ITS row shard under ``shard_map`` — the FLOP-heavy stage (coarse
+    assignment + per-subspace argmin) scales linearly over the mesh, the
+    reference's multi-GPU build split (raft-dask builds per-worker parts
+    against shared quantizers). The per-shard codes are all-gathered and
+    packed into the global list layout; at real DEEP-1B scale the gather
+    becomes a list-owner reduce-scatter instead (each device keeps only
+    its C/S lists — see ``sharded_ivf_pq_search``'s in_specs), which this
+    single-host rehearsal cannot exercise.
+
+    Returns a regular ``ivf_pq.Index`` with GLOBAL row ids; pass it to
+    ``sharded_ivf_pq_search`` to search list-sharded over the mesh.
+    """
+    from raft_tpu.neighbors import ivf_pq
+
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    nshards = mesh.shape[axis_name]
+    if n % nshards != 0:
+        raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
+
+    frac = float(params.kmeans_trainset_fraction)
+    if 0 < frac < 1.0 and int(n * frac) >= int(params.n_lists):
+        trainset = dataset[:: max(int(1.0 / frac), 1)]
+    else:
+        trainset = dataset
+    quant = ivf_pq._quantizer_index(params, trainset, dim)
+
+    def local_encode(part):
+        labels, packed = ivf_pq.encode(quant, part)
+        return labels, packed
+
+    fn = shard_map(
+        local_encode,
+        mesh=mesh,
+        in_specs=(P(axis_name, None),),
+        out_specs=(P(axis_name), P(axis_name, None)),
+        check_vma=False,
+    )
+    labels, packed = jax.jit(fn)(dataset)
+
+    import numpy as np
+    from raft_tpu.neighbors.ivf_flat import _aligned_cap, _pack_lists
+
+    ids = jnp.arange(n, dtype=jnp.int32)
+    counts = np.bincount(np.asarray(labels), minlength=quant.n_lists)
+    cap = _aligned_cap(int(counts.max()))
+    codes_packed, indices, list_sizes = _pack_lists(
+        packed, labels, ids, quant.n_lists, cap
+    )
+    rec_norms = ivf_pq._rec_norms(
+        codes_packed, quant.pq_centers, int(params.codebook_kind),
+        quant.pq_dim, int(params.pq_bits),
+    )
+    import dataclasses as _dc
+
+    return ivf_pq._attach_cache(_dc.replace(
+        quant,
+        codes=codes_packed,
+        indices=indices,
+        list_sizes=list_sizes,
+        rec_norms=rec_norms,
+    ))
+
+
 def sharded_cagra_build(
     params,
     dataset,

@@ -1,5 +1,15 @@
-"""Profile the IVF-Flat search pipeline component-by-component on the
-real chip. Round-2 perf work: find where the 3053-QPS round-1 number went.
+"""Profile the IVF-Flat (Pallas-path) and CAGRA search pipelines
+component-by-component on the real chip.
+
+Round-4 perf work (VERDICT #2): the bench configs sit at 0.30x/0.28x of
+the A100 baseline while the HBM-bound scan itself should reach ~0.4x —
+find which stage eats the difference. Stages measured independently with
+scan-chained timing where possible:
+
+  IVF-Flat: coarse+select | bucketize | qv-gather | fused kernel |
+            unbucketize+final-merge | end-to-end
+  CAGRA:    seed slab | per-iter pack gather | per-iter kernel |
+            final rescore | end-to-end
 
 Run: python scripts/profile_ivf.py [n] [nq]
 """
@@ -14,8 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-from bench import _sift_like as sift_like  # same workload the bench measures
+from bench import _sift_like as sift_like
 from raft_tpu.bench.harness import time_fn
 
 
@@ -23,60 +32,31 @@ def timeit(fn, *args, iters=5, warmup=2):
     return time_fn(lambda: fn(*args), iters=iters, warmup=warmup)
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    nq = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
-    d, k, n_lists, n_probes = 128, 10, 1024, 64
-
-    print(f"devices: {jax.devices()}", flush=True)
+def profile_ivf_flat(x, q, n_lists=1024, n_probes=64, k=10):
     from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.neighbors.ivf_flat import (
+        adaptive_query_group, bucketize_pairs, unbucketize_merge,
+    )
     from raft_tpu.matrix.select_k import select_k
+    from raft_tpu.neighbors.common import sentinel_for
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.ops import ivf_scan
 
-    x = jax.device_put(sift_like(n, d, seed=1))
-    q = jax.device_put(sift_like(nq, d, seed=2))
-
+    nq, d = q.shape
     t0 = time.perf_counter()
     params = ivf_flat.IndexParams(n_lists=n_lists, metric="sqeuclidean")
     index = ivf_flat.build(params, x)
     jax.block_until_ready(index.storage)
-    print(f"build: {time.perf_counter()-t0:.1f}s  cap={index.storage.shape[1]}",
-          flush=True)
-
     C, cap, _ = index.storage.shape
-    sizes = np.asarray(index.list_sizes)
-    print(f"list sizes: min={sizes.min()} max={sizes.max()} mean={sizes.mean():.0f}",
-          flush=True)
+    print(f"[flat] build {time.perf_counter()-t0:.1f}s cap={cap}", flush=True)
 
-    # --- raw MXU reference: what would brute force cost? ------------------
-    xb = index.storage.reshape(-1, d).astype(jnp.bfloat16)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    t = timeit(lambda: ivf_flat.search(sp, index, q, k)[1], iters=5, warmup=2)
+    print(f"[flat] end-to-end: {t*1e3:.1f} ms ({nq/t:.0f} QPS)", flush=True)
 
-    @jax.jit
-    def bf_dots(q):
-        return (q.astype(jnp.bfloat16) @ xb.T).sum(axis=1)  # avoid materializing topk
-
-    t = timeit(bf_dots, q, iters=3, warmup=1)
-    flops = 2.0 * nq * (C * cap) * d
-    print(f"brute dots: {t*1e3:.1f} ms  ({flops/t/1e12:.1f} TFLOP/s)", flush=True)
-
-    # --- full current search ---------------------------------------------
-    for bb, grp, lrt, cd in [(8, 256, 0.95, "bf16"),
-                             (32, 256, 0.95, "bf16"),
-                             (64, 256, 1.0, "bf16"),
-                             (32, 512, 0.95, "bf16")]:
-        sp = ivf_flat.SearchParams(n_probes=n_probes, bucket_batch=bb,
-                                   query_group=grp, local_recall_target=lrt,
-                                   compute_dtype=cd)
-        try:
-            t = timeit(lambda: ivf_flat.search(sp, index, q, k)[1], iters=3,
-                       warmup=1)
-            print(f"search bb={bb} grp={grp} lrt={lrt} {cd}: "
-                  f"{t*1e3:.1f} ms  ({nq/t:.0f} QPS)", flush=True)
-        except Exception as e:  # noqa: BLE001
-            print(f"search bb={bb} grp={grp}: FAILED {type(e).__name__}: {e}",
-                  flush=True)
-
-    # --- components -------------------------------------------------------
     q32 = q.astype(jnp.float32)
+    group = adaptive_query_group(nq, n_probes, C, sp.query_group)
+    print(f"[flat] group={group}", flush=True)
 
     @jax.jit
     def coarse(q32):
@@ -85,80 +65,87 @@ def main():
         cn2 = jnp.sum(index.centers * index.centers, axis=1)
         return select_k(qn2 + cn2[None, :] - 2.0 * cdot, n_probes)[1]
 
-    t = timeit(coarse, q32)
-    print(f"coarse+select: {t*1e3:.1f} ms", flush=True)
-
+    print(f"[flat] coarse+select: {timeit(coarse, q32)*1e3:.1f} ms", flush=True)
     probes = coarse(q32)
 
-    from raft_tpu.neighbors.ivf_flat import bucketize_pairs
-
-    bk = jax.jit(lambda p: bucketize_pairs(p, nq, n_probes, C, 256, 8)[:2])
-    t = timeit(bk, probes)
-    print(f"bucketize: {t*1e3:.1f} ms", flush=True)
-
-    bl, bq = bk(probes)
-    nb = bl.shape[0]
-    print(f"n_buckets(padded)={nb}", flush=True)
-
-    # gather cost alone
-    @jax.jit
-    def gather_blocks(bl):
-        def body(c, blc):
-            blk = index.storage[blc]  # [bb, cap, d]
-            return c + blk.sum(), None
-        c, _ = jax.lax.scan(body, 0.0, bl.reshape(-1, 8))
-        return c
-
-    t = timeit(gather_blocks, bl, iters=3, warmup=1)
-    print(f"scan gather-only (bb=8): {t*1e3:.1f} ms", flush=True)
-
-    # gather + matmul, no select
-    qg = q32[jnp.maximum(bq, 0)]  # [nb, grp, d] pre-gathered queries
+    bk = jax.jit(lambda p: bucketize_pairs(p, nq, n_probes, C, group,
+                                           sp.bucket_batch))
+    t = timeit(lambda: bk(probes)[0], iters=5)
+    print(f"[flat] bucketize: {t*1e3:.1f} ms", flush=True)
+    (bl, bq, pair_bucket, pair_pos, order, total, nb_pad) = bk(probes)
+    print(f"[flat] n_buckets={bl.shape[0]}", flush=True)
 
     @jax.jit
-    def scan_matmul(bl, qg):
-        def body(c, inp):
-            blc, qv = inp
-            blk = index.storage[blc].astype(jnp.bfloat16)
-            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
-                              preferred_element_type=jnp.float32)
-            return c + dots.sum(), None
-        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
-        return c
+    def qv_gather(q32, bq):
+        qs = jnp.maximum(bq, 0)
+        qv = q32[qs].astype(jnp.bfloat16)
+        qaux = jnp.sum(q32[qs] * q32[qs], axis=2)
+        return qv, qaux
 
-    t = timeit(scan_matmul, bl, qg, iters=3, warmup=1)
-    print(f"scan gather+matmul (bb=8): {t*1e3:.1f} ms", flush=True)
+    t = timeit(lambda: qv_gather(q32, bq)[0], iters=5)
+    print(f"[flat] qv gather: {t*1e3:.1f} ms", flush=True)
+    qv, qaux = qv_gather(q32, bq)
 
-    # matmul + approx topk
+    storage = index.storage
+    norms = jnp.sum(storage.astype(jnp.float32) ** 2, axis=2)
+
+    def kern(bl, qv, qaux):
+        return ivf_scan.fused_list_scan_topk(
+            storage, index.indices, index.list_sizes, bl, qv, qaux, norms,
+            None, k=k, metric_kind=ivf_scan.L2, approx=True)[0]
+
+    t = timeit(lambda: jax.jit(kern)(bl, qv, qaux), iters=5)
+    print(f"[flat] fused kernel: {t*1e3:.1f} ms", flush=True)
+    out_d, out_i = jax.jit(
+        lambda bl, qv, qaux: ivf_scan.fused_list_scan_topk(
+            storage, index.indices, index.list_sizes, bl, qv, qaux, norms,
+            None, k=k, metric_kind=ivf_scan.L2, approx=True)
+    )(bl, qv, qaux)
+
+    sentinel = sentinel_for(DistanceType.L2Expanded, jnp.float32)
+
     @jax.jit
-    def scan_matmul_topk(bl, qg):
-        def body(c, inp):
-            blc, qv = inp
-            blk = index.storage[blc].astype(jnp.bfloat16)
-            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
-                              preferred_element_type=jnp.float32)
-            v, i = jax.lax.approx_min_k(dots, k, recall_target=0.95)
-            return c + v.sum(), None
-        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
-        return c
+    def unb(out_d, out_i):
+        return unbucketize_merge(
+            out_d, out_i, pair_bucket, pair_pos, order, total, nq,
+            n_probes, k, k, True, sentinel)[1]
 
-    t = timeit(scan_matmul_topk, bl, qg, iters=3, warmup=1)
-    print(f"scan gather+matmul+approxtopk (bb=8): {t*1e3:.1f} ms", flush=True)
+    t = timeit(lambda: unb(out_d, out_i), iters=5)
+    print(f"[flat] unbucketize+merge: {t*1e3:.1f} ms", flush=True)
 
-    @jax.jit
-    def scan_matmul_exact_topk(bl, qg):
-        def body(c, inp):
-            blc, qv = inp
-            blk = index.storage[blc].astype(jnp.bfloat16)
-            dots = jnp.einsum("bgd,bcd->bgc", qv.astype(jnp.bfloat16), blk,
-                              preferred_element_type=jnp.float32)
-            v, i = jax.lax.top_k(-dots, k)
-            return c + v.sum(), None
-        c, _ = jax.lax.scan(body, 0.0, (bl.reshape(-1, 8), qg.reshape(-1, 8, 256, d)))
-        return c
 
-    t = timeit(scan_matmul_exact_topk, bl, qg, iters=3, warmup=1)
-    print(f"scan gather+matmul+exact topk (bb=8): {t*1e3:.1f} ms", flush=True)
+def profile_cagra(x, q, k=10):
+    from raft_tpu.neighbors import cagra
+
+    nq, d = q.shape
+    t0 = time.perf_counter()
+    params = cagra.IndexParams(graph_degree=32, intermediate_graph_degree=64)
+    index = cagra.build(params, x)
+    jax.block_until_ready(index.graph)
+    print(f"[cagra] build {time.perf_counter()-t0:.1f}s", flush=True)
+
+    sp = cagra.SearchParams(itopk_size=64, search_width=2)
+    t = timeit(lambda: cagra.search(sp, index, q, k)[1], iters=5, warmup=2)
+    print(f"[cagra] end-to-end: {t*1e3:.1f} ms ({nq/t:.0f} QPS)", flush=True)
+
+    # stage split: iterations vs fixed cost — vary max_iterations
+    for iters in (6, 12, 24):
+        spi = cagra.SearchParams(itopk_size=64, search_width=2,
+                                 max_iterations=iters)
+        t = timeit(lambda: cagra.search(spi, index, q, k)[1], iters=5,
+                   warmup=1)
+        print(f"[cagra] iters={iters}: {t*1e3:.1f} ms", flush=True)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    nq = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    print(f"devices: {jax.devices()}", flush=True)
+    x = jax.device_put(sift_like(n, 128, seed=1))
+    q = jax.device_put(sift_like(nq, 128, seed=2))
+    jax.block_until_ready(x)
+    profile_ivf_flat(x, q)
+    profile_cagra(x, q)
 
 
 if __name__ == "__main__":

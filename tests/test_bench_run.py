@@ -75,3 +75,41 @@ def test_constraints_skip_invalid_cases():
                           10)
     assert check_case("ivf_flat", {"n_lists": 64}, {"n_probes": 64}, 96, 10)
     assert not check_case("ivf_pq", {"pq_dim": 200}, {}, 96, 10)
+
+
+def test_run_config_skips_invalid_cases(capsys):
+    """The orchestrator itself gates on constraints: invalid search
+    params are skipped (printed), valid ones still run — the reference
+    sweep pattern (raft-ann-bench constraints/__init__.py)."""
+    cfg = json.load(open("raft_tpu/bench/conf/smoke.json"))
+    cfg["dataset"]["synthetic"]["n"] = 3000
+    cfg["dataset"]["synthetic"]["n_queries"] = 50
+    # poison one index def with an impossible probe count + keep a valid one
+    for idx in cfg["index"]:
+        if idx["algo"] == "ivf_flat":
+            idx["search_params"] = (
+                [{"n_probes": 10**6}] + idx["search_params"][:1]
+            )
+    results = bench_run.run_config(cfg, iters=2)
+    out = capsys.readouterr().out
+    assert "skip invalid case" in out
+    assert len(results) == 2  # bf + the one valid ivf case
+    assert all(r.qps > 0 for r in results)
+
+
+def test_latency_mode(tmp_path):
+    """--mode latency: per-call p50/p95 at batch 1/10 in extra, qps
+    derived from batch-10 p50."""
+    cfg = json.load(open("raft_tpu/bench/conf/smoke.json"))
+    cfg["dataset"]["synthetic"]["n"] = 3000
+    cfg["dataset"]["synthetic"]["n_queries"] = 64
+    cfg["index"] = [i for i in cfg["index"] if i["algo"] == "ivf_flat"]
+    cfg["index"][0]["search_params"] = cfg["index"][0]["search_params"][:1]
+    results = bench_run.run_config(cfg, iters=3, mode="latency")
+    assert len(results) == 1
+    r = results[0]
+    assert r.extra["mode"] == "latency"
+    for key in ("lat.b1.p50", "lat.b1.p95", "lat.b10.p50", "lat.b10.p95"):
+        assert r.extra[key] > 0
+    assert r.extra["lat.b1.p50"] <= r.extra["lat.b1.p95"]
+    assert abs(r.qps - 10.0 / r.extra["lat.b10.p50"]) / r.qps < 1e-6

@@ -61,3 +61,42 @@ def test_bitset_from_dense(rng):
     bs = Bitset.from_dense(mask)
     np.testing.assert_array_equal(np.asarray(bs.to_dense()), mask)
     assert int(bs.count()) == mask.sum()
+
+
+def test_interruptible_cancel_unblocks_sync():
+    """interruptible: cancel from another thread makes the target's next
+    synchronize raise (reference core/interruptible.hpp:39-105)."""
+    import threading
+    import time as _time
+
+    import pytest
+    import jax.numpy as jnp
+    from raft_tpu.core.interruptible import (
+        Interruptible, InterruptedException, cancel, synchronize,
+    )
+
+    # one-shot check(): set -> raise -> cleared
+    tok = Interruptible.get_token()
+    tok.cancel()
+    with pytest.raises(InterruptedException):
+        tok.check()
+    tok.check()  # flag cleared: no raise
+
+    # cross-thread cancel during a (long-ish) wait loop
+    main_tid = threading.get_ident()
+    state = {}
+
+    def killer():
+        _time.sleep(0.05)
+        cancel(main_tid)
+
+    t = threading.Thread(target=killer)
+    t.start()
+    # poll a ready array repeatedly so the canceller has a window; the
+    # cancel lands between synchronize calls and the next one raises
+    x = jnp.ones((4,))
+    with pytest.raises(InterruptedException):
+        for _ in range(500):
+            synchronize(x)
+            _time.sleep(0.001)
+    t.join()

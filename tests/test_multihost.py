@@ -60,6 +60,32 @@ _WORKER = textwrap.dedent(
     got = np.asarray(i)
     recall = np.mean([len(set(got[r]) & set(want[r])) / 4 for r in range(8)])
     assert recall > 0.99, recall
+
+    # session registry (raft-dask Comms.init/local_handle analog,
+    # raft_dask/common/comms.py:173,248,269): two concurrent sessions on
+    # this worker, collectives routed through each session's handle
+    from raft_tpu.comms import CommsSession, get_comm_state, session_handle
+
+    s1 = CommsSession(mesh).init()
+    s2 = CommsSession(mesh).init()
+    assert s1.sessionId != s2.sessionId
+    assert set(get_comm_state(None)) >= {s1.sessionId, s2.sessionId}
+    for s, mult in ((s1, 1.0), (s2, 2.0)):
+        h = session_handle(s.sessionId)
+        assert h is not None and h.mesh is mesh
+
+        def g(x, _c=h.comms):
+            return _c.allreduce(x)
+
+        z = jax.jit(shard_map(g, mesh=h.mesh, in_specs=P("shard"),
+                              out_specs=P()))(
+            jnp.full((nproc,), mult, jnp.float32)
+        )
+        assert float(z[0]) == nproc * mult, (s.sessionId, float(z[0]))
+    s1.destroy()
+    assert session_handle(s2.sessionId) is not None
+    assert get_comm_state(None).get(s1.sessionId) is None
+    s2.destroy()
     print(f"proc{pid} OK", flush=True)
     """
 )
