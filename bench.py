@@ -79,6 +79,18 @@ def _sift_like(n, d, seed=0, intrinsic=16):
 from raft_tpu.bench.harness import scan_qps_time  # noqa: E402
 
 
+def _median_s(results, key_stub, timer, n_draws=5):
+    """Variance-honest timing: run ``timer()`` (one scan-chained
+    two-point measurement = one draw) ``n_draws`` times, record EVERY
+    draw under ``{key_stub}_draws_s`` and return the median seconds.
+    Tunnel jitter spreads single draws by up to ~2x (BASELINE.md round-3
+    spread: pairwise 41-868 GB/s); medians of >=5 draws are stable to
+    ~10% and the full list keeps the spread auditable."""
+    draws = [timer() for _ in range(n_draws)]
+    results[f"{key_stub}_draws_s"] = [round(s, 6) for s in draws]
+    return float(np.median(draws))
+
+
 def bench_bruteforce_sift10k(results):
     import jax
     from raft_tpu.neighbors import brute_force
@@ -87,8 +99,8 @@ def bench_bruteforce_sift10k(results):
     x = jax.device_put(_sift_like(n, d, seed=1))
     q = jax.device_put(_sift_like(nq, d, seed=2))
     index = brute_force.build(x, "sqeuclidean")
-    s = scan_qps_time(lambda qq, ix: brute_force.search(ix, qq, k), q,
-                      operands=index)
+    s = _median_s(results, "bruteforce_sift10k", lambda: scan_qps_time(
+        lambda qq, ix: brute_force.search(ix, qq, k), q, operands=index))
     results["bruteforce_sift10k_qps"] = round(nq / s, 1)
 
 
@@ -99,18 +111,10 @@ def bench_pairwise(results):
     n, d = 10_000, 128
     x = jax.device_put(_sift_like(n, d, seed=1))
     q = jax.device_put(_sift_like(n, d, seed=2))
-    # median of 3: this config's wall time is seconds-scale, so a single
-    # two-point measurement inherits full tunnel jitter (observed
-    # 280-650 GB/s run to run); the median is stable to ~10%
-    samples = sorted(
-        scan_qps_time(
-            lambda qq, xx: (pairwise_distance(qq, xx, "sqeuclidean"),
-                            jax.numpy.zeros((1,), jax.numpy.int32)),
-            q, operands=x,
-        )
-        for _ in range(3)
-    )
-    s = samples[1]
+    s = _median_s(results, "pairwise_l2", lambda: scan_qps_time(
+        lambda qq, xx: (pairwise_distance(qq, xx, "sqeuclidean"),
+                        jax.numpy.zeros((1,), jax.numpy.int32)),
+        q, operands=x))
     bytes_moved = n * d * 4 * 2 + n * n * 4
     results["pairwise_l2_gbps"] = round(bytes_moved / s / 1e9, 1)
     results["pairwise_l2_gflops"] = round(2 * n * n * d / s / 1e9, 1)
@@ -135,8 +139,8 @@ def bench_ivfflat_sift1m(results):
     sub = 1000
     _, bf_idx = brute_force.knn(q[:sub], x, k)
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(bf_idx))
-    s = scan_qps_time(lambda qq, ix: ivf_flat.search(sp, ix, qq, k), q,
-                      operands=index)
+    s = _median_s(results, "ivfflat_sift1m", lambda: scan_qps_time(
+        lambda qq, ix: ivf_flat.search(sp, ix, qq, k), q, operands=index))
     results["ivfflat_sift1m_qps"] = round(nq / s, 1)
     results["ivfflat_recall"] = round(float(recall), 3)
 
@@ -162,8 +166,8 @@ def bench_cagra_sift1m(results):
     sub = 1000
     _, bf_idx = brute_force.knn(q[:sub], x, k)
     recall = compute_recall(np.asarray(idx[:sub]), np.asarray(bf_idx))
-    s = scan_qps_time(lambda qq, ix: cagra.search(sp, ix, qq, k), q,
-                      operands=index)
+    s = _median_s(results, "cagra_sift1m", lambda: scan_qps_time(
+        lambda qq, ix: cagra.search(sp, ix, qq, k), q, operands=index))
     results["cagra_sift1m_qps"] = round(nq / s, 1)
     results["cagra_recall"] = round(float(recall), 3)
 
@@ -212,8 +216,9 @@ def bench_ivfpq_deep10m(results):
     # platform's ~2 min single-program watchdog
     n2 = int(np.clip(45.0 / rough_s, 2, 13))
     n1 = max(1, n2 // 3)
-    s = scan_qps_time(lambda qq, ix: ivf_pq.search(sp, ix, qq, k), q,
-                      n1=n1, n2=n2, operands=index)
+    s = _median_s(results, "ivfpq_deep10m", lambda: scan_qps_time(
+        lambda qq, ix: ivf_pq.search(sp, ix, qq, k), q,
+        n1=n1, n2=n2, operands=index), n_draws=3)
     results["ivfpq_deep10m_qps"] = round(nq / s, 1)
     results["ivfpq_recall"] = round(float(recall), 3)
 
@@ -233,8 +238,9 @@ def bench_ivfpq_deep10m(results):
 
     dist_r, idx_r = search_refined(q, (index, x_dev))
     recall_r = compute_recall(np.asarray(idx_r[:sub]), np.asarray(mi))
-    s = scan_qps_time(search_refined, q, n1=n1, n2=n2,
-                      operands=(index, x_dev))
+    s = _median_s(results, "ivfpq_refined", lambda: scan_qps_time(
+        search_refined, q, n1=n1, n2=n2, operands=(index, x_dev)),
+        n_draws=3)
     results["ivfpq_refined_qps"] = round(nq / s, 1)
     results["ivfpq_refined_recall"] = round(float(recall_r), 3)
 

@@ -88,6 +88,22 @@ def sort_by_key(keys, *payloads, descending: bool = False):
     return keys, payloads
 
 
+def merge_bitonic(keys, *payloads):
+    """Final-stage network on rows that are already BITONIC (ascending
+    then descending): log2(L) all-ascending substages produce fully
+    ascending rows. The primitive under ``merge_sorted`` and the
+    tournament top-k's pair-merge (select_k._tournament_topk)."""
+    L = keys.shape[-1]
+    if L & (L - 1):
+        raise ValueError(f"bitonic length must be a power of two, got {L}")
+    j = L // 2
+    while j >= 1:
+        asc = jnp.asarray(np.ones((L // (2 * j), j), dtype=bool))
+        keys, payloads = _substage(keys, payloads, j, asc)
+        j //= 2
+    return keys, payloads
+
+
 def merge_sorted(keys, *payloads):
     """Bitonic *merge* of a row whose two halves are each sorted
     ascending: flip the upper half to form a bitonic sequence, then run

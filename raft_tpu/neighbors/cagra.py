@@ -293,6 +293,21 @@ def build_knn_graph(
     # is noise. Configs needing >= 64 final columns keep the exact k
     # (slower XLA scan) — correctness over speed.
     if k > 64 and min_degree is not None and min_degree <= 63:
+        if k > 65:
+            # trimming by more than the free self-column is a quality
+            # trade the caller should hear about (ADVICE r3): a requested
+            # intermediate degree of e.g. 128 becomes 63 columns fed to
+            # optimize(). Opt out by raising graph_degree above 63 or
+            # calling build_knn_graph directly (min_degree=None).
+            import warnings
+
+            warnings.warn(
+                f"CAGRA build: intermediate_graph_degree={k - 1} trimmed "
+                f"to 63 to stay on the fused k<=64 self-search (final "
+                f"graph_degree={min_degree} is unaffected; pass "
+                f"min_degree=None to keep the full candidate pool on the "
+                f"slower exact path)", stacklevel=2,
+            )
         k = 64       # None (direct callers) keeps the exact column count
     k = min(k, n)    # tiny datasets: refine k cannot exceed n candidates
     gpu_top_k = min(n, max(k, int(k * refine_rate)))
