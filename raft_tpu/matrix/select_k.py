@@ -3,15 +3,16 @@
 TPU-native analog of the reference's ``raft::matrix::select_k``
 (cpp/include/raft/matrix/select_k.cuh:81) whose CUDA backends are a radix
 11-bit histogram select and warp-level bitonic priority queues chosen by a
-learned heuristic (matrix/detail/select_k-inl.cuh:51-79). On TPU, XLA's
-``lax.top_k`` lowers to the hardware sort unit and is already near-optimal
-for the k ranges the reference covers; the "dispatch" concept survives as a
-single entry point that (a) maps select-min onto top_k by negation and (b)
-carries pass-through source indices (the reference's ``in_idx``). A
-two-pass histogram-threshold variant (the radix-select analog) is exposed
-as ``select_k_threshold``; it is not auto-dispatched because without
-candidate compaction it cannot beat the hardware top_k (see note in
-``select_k``).
+learned heuristic (matrix/detail/select_k-inl.cuh:51-79). The dispatch
+here has two arms: XLA's ``lax.top_k`` (hardware sort unit — near-optimal
+for small k) and the exact tournament network ``_tournament_topk`` for
+large k at n >> k — the compacting radix-select analog, built on the
+reshape-bitonic networks with no gathers. The entry point also (a) maps
+select-min onto top_k by negation and (b) carries pass-through source
+indices (the reference's ``in_idx``). A two-pass histogram-threshold
+variant is kept as ``select_k_threshold`` for callers wanting that
+structure; the tournament supersedes it for dispatch (the histogram
+variant never compacts, so it cannot beat the hardware top_k).
 """
 
 from __future__ import annotations

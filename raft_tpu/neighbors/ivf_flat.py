@@ -213,6 +213,12 @@ def _pack_lists(data, labels, row_ids, n_lists: int, cap: int):
     packers rely on it to cap hub in-degree). Returned sizes are the
     *stored* (truncated) counts."""
     n, d = data.shape
+    if n_lists * cap >= 2**31:
+        raise ValueError(
+            f"padded list storage n_lists*cap = {n_lists}*{cap} overflows "
+            "int32 row indexing — the coarse lists are badly skewed "
+            "(undertrained kmeans?) or cap_rows should bound list size"
+        )
     order = jnp.argsort(labels, stable=True)
     sorted_labels = labels[order]
     counts = jnp.bincount(labels, length=n_lists)

@@ -51,9 +51,25 @@ def main():
     mesh = Mesh(devs, ("shard",))
     nshards = 8
 
+    # low-intrinsic-dim manifold data (the bench-wide synthetic recipe):
+    # pure gaussians are IVF's worst case — no cluster structure, so
+    # neighbors spread over all lists and probe recall collapses (~0.25
+    # measured); real embedding datasets (DEEP's CNN features) are
+    # manifold-like, which this generator matches
+    from raft_tpu.bench.run import _gen_device_block
+
     key = jax.random.PRNGKey(4)
-    x = jax.random.normal(key, (n, d), jnp.float32)
-    q = jax.random.normal(jax.random.fold_in(key, 1), (nq, d), jnp.float32)
+    gen = _gen_device_block(1_000_000, d, 16)
+    x = jnp.concatenate(
+        [gen(jax.random.fold_in(key, b)) for b in range(n // 1_000_000)]
+    )
+    q = _gen_device_block(nq, d, 16)(jax.random.fold_in(key, 999))
+    # L2-normalize: DEEP's CNN features are near-unit-norm, which is what
+    # makes its inner_product metric well-posed — on unnormalized data
+    # IP coarse assignment degenerates (big-norm centers capture
+    # everything; measured 61x list skew vs 3.5x normalized)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    q = q / jnp.linalg.norm(q, axis=1, keepdims=True)
 
     res = {"config": {
         "n": n, "dim": d, "n_lists": n_lists, "pq_dim": pq_dim,
@@ -67,7 +83,7 @@ def main():
     t0 = time.time()
     params = ivf_pq.IndexParams(
         n_lists=n_lists, pq_dim=pq_dim, pq_bits=8, metric="inner_product",
-        kmeans_n_iters=5, kmeans_trainset_fraction=0.05,
+        kmeans_n_iters=10, kmeans_trainset_fraction=0.1,
         cache_decoded=False,   # CPU rehearsal: skip the cache build pass
     )
     index = sharded_ivf_pq_build(params, x, mesh)

@@ -558,3 +558,42 @@ def test_cache_only_extend_raises():
                                 keep_codes=False)
     with pytest.raises(ValueError, match="cache-only"):
         ivf_pq.extend(got, x[:10])
+
+
+def test_fused_scan_packed_i4_kernel_oracle():
+    """ops/ivf_scan packed_i4 mode (interpret) against a direct numpy
+    oracle: nibble unpack + scaled dot + norms must reproduce exact L2
+    rankings of the dequantized vectors."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors.ivf_pq import _quant_pack_i4, unpack_i4
+    from raft_tpu.ops import ivf_scan
+
+    rng = np.random.default_rng(21)
+    C, cap, rot, G, k = 3, 128, 16, 8, 5
+    vecs = rng.standard_normal((C, cap, rot)).astype(np.float32)
+    scales = (np.abs(vecs).max(axis=(0, 1)) / 7.0 + 1e-6).astype(np.float32)
+    packed, qnorm = _quant_pack_i4(jnp.asarray(vecs), jnp.asarray(scales))
+    storage_t = jnp.swapaxes(packed, 1, 2)          # [C, rot//8, cap]
+    deq = np.asarray(unpack_i4(packed)) * scales    # [C, cap, rot]
+
+    indices = jnp.arange(C * cap, dtype=jnp.int32).reshape(C, cap)
+    sizes = jnp.full((C,), cap, jnp.int32)
+    bl = jnp.asarray([2, 0, 1], jnp.int32)
+    q = rng.standard_normal((3, G, rot)).astype(np.float32)
+    qv = jnp.asarray(q * scales[None, None, :], jnp.float32)
+    qaux = jnp.asarray((q * q).sum(-1), jnp.float32)
+    norms = jnp.asarray((deq * deq).sum(-1), jnp.float32)
+
+    out_d, out_i = ivf_scan.fused_list_scan_topk(
+        storage_t, indices, sizes, bl, qv, qaux, norms, None,
+        k=k, metric_kind=ivf_scan.L2, approx=False, interpret=True,
+        packed_i4=True,
+    )
+    out_d, out_i = np.asarray(out_d), np.asarray(out_i)
+    for b, lid in enumerate([2, 0, 1]):
+        d2 = ((q[b][:, None, :] - deq[lid][None, :, :]) ** 2).sum(-1)
+        order = np.argsort(d2, axis=1)[:, :k]
+        want_i = np.asarray(indices)[lid][order]
+        np.testing.assert_array_equal(out_i[b], want_i)
+        np.testing.assert_allclose(
+            out_d[b], np.sort(d2, axis=1)[:, :k], rtol=1e-4, atol=1e-4)
