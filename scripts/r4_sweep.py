@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Round-4 single-chip perf sweep (VERDICT #2): IVF-Flat and CAGRA
+throughput levers measured back-to-back in one session — storage dtype,
+query grouping, beam width/iteration trades — each with recall so the
+QPS targets (ivfflat >= 160k, cagra >= 240k at current recalls) are
+checked at equal accuracy.
+
+Run: python scripts/r4_sweep.py [flat|cagra|both]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import _sift_like as sift_like
+from raft_tpu.bench.harness import compute_recall, scan_qps_time
+
+
+def sweep_flat(x, q, want):
+    from raft_tpu.neighbors import ivf_flat
+
+    nq, k = q.shape[0], 10
+    for sd in ("f32", "bf16"):
+        t0 = time.time()
+        params = ivf_flat.IndexParams(n_lists=1024, metric="sqeuclidean",
+                                      storage_dtype=sd)
+        index = ivf_flat.build(params, x)
+        jax.block_until_ready(index.list_sizes)
+        print(f"[flat {sd}] build {time.time()-t0:.0f}s", flush=True)
+        for grp, bb, lrt, mrt in [
+            (256, 32, 0.95, 1.0),
+            (256, 32, 0.95, 0.95),
+            (512, 32, 0.95, 1.0),
+            (256, 64, 0.95, 1.0),
+            (128, 32, 0.95, 1.0),
+        ]:
+            sp = ivf_flat.SearchParams(
+                n_probes=64, query_group=grp, bucket_batch=bb,
+                local_recall_target=lrt, merge_recall_target=mrt)
+            try:
+                _, idx = ivf_flat.search(sp, index, q, k)
+                rec = compute_recall(np.asarray(idx[:1000]), want)
+                s = scan_qps_time(
+                    lambda qq, ix: ivf_flat.search(sp, ix, qq, k), q,
+                    operands=index)
+                print(f"[flat {sd}] grp={grp} bb={bb} lrt={lrt} mrt={mrt}: "
+                      f"{nq/s:.0f} QPS r={rec:.3f}", flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(f"[flat {sd}] grp={grp} bb={bb}: FAIL {e!r}"[:200],
+                      flush=True)
+
+
+def sweep_cagra(x, q, want):
+    from raft_tpu.neighbors import cagra
+
+    nq, k = q.shape[0], 10
+    t0 = time.time()
+    index = cagra.build(
+        cagra.IndexParams(graph_degree=32, intermediate_graph_degree=64), x)
+    jax.block_until_ready(index.graph)
+    print(f"[cagra] build {time.time()-t0:.0f}s", flush=True)
+    for width, iters, seeds, itopk in [
+        (2, 15, 64, 64),
+        (2, 12, 64, 64),
+        (4, 8, 64, 64),
+        (4, 6, 64, 64),
+        (2, 15, 64, 48),
+        (1, 24, 64, 64),
+    ]:
+        sp = cagra.SearchParams(itopk_size=itopk, search_width=width,
+                                max_iterations=iters, n_seeds=seeds)
+        try:
+            _, idx = cagra.search(sp, index, q, k)
+            rec = compute_recall(np.asarray(idx[:1000]), want)
+            s = scan_qps_time(
+                lambda qq, ix: cagra.search(sp, ix, qq, k), q,
+                operands=index)
+            print(f"[cagra] w={width} it={iters} seeds={seeds} "
+                  f"itopk={itopk}: {nq/s:.0f} QPS r={rec:.3f}", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"[cagra] w={width} it={iters}: FAIL {e!r}"[:200],
+                  flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    n, d, nq = 1_000_000, 128, 10_000
+    print(f"devices: {jax.devices()}", flush=True)
+    x = jax.device_put(sift_like(n, d, seed=1))
+    q = jax.device_put(sift_like(nq, d, seed=2))
+    jax.block_until_ready(x)
+    from raft_tpu.neighbors import brute_force
+
+    _, bf_idx = brute_force.knn(q[:1000], x, 10)
+    want = np.asarray(bf_idx)
+    print("oracle done", flush=True)
+    if which in ("flat", "both"):
+        sweep_flat(x, q, want)
+    if which in ("cagra", "both"):
+        sweep_cagra(x, q, want)
+
+
+if __name__ == "__main__":
+    main()

@@ -310,3 +310,22 @@ def test_bf16_storage_recall(dataset):
     r32 = eval_recall(np.asarray(idx32), want)
     rbf = eval_recall(np.asarray(idxbf), want)
     assert rbf > r32 - 0.02, (rbf, r32)
+
+
+def test_bf16_storage_serialize_roundtrip(dataset, tmp_path):
+    """bf16 storage survives the .npy container round trip (ml_dtypes
+    bfloat16 is not a stock numpy dtype — regression guard)."""
+    import jax.numpy as jnp
+
+    x, q = dataset
+    idx = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=16, storage_dtype="bf16",
+                             kmeans_n_iters=5), x)
+    p = str(tmp_path / "bf16.idx")
+    ivf_flat.save(p, idx)
+    loaded = ivf_flat.load(p)
+    assert loaded.storage.dtype == jnp.bfloat16
+    sp = ivf_flat.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, i1 = ivf_flat.search(sp, idx, q[:32], 5)
+    _, i2 = ivf_flat.search(sp, loaded, q[:32], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
