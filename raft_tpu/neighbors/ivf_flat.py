@@ -741,9 +741,18 @@ def reconstruct_dataset(index: Index) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def save(path: str, index: Index) -> None:
+    storage = index.storage
+    bf16 = storage.dtype == jnp.bfloat16
+    if bf16:
+        # the .npy container stays pure-numpy for interop (the reference
+        # serializer writes standard npy, mdspan_numpy_serializer.hpp);
+        # ml_dtypes bfloat16 round-trips as an opaque V2 dtype that
+        # numpy/jax reject on load, so store bf16 widened to f32 (exact)
+        # and narrow back on load via the recorded storage_dtype
+        storage = storage.astype(jnp.float32)
     arrays = {
         "centers": np.asarray(index.centers),
-        "storage": np.asarray(index.storage),
+        "storage": np.asarray(storage),
         "indices": np.asarray(index.indices),
         "list_sizes": np.asarray(index.list_sizes),
     }
@@ -757,6 +766,7 @@ def save(path: str, index: Index) -> None:
             "metric": int(index.metric),
             "metric_arg": index.metric_arg,
             "adaptive_centers": index.adaptive_centers,
+            "storage_dtype": "bf16" if bf16 else str(index.storage.dtype),
         },
         arrays,
     )
@@ -764,9 +774,12 @@ def save(path: str, index: Index) -> None:
 
 def load(path: str) -> Index:
     _, meta, arrays = read_index_file(path, "ivf_flat")
+    storage = jnp.asarray(arrays["storage"])
+    if meta.get("storage_dtype") == "bf16":
+        storage = storage.astype(jnp.bfloat16)
     return Index(
         centers=jnp.asarray(arrays["centers"]),
-        storage=jnp.asarray(arrays["storage"]),
+        storage=storage,
         indices=jnp.asarray(arrays["indices"]),
         list_sizes=jnp.asarray(arrays["list_sizes"]),
         metric=DistanceType(meta["metric"]),
