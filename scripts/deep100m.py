@@ -23,6 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
+
+if os.environ.get("DEEP100M_FORCE_CPU"):
+    # env-var JAX_PLATFORMS does not override the axon plugin; the
+    # config update does — CPU smoke only (--scan-impl pallas_interpret)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 
@@ -32,6 +38,9 @@ def main():
     n = 100_000_000
     if "--n" in sys.argv:
         n = int(sys.argv[sys.argv.index("--n") + 1])
+    scan_impl = "pallas"
+    if "--scan-impl" in sys.argv:   # CPU smoke: pass pallas_interpret
+        scan_impl = sys.argv[sys.argv.index("--scan-impl") + 1]
     d, nq, k = 96, 10_000, 10
     bs = 500_000
     n_lists = 32768 if n > 20_000_000 else 4096
@@ -128,7 +137,7 @@ def main():
     print(f"groundtruth: {res['groundtruth_s']} s", flush=True)
 
     # ---- search --------------------------------------------------------
-    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_impl="pallas")
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_impl=scan_impl)
     dist, idx = ivf_pq.search(sp, index, queries, k)
     np.asarray(idx[0, 0])
     recall = compute_recall(np.asarray(idx[:sub]), cur_i)
