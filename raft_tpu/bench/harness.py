@@ -145,8 +145,12 @@ def latency_percentiles(search_step, queries, batch: int,
     dispatches ONE ``batch``-sized query slice and blocks for its
     result — end-to-end serving latency including dispatch, which is
     what a latency SLO sees (unlike scan-chained throughput timing,
-    which amortizes dispatch away). Distinct slices defeat result
-    caching. Returns seconds: {p50, p95, mean, batch, n_calls}.
+    which amortizes dispatch away). Every call — warmup included —
+    dispatches a DISTINCT row rotation of the pool (strided slicing
+    degenerates to a repeated slice whenever (m - batch) divides batch,
+    m == batch included), defeating platform result caching for any
+    n_calls < m. Rotation is materialized before the clock starts.
+    Returns seconds: {p50, p95, mean, batch, n_calls}.
     """
     import jax
     import jax.numpy as jnp
@@ -158,13 +162,14 @@ def latency_percentiles(search_step, queries, batch: int,
         search_step if operands is None
         else functools.partial(search_step, ops=operands)
     )
-    # warmup/compile on an off-rotation slice
-    qs = jnp.roll(queries, 1, axis=0)[:batch]
+    # warmup/compile on rotation n_calls+1 — outside the timed rotation
+    # set {1..n_calls}, so no timed call can be served its cached result
+    qs = jnp.roll(queries, n_calls + 1, axis=0)[:batch]
     jax.block_until_ready(jitted(qs))
     times = []
     for c in range(n_calls):
-        q = jax.lax.dynamic_slice_in_dim(
-            queries, (c * batch) % max(m - batch, 1), batch)
+        q = jnp.roll(queries, c + 1, axis=0)[:batch]
+        q = jax.block_until_ready(q)   # keep rotation out of the timed call
         t0 = time.perf_counter()
         out = jitted(q)
         jax.block_until_ready(out)

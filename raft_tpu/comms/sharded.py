@@ -154,6 +154,7 @@ def sharded_ivf_pq_search(
     k: int,
     mesh: Mesh,
     axis_name: str = "shard",
+    refine_ratio: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Approximate KNN with the IVF-PQ index's *lists* sharded over the
     mesh — the DEEP-1B-scale model (the reference fits DEEP-1B in 24 GiB
@@ -166,6 +167,16 @@ def sharded_ivf_pq_search(
     PER_CLUSTER codebooks shard with their lists; PER_SUBSPACE codebooks
     and the rotation are replicated. Stored ids are global dataset row
     ids, so no rank offset is needed.
+
+    ``refine_ratio > 1`` adds a PER-SHARD exact re-rank from the residual
+    cache before the cross-shard merge (the reference's refine_ratio
+    pattern, bench/ann raft_ivf_pq_wrapper.h, with the dataset read
+    replaced by on-chip cache decode — detail/refine_host-inl.hpp's role
+    at a scale where the f32 dataset cannot be resident): each shard
+    searches ``k * refine_ratio`` candidates over slot-substituted
+    indices, decodes those slots from ITS OWN cache shard at f32, ranks
+    exactly, and only the refined top-k rides the all-gather. Requires
+    the index to carry a residual cache.
     """
     from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.ivf_flat import adaptive_query_group
@@ -199,13 +210,27 @@ def sharded_ivf_pq_search(
     per_cluster = int(index.codebook_kind) == ivf_pq.codebook_gen.PER_CLUSTER
     has_cache = index.recon_cache is not None
     lut = ivf_pq._norm_dtype_knob(search_params.lut_dtype)
-    if lut in ("auto", "i8") and not has_cache:
-        if lut == "i8":
-            raise ValueError("lut_dtype='i8' needs the decoded-residual cache")
+    if lut == "i8" and index.cache_kind not in ("i8", "i4"):
+        # mirror ivf_pq.search(): a pq4 code cache is not the i8 LUT path
+        raise ValueError("lut_dtype='i8' needs the decoded-residual cache")
+    if lut == "auto" and not has_cache:
         lut = "f32"
     internal = ivf_pq._norm_dtype_knob(search_params.internal_distance_dtype)
 
-    cache_i4 = has_cache and index.recon_cache.dtype == jnp.uint32
+    cache_i4 = has_cache and index.cache_kind == "i4"
+    refine_ratio = int(refine_ratio)
+    if refine_ratio > 1 and index.cache_kind not in ("i4", "i8"):
+        raise ValueError(
+            "refine_ratio > 1 needs the decoded-RESIDUAL cache (i8/i4; "
+            "build with cache_decoded=True within the cache budget) — a "
+            "pq4 code cache carries no fidelity beyond the scan itself"
+        )
+    k_search = k * refine_ratio
+    if k_search > n_probes * cap:
+        raise ValueError(
+            f"k*refine_ratio={k_search} exceeds the per-shard candidate "
+            f"pool (n_probes/shard={n_probes} x cap={cap})"
+        )
 
     def local(q, centers, centers_rot, rotation, pq_centers, codes,
               indices, list_sizes, rec_norms, *rest):
@@ -213,17 +238,28 @@ def sharded_ivf_pq_search(
         cache = rest.pop(0) if has_cache else None
         scales = rest.pop(0) if cache_i4 else None
         qnorms = rest.pop(0) if cache_i4 else None
+        search_ids = (ivf_pq._slot_indices(indices) if refine_ratio > 1
+                      else indices)
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
-                  indices, list_sizes, rec_norms, None, cache,
+                  search_ids, list_sizes, rec_norms, None, cache,
                   jnp.float32(index.recon_scale), scales, qnorms)
         d, i = ivf_pq._pq_search(
-            arrays, int(k), n_probes, metric, group, bucket_batch,
+            arrays, int(k_search), n_probes, metric, group, bucket_batch,
             int(index.codebook_kind), 0,
             str(search_params.compute_dtype),
             float(search_params.local_recall_target),
             float(search_params.merge_recall_target),
             lut, internal, int(index.pq_dim), int(index.pq_bits), "xla",
         )
+        if refine_ratio > 1:
+            # per-shard cache-decoded exact re-rank, then slots -> ids
+            d, s = ivf_pq._refine_slots(
+                q, i, int(k), metric, cache, scales, centers_rot,
+                rotation, jnp.float32(index.recon_scale),
+            )
+            i = jnp.where(
+                s >= 0, indices.reshape(-1)[jnp.maximum(s, 0)], -1
+            )
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
         return merge_topk(gd, gi, k, select_min)

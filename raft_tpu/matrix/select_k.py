@@ -55,9 +55,11 @@ def select_k(
     # n >> k — the regime the reference serves with multi-pass radix
     # select (select_radix.cuh:231,546). There the tournament network
     # (sorted 2K blocks + log rounds of keep-smallest-2K pair merges,
-    # each round HALVING the data — the compaction) wins; measured
-    # crossover on v5e at n=256k: k=1024 ~2-4x, k=4096 larger. Small k
-    # stays on the hardware top_k.
+    # each round HALVING the data — the compaction) wins. The k>256 /
+    # n>=8K thresholds below are asymptotic-cost projections pending an
+    # on-chip crossover measurement (scripts/select_crossover.py emits
+    # the table; see BASELINE.md for the artifact once captured). Small
+    # k stays on the hardware top_k.
     K = 1 << (int(k) - 1).bit_length()
     if (k > 256 and n >= 8 * K
             and jnp.issubdtype(in_val.dtype, jnp.floating)):
@@ -100,7 +102,13 @@ def _tournament_topk(in_val, k: int, select_min: bool):
     reversed partner + a log(2K)-substage bitonic merge) and HALVES the
     live data — the survivors-only shrink the radix compaction buys,
     with no gathers anywhere. Total compare-exchange work is
-    ~n(log^2(2K)/2 + 2 log(2K)) vs the full sort's n log^2(n)/2."""
+    ~n(log^2(2K)/2 + 2 log(2K)) vs the full sort's n log^2(n)/2.
+
+    Output contract matches the top_k arm: values are returned in the
+    input dtype. Rows with fewer than k finite entries fill the tail
+    with +/-inf values carrying id -1 (the pad id; lax.top_k would
+    return an arbitrary real index there — -1 is the honest answer and
+    is what the bitset/pad conventions elsewhere in the package use)."""
     from raft_tpu.matrix.bitonic import merge_bitonic, sort_by_key
 
     m, n = in_val.shape
@@ -140,7 +148,7 @@ def _tournament_topk(in_val, k: int, select_min: bool):
     idxs = ib[:, 0, :k]
     if not select_min:
         vals = -vals
-    return vals, idxs
+    return vals.astype(in_val.dtype), idxs
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))

@@ -648,7 +648,12 @@ def _side_accumulate(res_d, res_i, dvals, ids, kr: int, window: int = 8):
     buffer and collapse duplicate ids (a node is scored once per parent
     that lists it; copies carry bit-identical distances, so they sort
     adjacent — without this collapse the top-kr fills with copies of a
-    handful of near nodes and recall craters)."""
+    handful of near nodes and recall craters). ``window`` must cover the
+    worst adjacent run: up to ``search_width`` copies of one hub node per
+    merge (one per parent listing it), so callers merging expanded
+    candidates pass ``window=max(8, width)``; survivors past the window
+    only waste side-buffer slots (the final exact dedup keeps results
+    correct)."""
     rd, ri = merge_topk(
         jnp.concatenate([res_d, dvals], axis=1),
         jnp.concatenate([res_i, ids], axis=1),
@@ -762,7 +767,8 @@ def _beam_search(
 
     def side_merge(res_d, res_i, ids, dvals):
         vd = dvals + pen[ids]                  # filtered -> +inf
-        return _side_accumulate(res_d, res_i, vd, ids, kr)
+        return _side_accumulate(res_d, res_i, vd, ids, kr,
+                                window=max(8, width))
 
     if n_seeds <= 0:
         n_seeds = max(2 * itopk, 128)
@@ -932,7 +938,8 @@ def _beam_search_pallas(
             cid = ci.T                                   # [m, C]
             vd = cd.T + pen[jnp.maximum(cid, 0)]         # filtered -> inf
             vd = jnp.where(cid < 0, jnp.inf, vd)
-            rd_, ri_ = _side_accumulate(rd_, ri_, vd, cid, kr)
+            rd_, ri_ = _side_accumulate(rd_, ri_, vd, cid, kr,
+                                        window=max(8, width))
             return bd, bi, be, par, rd_, ri_
         return out
 

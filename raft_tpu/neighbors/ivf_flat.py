@@ -268,8 +268,16 @@ def build(params: IndexParams, dataset, row_ids=None) -> Index:
     if st_dtype is None:
         raise ValueError(
             f"storage_dtype must be f32|bf16, got {params.storage_dtype!r}")
-    if dataset.dtype == jnp.float32 and st_dtype == jnp.float32:
-        st_dtype = dataset.dtype
+    if st_dtype == jnp.bfloat16 and dataset.dtype not in (jnp.float32,
+                                                          jnp.bfloat16):
+        # The halved-bandwidth path narrows f32 storage; for any other
+        # dataset dtype (f16, int8, ...) narrowing semantics are
+        # undefined-to-lossy, and silently keeping dataset.dtype (the
+        # pre-r5 behavior) gave the caller no signal (ADVICE r4).
+        raise ValueError(
+            f"storage_dtype='bf16' requires a float32 dataset, got "
+            f"{dataset.dtype}; pass the dataset as f32 or leave "
+            "storage_dtype='f32' to store in the dataset dtype")
     index = Index(
         centers=centers,
         storage=jnp.zeros((n_lists, 0, d),

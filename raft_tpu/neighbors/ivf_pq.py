@@ -217,6 +217,19 @@ class Index:
     def size(self) -> int:
         return int(self.list_sizes.sum())
 
+    @property
+    def cache_kind(self) -> str:
+        """Which fused-scan operand the index carries: "i8" (int8 decoded
+        residuals), "i4" (packed int4 raw residuals + per-list scales),
+        "pq4" (transposed packed 4-bit codes — exact one-hot code scan),
+        or "none". The u32 kinds are discriminated by cache_scales: the
+        i4 residual cache cannot exist without its per-list scales."""
+        if self.recon_cache is None:
+            return "none"
+        if self.recon_cache.dtype == jnp.uint32:
+            return "i4" if self.cache_scales is not None else "pq4"
+        return "i8"
+
 
 jax.tree_util.register_dataclass(
     Index,
@@ -566,15 +579,67 @@ def build_streamed(
         ),
     )
     ts_scales = None
+    # The padded i8 footprint is C*cap*rot with cap unknown until pass 1,
+    # but it is bounded below by n*rot (C*cap >= n) and, when the caller
+    # bounds list capacity, above by C*aligned_cap(cap_rows)*rot — enough
+    # to decide BEFORE the expensive labeling pass whether the i4 scales
+    # must be precomputed (at 100M scale a post-pass-1 "scales missing"
+    # failure throws away hours of work; ADVICE r4).
+    _cap_bound = (
+        index.n_lists * _aligned_cap(int(cap_rows)) * index.rot_dim
+        if cap_rows is not None else None
+    )
+    _i8_may_miss = (
+        n * index.rot_dim > _CACHE_BUDGET // 2      # padding factor <= 2x
+        or (_cap_bound is not None and _cap_bound > _CACHE_BUDGET)
+        # unbounded cap + fatal-on-miss: any padding blowup must not
+        # strike after pass 1, so be conservative and pay the scale pass
+        or (cap_rows is None and not keep_codes
+            and n * index.rot_dim > _CACHE_BUDGET // 8)
+    )
+    if str(params.cache_dtype) == "pq4":
+        # the pq4 transposed-code cache has no streamed scatter; say so
+        # up front instead of silently building without a cache
+        raise ValueError(
+            "cache_dtype='pq4' is not supported by build_streamed (the "
+            "transposed-code cache is attached by the batch build); use "
+            "cache_dtype='auto'/'i8'/'i4' here"
+        )
     i4_possible = (
         params.cache_decoded and index.rot_dim % 8 == 0
         and (str(params.cache_dtype) == "i4"
-             or (str(params.cache_dtype) == "auto"
-                 # auto only reaches i4 when i8 misses budget: C*cap >= n,
-                 # so n*rot > budget/2 covers padding factors up to 2x
-                 # without paying the scale passes on every small build
-                 and n * index.rot_dim > _CACHE_BUDGET // 2))
+             or (str(params.cache_dtype) == "auto" and _i8_may_miss))
     )
+    if not keep_codes:
+        # keep_codes=False REQUIRES some cache; decide from the pre-pass-1
+        # bounds (floor n*rot since C*cap >= n; cap_rows gives the padded
+        # ceiling) whether any requested kind can possibly fit, and fail
+        # now rather than after the hours-long labeling pass (ADVICE r4).
+        # A cap_rows bound under budget legitimately truncates rows until
+        # the cache fits — those builds proceed.
+        cd = str(params.cache_dtype)
+        i8_can = cd in ("auto", "i8") and (
+            n * index.rot_dim <= _CACHE_BUDGET
+            or (_cap_bound is not None and _cap_bound <= _CACHE_BUDGET)
+        )
+        i4_can = (
+            cd in ("auto", "i4")
+            and params.cache_decoded and index.rot_dim % 8 == 0
+            and (n * index.rot_dim // 2 <= _CACHE_BUDGET
+                 or (_cap_bound is not None
+                     and _cap_bound // 2 <= _CACHE_BUDGET))
+        )
+        if not (i8_can or i4_can):
+            raise ValueError(
+                "keep_codes=False requires a residual cache but no "
+                f"cache_dtype={cd!r} kind can fit _CACHE_BUDGET at "
+                f"{n} rows x {index.rot_dim} rot dims (i4 additionally "
+                "needs cache_decoded=True and rot_dim % 8 == 0)"
+            )
+        if i4_can and not i8_can:
+            # only i4 can fit: make sure its scales actually get computed
+            # (the auto heuristic above may not have triggered)
+            i4_possible = True
     if i4_possible:
         # per-list int4 scales need the trainset — computed before it is
         # freed, used only if the budget later picks the i4 cache
@@ -1208,18 +1273,34 @@ def _recon_cache_scan(codes_packed, pq_centers, codebook_kind: int,
 
 
 def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
-                    cap: int, rot: int) -> Optional[str]:
-    """The budget/dtype ladder shared by batch and streamed builds."""
+                    cap: int, rot: int, pq_bits: int = 8,
+                    pq_dim: int = 0, per_subspace: bool = True,
+                    ) -> Optional[str]:
+    """The budget/dtype ladder shared by batch and streamed builds.
+
+    "auto" is perf-first: i8 (1 matmul pass, 1 B/component) when it fits,
+    else packed i4 (1 pass, 0.5 B/component, slightly lossy). "pq4" — the
+    transposed packed-CODE scan at pq_bits=4 (exact PQ distances, 0.5
+    B/code, 16 MXU passes; see ops/ivf_scan one-hot contraction) — is
+    explicit opt-in: at equal bytes the i4 residual cache is ~16x cheaper
+    on the MXU, but pq4 is exact and the only fast path when pq_dim < dim
+    pushes compression below 0.5 B/dim (the reference's high-compression
+    regime, ivf_pq_compute_similarity-inl.cuh LUT scoring)."""
     if not cache_decoded or cap == 0:
         return None
     i8_ok = C * cap * rot <= _CACHE_BUDGET
     i4_ok = rot % 8 == 0 and C * cap * rot // 2 <= _CACHE_BUDGET
+    pq4_ok = (pq_bits == 4 and per_subspace and pq_dim > 0
+              and pq_dim % 8 == 0
+              and C * cap * pq_dim // 2 <= _CACHE_BUDGET)
     if cache_dtype == "auto":
         return "i8" if i8_ok else ("i4" if i4_ok else None)
     if cache_dtype == "i8":
         return "i8" if i8_ok else None
     if cache_dtype == "i4":
         return "i4" if i4_ok else None
+    if cache_dtype == "pq4":
+        return "pq4" if pq4_ok else None
     raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
 
 
@@ -1227,7 +1308,9 @@ def _resolve_cache_kind(index: "Index") -> Optional[str]:
     """Which cache precision to build for this index (None = no cache)."""
     return _cache_kind_for(
         bool(index.cache_decoded), str(index.cache_dtype), index.n_lists,
-        index.indices.shape[1], index.rot_dim,
+        index.indices.shape[1], index.rot_dim, int(index.pq_bits),
+        int(index.pq_dim),
+        int(index.codebook_kind) == codebook_gen.PER_SUBSPACE,
     )
 
 
@@ -1255,6 +1338,14 @@ def _attach_cache(index: "Index") -> "Index":
         return dataclasses.replace(
             index, recon_cache=cache, recon_scale=float(scale),
             cache_scales=None, cache_qnorms=None,
+        )
+    if kind == "pq4":
+        # the "cache" IS the packed codes, transposed to the kernel's
+        # dense [C, nw, cap] layout (discriminated from the i4 residual
+        # cache by cache_scales is None — see Index.cache_kind)
+        return dataclasses.replace(
+            index, recon_cache=jnp.swapaxes(index.codes, 1, 2),
+            recon_scale=1.0, cache_scales=None, cache_qnorms=None,
         )
     cache_t, scales, qnorms = _recon_cache_scan_i4(
         index.codes, index.indices, index.pq_centers, index.codebook_kind,
@@ -1296,8 +1387,11 @@ def _pq_search(
     (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
      list_sizes, rec_norms, filter_bits, recon_cache, recon_scale,
      cache_scales, cache_qnorms) = arrays
-    cache_i4 = (recon_cache is not None
-                and recon_cache.dtype == jnp.uint32)
+    cache_kind = ("none" if recon_cache is None
+                  else "i8" if recon_cache.dtype != jnp.uint32
+                  else "i4" if cache_scales is not None
+                  else "pq4")
+    cache_i4 = cache_kind == "i4"
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
     C, cap = indices.shape   # codes may be FLAT [C*cap, nw] (streamed
@@ -1346,8 +1440,11 @@ def _pq_search(
         # dequant scaling folds into the query side so the kernel scores
         # raw cached integers: scalar recon_scale for int8, the per-LIST
         # per-component scale rows for packed int4 (qv is per-bucket and a
-        # bucket is one list — free per-list granularity)
+        # bucket is one list — free per-list granularity). The pq4 code
+        # scan is scale-free (the codebook lives in the kernel's LUT
+        # weights), so qv stays the raw residual.
         qscale = (cache_scales[bucket_list][:, None, :] if cache_i4
+                  else 1.0 if cache_kind == "pq4"
                   else recon_scale)
         qv = (q_res * qscale).astype(mm)                     # [nb, G, rot]
         ip = metric == DistanceType.InnerProduct
@@ -1363,11 +1460,22 @@ def _pq_search(
             keep = filter_keep(filter_bits, filter_nbits, indices).astype(
                 jnp.int32
             )
+        lut_w = None
+        if cache_kind == "pq4":
+            # block-diagonal codebook weights W[v][s*pl + l, s] =
+            # pq_centers[s, v, l]: one [rot, p] matmul per code value
+            # turns the per-subspace LUT build into MXU work (PER_SUBSPACE
+            # only — a per-list codebook would need C of these)
+            p_, K_, pl_ = pq_centers.shape
+            eye = jnp.eye(p_, dtype=jnp.float32)
+            lut_w = (pq_centers.transpose(1, 0, 2)[:, :, :, None]
+                     * eye[None, :, None, :]).reshape(K_, p_ * pl_, p_)
         norms = rec_norms if cache_qnorms is None else cache_qnorms
         out_d, cand_i = ivf_scan.fused_list_scan_topk(
             recon_cache, indices, list_sizes, bucket_list, qv, qaux,
             None if ip else norms,       # IP kernel never reads norms
             keep,
+            lut_weights=lut_w,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
             interpret=scan_impl == "pallas_interpret",
             packed_i4=cache_i4,
@@ -1397,7 +1505,10 @@ def _pq_search(
         bl, bq = inp  # [bb], [bb, group]
         ids = indices[bl]
         sizes = list_sizes[bl]
-        use_cache_blk = recon_cache is not None and lut_dtype in ("auto", "i8")
+        # pq4's transposed-code "cache" is not a decoded-residual block;
+        # the XLA body scores it from the packed codes like any code index
+        use_cache_blk = (cache_kind in ("i8", "i4")
+                         and lut_dtype in ("auto", "i8"))
         rn = (cache_qnorms if use_cache_blk and cache_qnorms is not None
               else rec_norms)[bl]
         if use_cache_blk:
@@ -1538,7 +1649,7 @@ def search(
     requested = str(search_params.scan_impl)
     lut = _norm_dtype_knob(search_params.lut_dtype)
     use_cache = index.recon_cache is not None and lut in ("auto", "i8")
-    if lut == "i8" and index.recon_cache is None:
+    if lut == "i8" and index.cache_kind not in ("i8", "i4"):
         raise ValueError(
             "lut_dtype='i8' needs the decoded-residual cache; build with "
             "cache_decoded=True (and within _CACHE_BUDGET)"
@@ -1585,6 +1696,115 @@ def search(
         int(index.pq_bits),
         impl,
     )
+
+
+def _decode_slots(slots, recon_cache, cache_scales, centers_rot,
+                  recon_scale):
+    """Decode flattened list slots (``list * cap + slot``) [m, c] from the
+    residual cache to [m, c, rot_dim] f32 vectors in rotated space.
+
+    The per-candidate fidelity source for cache-resident refine: packed
+    int4 caches hold raw rotated residuals (per-list scales), int8 caches
+    hold decoded-PQ residuals (scalar scale); either way the vector is
+    ``centers_rot[list] + residual``."""
+    if recon_cache.dtype == jnp.uint32:                  # packed int4
+        C, nw4, cap = recon_cache.shape
+        lst = slots // cap
+        sl = slots % cap
+        words = recon_cache[lst, :, sl]                  # [m, c, nw4]
+        res = unpack_i4(words) * cache_scales[lst]
+    else:                                                # int8
+        C, cap, _rot = recon_cache.shape
+        lst = slots // cap
+        sl = slots % cap
+        res = recon_cache[lst, sl].astype(jnp.float32) * recon_scale
+    return centers_rot[lst] + res
+
+
+def _refine_slots(queries, slots, k: int, metric_val: int,
+                  recon_cache, cache_scales, centers_rot, rotation,
+                  recon_scale):
+    """Exact re-rank of slot candidates against cache-decoded vectors —
+    the refine source that fits the DEEP-1B per-chip budget (the
+    reference refines from the raw dataset, detail/refine_device.cuh /
+    detail/refine_host-inl.hpp; at 1B scale the f32 dataset is 384 GB
+    and never sharded into HBM, but the int4 cache IS — so refine
+    decodes the <= k*ratio candidates per query from it on-chip).
+
+    Distances are computed at f32 in rotated space (the rotation is
+    orthonormal, so L2/IP are preserved); slots < 0 are invalid.
+    Returns (dist [m, k], slots [m, k])."""
+    metric = DistanceType(metric_val)
+    q32 = jnp.asarray(queries).astype(jnp.float32)
+    qrot = dist_dot(q32, rotation.T)                     # [m, rot]
+    valid = slots >= 0
+    safe = jnp.maximum(slots, 0)
+    vec = _decode_slots(safe, recon_cache, cache_scales, centers_rot,
+                        recon_scale)                     # [m, c, rot] f32
+    if metric == DistanceType.InnerProduct:
+        # elementwise mult-sum: XLA fuses it into the gather consumer
+        # (the "md,mcd" einsum form measured 4x slower on v5e)
+        d = jnp.sum(vec * qrot[:, None, :], axis=-1, dtype=jnp.float32)
+    else:
+        diff = qrot[:, None, :] - vec
+        d = jnp.sum(diff * diff, axis=-1, dtype=jnp.float32)
+        if metric == DistanceType.L2SqrtExpanded:
+            d = jnp.sqrt(d)
+    sentinel = sentinel_for(metric, jnp.float32)
+    d = jnp.where(valid, d, sentinel)
+    out_d, out_s = merge_topk(d, slots.astype(jnp.int32), k,
+                              is_min_close(metric))
+    out_s = jnp.where(out_d == sentinel, -1, out_s)
+    return out_d, out_s
+
+
+def _slot_indices(indices):
+    """Replace stored global ids [C, cap] with flattened slot positions,
+    keeping -1 at padding slots, so a search over the substituted index
+    emits WHERE each candidate lives instead of what it is — the id is
+    recovered afterwards by one flat gather (``indices.reshape(-1)[slot]``)
+    and no O(n_rows) inverse map ever exists."""
+    C, cap = indices.shape
+    slot_ids = jnp.arange(C * cap, dtype=jnp.int32).reshape(C, cap)
+    return jnp.where(indices >= 0, slot_ids, -1)
+
+
+def search_refined(
+    search_params: SearchParams,
+    index: Index,
+    queries,
+    k: int,
+    refine_ratio: int = 2,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search + exact re-rank from the residual cache, no raw dataset.
+
+    The reference's ``refine_ratio`` pattern (bench/ann
+    raft_ivf_pq_wrapper.h: search k*ratio, then exact refine) with the
+    dataset read replaced by on-chip cache decode: the inner search runs
+    over slot-substituted indices, the top ``k * refine_ratio`` slots are
+    decoded from the int4/int8 residual cache at f32 and re-ranked
+    exactly, then slots resolve to global ids. This is the recall lever
+    for cache-only (keep_codes=False) and billion-scale sharded indexes
+    where the f32 dataset can never be resident.
+    """
+    if index.cache_kind not in ("i8", "i4"):
+        raise ValueError(
+            "search_refined needs the decoded-RESIDUAL cache (i8/i4; "
+            "build with cache_decoded=True within _CACHE_BUDGET) — a pq4 "
+            "code cache adds no fidelity over its own exact scan; for "
+            "raw-dataset refine use neighbors.refine"
+        )
+    if refine_ratio < 1:
+        raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
+    slot_index = dataclasses.replace(index, indices=_slot_indices(index.indices))
+    _, slots = search(search_params, slot_index, queries, int(k * refine_ratio))
+    d, s = _refine_slots(
+        jnp.asarray(queries), slots, int(k), int(index.metric),
+        index.recon_cache, index.cache_scales, index.centers_rot,
+        index.rotation, jnp.float32(index.recon_scale),
+    )
+    ids = jnp.where(s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)], -1)
+    return d, ids
 
 
 def _norm_dtype_knob(v) -> str:
@@ -1636,15 +1856,15 @@ def save(path: str, index: Index) -> None:
     if cache_only and index.recon_cache is None:
         raise ValueError("cache-only index has no recon_cache to serialize")
     cache_kind = "none"
-    has_i4 = (index.recon_cache is not None
-              and index.recon_cache.dtype == jnp.uint32)
+    has_i4 = index.cache_kind == "i4"
     if cache_only or has_i4:
         # serialize the cache when it cannot be equivalently rebuilt from
         # codes: cache-only indexes have no codes at all (round 3 silently
         # wrote empty codes and rebuilt a wrong cache on load), and i4
         # caches from streamed builds quantize RAW residuals — a rebuild
         # from decoded codes loses that fidelity. The i8-with-codes cache
-        # rebuilds exactly and is not serialized.
+        # and the pq4 transposed-code cache rebuild exactly and are not
+        # serialized.
         arrays["recon_cache"] = np.asarray(index.recon_cache)
         if has_i4:
             cache_kind = "i4"

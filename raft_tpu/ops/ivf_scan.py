@@ -143,7 +143,7 @@ def _extract_topk_binned_deep(dist, ids_row, k: int, cap: int,
 def _scan_kernel(
     bl_ref, ls_ref, *refs,
     k: int, metric_kind: int, approx: bool, has_norms: bool, has_filter: bool,
-    packed_i4: bool = False,
+    packed_i4: bool = False, packed_pq4: bool = False,
 ):
     refs = list(refs)
     storage_ref = refs.pop(0)
@@ -151,8 +151,9 @@ def _scan_kernel(
     norms_ref = refs.pop(0) if has_norms else None
     keep_ref = refs.pop(0) if has_filter else None
     qv_ref = refs.pop(0)
+    w_ref = refs.pop(0) if packed_pq4 else None
     qaux_ref = refs.pop(0) if metric_kind != IP else None
-    if packed_i4:
+    if packed_i4 or packed_pq4:
         outd_ref, outi_ref, recon_ref = refs
     else:
         outd_ref, outi_ref = refs
@@ -160,7 +161,42 @@ def _scan_kernel(
     i = pl.program_id(0)
     size = ls_ref[bl_ref[i]]
     qv = qv_ref[0]                                      # [G, d] mm dtype
-    if packed_i4:
+    if packed_pq4:
+        # packed 4-bit PQ CODES [nw, cap] u32 (8 codes/word, transposed
+        # like the i4 cache) scored as a 16-pass one-hot MXU contraction —
+        # the TPU answer to the reference's in-kernel shm-LUT code scoring
+        # (ivf_pq_compute_similarity-inl.cuh:164-185): TPUs have no
+        # per-lane LUT gather, but "which codes equal v" is a VPU compare
+        # and "sum LUT[s, v] over matching (s, x)" is a matmul. Pass v:
+        #   lut_v[G, s] = qv[G, rot] @ W[v][rot, s]   (block-diag codebook)
+        #   dots      += lut_v @ (codes == v)         ([G,p] x [p,cap])
+        # Exact PQ distances (no quantization beyond the codes), at 2x
+        # fewer HBM bytes than the i8 cache and 16x its MXU work — the
+        # high-compression regime trade (see tuning.md ladder).
+        blk_w = storage_ref[0].astype(jnp.int32)        # [nw, cap]
+        nw = blk_w.shape[0]
+        p = w_ref.shape[2]
+        for wi in range(nw):
+            word = blk_w[wi, :]                          # [cap] i32
+            for j in range(8):
+                recon_ref[wi * 8 + j, :] = (word >> (4 * j)) & 0xF
+        codes_blk = recon_ref[0:p, :]                    # [p, cap] i32
+        G = qv.shape[0]
+        cap = codes_blk.shape[1]
+        dots = jnp.zeros((G, cap), jnp.float32)
+        for v in range(16):
+            lut_v = jax.lax.dot_general(
+                qv, w_ref[v],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                            # [G, p]
+            mask_v = (codes_blk == v).astype(qv.dtype)   # [p, cap]
+            dots = dots + jax.lax.dot_general(
+                lut_v.astype(qv.dtype), mask_v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    elif packed_i4:
         # packed int4 block [nw, cap] uint32 (transposed: components on
         # sublanes, rows on lanes — the Mosaic-dense layout for narrow
         # per-row payloads). Unpack 8 signed nibbles per word with the
@@ -228,6 +264,7 @@ def fused_list_scan_topk(
     qaux=None,      # [nb, G] f32: ||q||^2 (L2) or ||q|| (cosine); None for IP
     norms=None,     # [C, cap] f32: ||x||^2; None for IP
     keep=None,      # [C, cap] int32 filter keep-mask; None = no filter
+    lut_weights=None,  # [16, rot, p] block-diag codebook (pq4 code scan)
     *,
     k: int,
     metric_kind: int,
@@ -252,10 +289,29 @@ def fused_list_scan_topk(
     a shift/mask VPU prologue feeding one MXU matmul). Per-component
     dequant scales must be pre-folded into ``qv`` (and ``norms`` hold the
     dequantized-vector norms), so the kernel itself is scale-free.
+
+    ``lut_weights`` (mutually exclusive with ``packed_i4``): storage holds
+    packed 4-bit PQ CODES [C, p//8, cap] u32 and scoring runs the 16-pass
+    one-hot contraction against the block-diagonal codebook weights
+    W[v][s*pq_len + l, s] = pq_centers[s, v, l]; ``qv`` is the raw rotated
+    query (residual) group [nb, G, rot] and ``norms`` the exact
+    reconstruction norms. Distances equal the decode-then-matmul path's
+    exactly (same codes, same codebook).
     """
+    packed_pq4 = lut_weights is not None
+    if packed_pq4 and packed_i4:
+        raise ValueError("packed_i4 and lut_weights are mutually exclusive")
     if packed_i4:
         C, nw_c, cap = storage.shape
         d = nw_c * 8
+    elif packed_pq4:
+        C, nw_c, cap = storage.shape
+        d = lut_weights.shape[1]                       # rot_dim
+        p_sub = lut_weights.shape[2]
+        if p_sub > nw_c * 8:
+            raise ValueError(
+                f"lut_weights pq_dim {p_sub} exceeds packed capacity "
+                f"{nw_c * 8}")
     else:
         C, cap, d = storage.shape
     nb, G, _ = qv.shape
@@ -268,7 +324,7 @@ def fused_list_scan_topk(
     inputs = [storage, indices.reshape(C, 1, cap)]
     in_specs = [
         pl.BlockSpec(
-            (1, nw_c, cap) if packed_i4 else (1, cap, d),
+            (1, nw_c, cap) if (packed_i4 or packed_pq4) else (1, cap, d),
             lambda i, bl, ls: (bl[i], 0, 0),
         ),
         pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0)),
@@ -285,6 +341,12 @@ def fused_list_scan_topk(
         )
     inputs.append(qv)
     in_specs.append(pl.BlockSpec((1, G, d), lambda i, bl, ls: (i, 0, 0)))
+    if packed_pq4:
+        # full codebook weights resident per step (small: 16*rot*p)
+        inputs.append(lut_weights.astype(qv.dtype))
+        in_specs.append(
+            pl.BlockSpec(lut_weights.shape, lambda i, bl, ls: (0, 0, 0))
+        )
     if metric_kind != IP:
         inputs.append(qaux.reshape(nb, 1, G))
         in_specs.append(
@@ -295,6 +357,7 @@ def fused_list_scan_topk(
         _scan_kernel,
         k=k, metric_kind=metric_kind, approx=approx,
         has_norms=has_norms, has_filter=has_filter, packed_i4=packed_i4,
+        packed_pq4=packed_pq4,
     )
     out_d, out_i = pl.pallas_call(
         kernel,
@@ -307,7 +370,9 @@ def fused_list_scan_topk(
                 pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
             ],
             scratch_shapes=(
-                [pltpu.VMEM((d, cap), qv.dtype)] if packed_i4 else []
+                [pltpu.VMEM((d, cap), qv.dtype)] if packed_i4
+                else [pltpu.VMEM((nw_c * 8, cap), jnp.int32)] if packed_pq4
+                else []
             ),
         ),
         out_shape=[

@@ -120,6 +120,41 @@ def test_search_with_refine(dataset):
     assert eval_recall(np.asarray(idx), want) > 0.95
 
 
+@pytest.mark.parametrize("cache_dtype", ["auto", "i4"])
+def test_search_refined_from_cache(dataset, cache_dtype):
+    """search_refined re-ranks candidates decoded from the residual cache
+    (i8 via auto, packed i4) WITHOUT touching the raw dataset — the
+    refine source for cache-only / billion-scale sharded indexes
+    (reference refine_ratio pattern, bench/ann raft_ivf_pq_wrapper.h +
+    detail/refine_host-inl.hpp)."""
+    x, q = dataset
+    k = 10
+    index = _build(x, cache_dtype=cache_dtype)
+    assert index.recon_cache is not None
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+    _, base_idx = ivf_pq.search(sp, index, q, k)
+    d, idx = ivf_pq.search_refined(sp, index, q, k, refine_ratio=4)
+    _, want = naive_knn(q, x, k)
+    r_base = eval_recall(np.asarray(base_idx), want)
+    r_ref = eval_recall(np.asarray(idx), want)
+    # the wider candidate pool + exact f32 re-rank never loses recall
+    assert r_ref >= r_base - 0.02
+    assert r_ref > 0.65
+    # ids are real dataset rows (slot substitution resolved), dists sorted
+    ii = np.asarray(idx)
+    assert ((ii >= 0) & (ii < x.shape[0])).all()
+    dd = np.asarray(d)
+    assert (np.diff(dd, axis=1) >= -1e-6).all()
+
+
+def test_search_refined_needs_cache(dataset):
+    x, q = dataset
+    index = _build(x, cache_decoded=False)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    with pytest.raises(ValueError, match="cache"):
+        ivf_pq.search_refined(sp, index, q, 10)
+
+
 def test_per_cluster_codebooks(dataset):
     x, q = dataset
     k = 10
@@ -254,6 +289,58 @@ def test_pallas_cached_scan_interpret_filter(dataset):
     _, idx = ivf_pq.search(sp, index, q[:50], k, prefilter=bits)
     idx = np.asarray(idx)
     assert ((idx == -1) | (idx < n // 4)).all()
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product"])
+def test_pq4_code_scan_interpret_matches_xla(dataset, metric):
+    """cache_dtype='pq4': the fused one-hot packed-CODE scan (16-pass MXU
+    contraction, reference ivf_pq_compute_similarity-inl.cuh:164-185 LUT
+    analog) computes EXACTLY the decode-then-matmul distances — same
+    codes, same codebook, no extra quantization — so interpret-mode
+    results must match the XLA scan to float tolerance."""
+    x, q = dataset
+    k = 10
+    index = _build(x, pq_dim=16, pq_bits=4, metric=metric,
+                   cache_dtype="pq4")
+    assert index.cache_kind == "pq4"
+    # transposed packed codes: [C, nw, cap] vs codes [C, cap, nw]
+    assert index.recon_cache.shape == (
+        index.codes.shape[0], index.codes.shape[2], index.codes.shape[1])
+    kw = dict(n_probes=8, query_group=64, bucket_batch=4,
+              compute_dtype="f32", local_recall_target=1.0)
+    d_x, i_x = ivf_pq.search(
+        ivf_pq.SearchParams(scan_impl="xla", lut_dtype="f32", **kw),
+        index, q[:50], k)
+    d_p, i_p = ivf_pq.search(
+        ivf_pq.SearchParams(scan_impl="pallas_interpret", **kw),
+        index, q[:50], k)
+    _, want = naive_knn(q[:50], x, k, metric=metric)
+    rx = eval_recall(np.asarray(i_x), want)
+    rp = eval_recall(np.asarray(i_p), want)
+    assert rp > rx - 0.02, (rp, rx)
+    same = np.asarray(i_x) == np.asarray(i_p)
+    assert same.mean() > 0.9          # only exact PQ ties may reorder
+    np.testing.assert_allclose(np.asarray(d_x)[same], np.asarray(d_p)[same],
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_pq4_cache_roundtrip_and_guards(dataset, tmp_path):
+    """pq4 cache rebuilds from codes on load (never serialized); residual
+    refine correctly refuses the code cache."""
+    x, q = dataset
+    index = _build(x, pq_dim=16, pq_bits=4, cache_dtype="pq4")
+    p = str(tmp_path / "pq4.idx")
+    ivf_pq.save(p, index)
+    loaded = ivf_pq.load(p)
+    assert loaded.cache_kind == "pq4"
+    np.testing.assert_array_equal(
+        np.asarray(loaded.recon_cache), np.asarray(index.recon_cache))
+    sp = ivf_pq.SearchParams(n_probes=8, query_group=64, bucket_batch=4)
+    _, i0 = ivf_pq.search(sp, index, q[:30], 10)
+    _, i1 = ivf_pq.search(sp, loaded, q[:30], 10)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    with pytest.raises(ValueError, match="RESIDUAL"):
+        ivf_pq.search_refined(sp, index, q[:10], 10)
 
 
 def test_cache_disabled_matches(dataset):
