@@ -217,7 +217,6 @@ def sharded_ivf_pq_search(
         lut = "f32"
     internal = ivf_pq._norm_dtype_knob(search_params.internal_distance_dtype)
 
-    cache_i4 = has_cache and index.cache_kind == "i4"
     refine_ratio = int(refine_ratio)
     if refine_ratio > 1 and index.cache_kind not in ("i4", "i8"):
         raise ValueError(
@@ -232,12 +231,14 @@ def sharded_ivf_pq_search(
             f"pool (n_probes/shard={n_probes} x cap={cap})"
         )
 
+    has_scales = has_cache and index.cache_scales is not None
+
     def local(q, centers, centers_rot, rotation, pq_centers, codes,
               indices, list_sizes, rec_norms, *rest):
         rest = list(rest)
         cache = rest.pop(0) if has_cache else None
-        scales = rest.pop(0) if cache_i4 else None
-        qnorms = rest.pop(0) if cache_i4 else None
+        scales = rest.pop(0) if has_scales else None
+        qnorms = rest.pop(0) if has_scales else None
         search_ids = (ivf_pq._slot_indices(indices) if refine_ratio > 1
                       else indices)
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
@@ -281,7 +282,7 @@ def sharded_ivf_pq_search(
     if has_cache:
         args.append(index.recon_cache)
         in_specs.append(P(axis_name, None, None))
-    if cache_i4:
+    if has_scales:
         args.append(index.cache_scales)        # [C, rot] per-list scales
         in_specs.append(P(axis_name, None))
         qn = (index.cache_qnorms if index.cache_qnorms is not None

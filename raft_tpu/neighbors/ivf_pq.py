@@ -1226,23 +1226,27 @@ def _recon_cache_scan_i4(codes_packed, indices, pq_centers,
 _CLIP_CANDIDATES = (0.6, 0.7, 0.8, 0.9, 1.0)
 
 
-def _pick_clip_scale(vals, base_scale, ok):
-    """Per-list MSE-optimal clip multiplier: quantize ``vals`` [n, rot]
-    (validity mask ``ok`` [n, 1]) at each candidate scale m * base_scale
-    and keep the m with least total squared error (measured: m=0.7 lifts
-    DEEP-like int4 recall 0.882 -> 0.917 vs full-range m=1.0)."""
-    best_err, best_s = None, None
+def _pick_clip_scale(vals, base_scale, ok, qmax: int = 7):
+    """Per-list MSE-optimal clip multiplier: quantize ``vals``
+    [..., n, rot] (validity mask ``ok`` [..., n, 1]) at each candidate
+    scale m * base_scale [..., rot] and keep, per leading batch entry,
+    the m with least total squared error (measured: m=0.7 lifts
+    DEEP-like int4 recall 0.882 -> 0.917 vs full-range m=1.0). The one
+    clip-search implementation shared by the streamed scale pass, the
+    decoded-cache scan, and attach_raw_residual_cache."""
+    best_err = best_m = None
     for m in _CLIP_CANDIDATES:
         s = base_scale * m
-        q = jnp.clip(jnp.round(vals / s), -8, 7)
-        err = jnp.sum(jnp.where(ok, (q * s - vals) ** 2, 0.0))
+        q = jnp.clip(jnp.round(vals / s[..., None, :]), -qmax - 1, qmax)
+        err = jnp.sum(jnp.where(ok, (q * s[..., None, :] - vals) ** 2, 0.0),
+                      axis=(-2, -1))
         if best_err is None:
-            best_err, best_s = err, s
+            best_err, best_m = err, jnp.full_like(err, m)
         else:
             take = err < best_err
             best_err = jnp.minimum(err, best_err)
-            best_s = jnp.where(take, s, best_s)
-    return best_s
+            best_m = jnp.where(take, m, best_m)
+    return base_scale * best_m[..., None]
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
@@ -1270,6 +1274,73 @@ def _recon_cache_scan(codes_packed, pq_centers, codebook_kind: int,
         body, None, (codes_packed, jnp.arange(C, dtype=jnp.int32))
     )
     return cache, scale
+
+
+def attach_raw_residual_cache(index: Index, dataset,
+                              block_lists: int = 64,
+                              dtype: str = "i4") -> Index:
+    """Attach a RAW rotated-residual cache (packed int4 at 0.5
+    B/component or int8 at 1 B/component, both with per-list scales)
+    built from the original dataset — the refine/scan fidelity source
+    for in-core and sharded indexes (streamed keep_codes=False builds
+    produce the identical i4 cache on the fly; this is the batch-path
+    equivalent).
+
+    The distinction matters: ``_attach_cache``'s kinds quantize the
+    DECODED PQ reconstruction (fidelity = PQ, usable by the fused scan
+    but worthless as a refine source — re-ranking PQ scores with PQ
+    fidelity gains nothing), while this cache quantizes the raw rotated
+    residual. dtype picks the rung: "i4" matches the PQ bytes (0.5
+    B/dim) and "i8" doubles them for ~16x lower quantization error —
+    the DEEP-1B per-chip refine source (1.8 GB/chip at 1B rows/64
+    chips). On the quantization-hostile unit-norm synthetic
+    (scripts/sharded_deep1b.py), end-to-end residual-cache recall@10 is
+    ~0.95 at i8 vs ~0.58 at i4 (and quantizing the VECTORS directly,
+    with no residual structure subtracting the ~4x-smaller list offsets,
+    ranks at 0.897/0.123 — the floor the residual form lifts). The
+    reference refines from the raw f32 dataset instead
+    (detail/refine_host-inl.hpp), which at 1B scale can never be HBM
+    resident. Scales are per-list MSE-optimal-clip on the actual stored
+    residuals. Processes ``block_lists`` lists per step to bound the
+    [B, cap, rot] f32 transient."""
+    if dtype not in ("i4", "i8"):
+        raise ValueError(f"dtype must be i4|i8, got {dtype!r}")
+    qmax = 7 if dtype == "i4" else 127
+    C, cap = index.indices.shape
+    rot = index.rot_dim
+    if dtype == "i4" and rot % 8 != 0:
+        raise ValueError(f"int4 cache needs rot_dim % 8 == 0, got {rot}")
+    ds = jnp.asarray(dataset)
+    caches, scales, qnorms = [], [], []
+    for c0 in range(0, C, block_lists):
+        ids = index.indices[c0:c0 + block_lists]           # [B, cap]
+        B = ids.shape[0]
+        ok = (ids >= 0)[..., None]
+        rows = ds[jnp.maximum(ids, 0)].astype(jnp.float32)  # [B, cap, d]
+        r_rot = dist_dot(rows.reshape(B * cap, -1), index.rotation.T)
+        res = (r_rot.reshape(B, cap, rot)
+               - index.centers_rot[c0:c0 + B][:, None, :])
+        res = jnp.where(ok, res, 0.0)
+        base = jnp.maximum(
+            jnp.max(jnp.abs(res), axis=1), 1e-30) / qmax    # [B, rot]
+        s_blk = _pick_clip_scale(res, base, ok, qmax=qmax)  # [B, rot]
+        if dtype == "i4":
+            packed, qn = _quant_pack_i4(res, s_blk[:, None, :])
+            caches.append(jnp.swapaxes(packed, 1, 2))       # [B, nw4, cap]
+        else:
+            q8 = jnp.clip(jnp.round(res / s_blk[:, None, :]), -128, 127)
+            deq = q8 * s_blk[:, None, :]
+            qn = jnp.sum(deq * deq, axis=-1)
+            caches.append(q8.astype(jnp.int8))              # [B, cap, rot]
+        scales.append(s_blk)
+        qnorms.append(jnp.where(ok[..., 0], qn, 0.0))
+    return dataclasses.replace(
+        index,
+        recon_cache=jnp.concatenate(caches),
+        recon_scale=1.0,
+        cache_scales=jnp.concatenate(scales),
+        cache_qnorms=jnp.concatenate(qnorms),
+    )
 
 
 def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
@@ -1443,7 +1514,8 @@ def _pq_search(
         # bucket is one list — free per-list granularity). The pq4 code
         # scan is scale-free (the codebook lives in the kernel's LUT
         # weights), so qv stays the raw residual.
-        qscale = (cache_scales[bucket_list][:, None, :] if cache_i4
+        qscale = (cache_scales[bucket_list][:, None, :]
+                  if cache_scales is not None       # per-list (raw caches)
                   else 1.0 if cache_kind == "pq4"
                   else recon_scale)
         qv = (q_res * qscale).astype(mm)                     # [nb, G, rot]
@@ -1522,7 +1594,10 @@ def _pq_search(
                 raw = unpack_i4(jnp.swapaxes(blk_t, 1, 2))
                 recon = raw * cache_scales[bl][:, None, :]
             else:
-                recon = recon_cache[bl].astype(jnp.float32) * recon_scale
+                sc = (cache_scales[bl][:, None, :]
+                      if cache_scales is not None      # raw i8 per-list
+                      else recon_scale)
+                recon = recon_cache[bl].astype(jnp.float32) * sc
         else:
             if codes.ndim == 2:
                 # flat streamed codes: gather each probed list's row range
@@ -1717,7 +1792,9 @@ def _decode_slots(slots, recon_cache, cache_scales, centers_rot,
         C, cap, _rot = recon_cache.shape
         lst = slots // cap
         sl = slots % cap
-        res = recon_cache[lst, sl].astype(jnp.float32) * recon_scale
+        sc = (cache_scales[lst] if cache_scales is not None  # raw i8
+              else recon_scale)
+        res = recon_cache[lst, sl].astype(jnp.float32) * sc
     return centers_rot[lst] + res
 
 
@@ -1856,23 +1933,20 @@ def save(path: str, index: Index) -> None:
     if cache_only and index.recon_cache is None:
         raise ValueError("cache-only index has no recon_cache to serialize")
     cache_kind = "none"
-    has_i4 = index.cache_kind == "i4"
-    if cache_only or has_i4:
-        # serialize the cache when it cannot be equivalently rebuilt from
-        # codes: cache-only indexes have no codes at all (round 3 silently
-        # wrote empty codes and rebuilt a wrong cache on load), and i4
-        # caches from streamed builds quantize RAW residuals — a rebuild
-        # from decoded codes loses that fidelity. The i8-with-codes cache
-        # and the pq4 transposed-code cache rebuild exactly and are not
-        # serialized.
+    # per-list-scaled caches hold RAW-residual fidelity (i4 streamed/
+    # attach_raw_residual_cache, i8 raw) that a rebuild from decoded
+    # codes would lose — serialize them, like cache-only caches (round 3
+    # silently wrote empty codes and rebuilt a wrong cache on load). The
+    # scalar-scale decoded-i8 cache and the pq4 transposed-code cache
+    # rebuild exactly from codes and are not serialized.
+    raw_scaled = index.cache_scales is not None
+    if cache_only or raw_scaled:
         arrays["recon_cache"] = np.asarray(index.recon_cache)
-        if has_i4:
-            cache_kind = "i4"
+        cache_kind = index.cache_kind
+        if raw_scaled:
             arrays["cache_scales"] = np.asarray(index.cache_scales)
             if index.cache_qnorms is not None:
                 arrays["cache_qnorms"] = np.asarray(index.cache_qnorms)
-        else:
-            cache_kind = "i8"
     write_index_file(
         path, "ivf_pq", _SERIAL_VERSION,
         {
@@ -1918,7 +1992,7 @@ def load(path: str) -> Index:
             recon_cache=jnp.asarray(arrays["recon_cache"]),
             recon_scale=float(meta.get("recon_scale", 1.0)),
             cache_scales=(jnp.asarray(arrays["cache_scales"])
-                          if ser_cache == "i4" else None),
+                          if "cache_scales" in arrays else None),
             cache_qnorms=(jnp.asarray(arrays["cache_qnorms"])
                           if "cache_qnorms" in arrays else None),
         )
