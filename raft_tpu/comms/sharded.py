@@ -398,8 +398,9 @@ def sharded_cagra_build(
     if n % nshards != 0:
         raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
     rows = n // nshards
-    # the packed inline layout would be discarded by the stacking below —
-    # skip building it per shard
+    # per-shard inline packing happens below with a GLOBAL dequant scale
+    # (per-shard scales would diverge and the stacked Index carries one)
+    want_inline = bool(params.inline_codes)
     params = dataclasses.replace(params, inline_codes=False)
     subs = []
     for s in range(nshards):
@@ -408,8 +409,26 @@ def sharded_cagra_build(
     datasets = jnp.stack([s.dataset for s in subs])      # [S, rows, d]
     norms = (jnp.stack([s.data_norms for s in subs])
              if subs[0].data_norms is not None else None)
-    return cagra.Index(dataset=datasets, graph=graphs,
-                       metric=subs[0].metric, data_norms=norms)
+    out = cagra.Index(dataset=datasets, graph=graphs,
+                      metric=subs[0].metric, data_norms=norms)
+    d = dataset.shape[1]
+    deg = graphs.shape[2]
+    need_norms = out.metric != DistanceType.InnerProduct
+    if want_inline and cagra._inline_eligible(n, d, deg, need_norms,
+                                              max_rows=rows):
+        scale = cagra._code_scale(dataset)
+        packs, codes = [], []
+        for s in subs:
+            p_, c_, _ = cagra._pack_tables(
+                s.dataset, s.graph, need_norms, scale=scale)
+            packs.append(p_)
+            codes.append(c_)
+        out = dataclasses.replace(
+            out, nbr_pack=jnp.stack(packs),              # [S, rows, W]
+            flat_codes=jnp.stack(codes),                 # [S, rows, d] i8
+            code_scale=float(scale),
+        )
+    return out
 
 
 def sharded_cagra_search(
@@ -427,11 +446,16 @@ def sharded_cagra_search(
     (the knn_merge_parts-over-comms pattern,
     detail/knn_merge_parts.cuh:140).
 
-    NOTE: the per-shard search is the exact scattered-gather path, not
-    the fused Pallas beam kernel (per-shard packed tables would need
-    stacked [S, rows, W] layouts threaded through shard_map — a known
-    follow-up); expect single-chip CAGRA QPS ratios to understate the
-    sharded path accordingly."""
+    When the index carries the stacked inline layout (sharded_cagra_build
+    with inline_codes=True), each shard runs the FUSED Pallas beam kernel
+    on its own sub-graph — the same kernel as single-chip search, with
+    the per-shard packed table and int8 codes threaded through shard_map
+    (local itopk per shard, merged over ICI; the reference's multi-GPU
+    CAGRA similarly runs its single-CTA kernel per GPU and merges).
+    ``scan_impl`` resolution matches single-device search: "auto" picks
+    the kernel on TPU, the exact scattered-gather path elsewhere;
+    "pallas_interpret" forces the kernel in interpret mode (CPU-mesh
+    parity tests / dryrun)."""
     from raft_tpu.neighbors import cagra
 
     queries = jnp.asarray(queries)
@@ -442,14 +466,39 @@ def sharded_cagra_search(
     select_min = is_min_close(index.metric)
     itopk, width, iters, n_seeds = cagra.search_plan(search_params, k)
     has_norms = index.data_norms is not None
+    dtype = str(getattr(search_params, "compute_dtype", "auto"))
+    requested = str(getattr(search_params, "scan_impl", "auto"))
+    # same resolver + validation as single-device cagra.search
+    impl = cagra._resolve_beam_impl(requested, index, dtype)
+    fused = impl.startswith("pallas")
+    if fused and index.nbr_pack is None:
+        raise ValueError(
+            "scan_impl=%r needs the stacked inline layout (build with "
+            "sharded_cagra_build inline_codes=True)" % impl)
+    if fused and dtype != "auto":
+        raise ValueError(
+            "scan_impl=%r scores int8 traversal distances; compute_dtype "
+            "must stay 'auto' (got %r)" % (impl, dtype))
 
     def local(q, ds, graph, *rest):
         rank = jax.lax.axis_index(axis_name)
-        norms = rest[0][0] if has_norms else None
-        d, i = cagra._beam_search(
-            q, ds[0], graph[0], norms, int(k), itopk, width, iters,
-            int(index.metric), "f32", n_seeds,
-        )
+        rest = list(rest)
+        norms = rest.pop(0)[0] if has_norms else None
+        if fused:
+            pack = rest.pop(0)[0]                        # [rows, W]
+            codes = rest.pop(0)[0]                       # [rows, d] i8
+            d, i = cagra._beam_search_pallas(
+                q, ds[0], graph[0], norms, pack, codes,
+                jnp.float32(index.code_scale), int(k), itopk, width,
+                iters, int(index.metric), n_seeds,
+                impl == "pallas_interpret",
+            )
+        else:
+            d, i = cagra._beam_search(
+                q, ds[0], graph[0], norms, int(k), itopk, width, iters,
+                int(index.metric), "f32" if dtype == "auto" else dtype,
+                n_seeds,
+            )
         i = jnp.where(i >= 0, i + (rank * rows).astype(i.dtype), -1)
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
@@ -460,6 +509,11 @@ def sharded_cagra_search(
     if has_norms:
         args.append(index.data_norms)
         in_specs.append(P(axis_name, None))
+    if fused:
+        args.append(index.nbr_pack)
+        in_specs.append(P(axis_name, None, None))
+        args.append(index.flat_codes)
+        in_specs.append(P(axis_name, None, None))
 
     fn = shard_map(
         local,

@@ -70,7 +70,10 @@ def select_k(
         in_idx = jnp.asarray(in_idx)
         if squeeze and in_idx.ndim == 1:
             in_idx = in_idx[None, :]
-        idxs = jnp.take_along_axis(in_idx, idxs, axis=1)
+        # tournament pad slots carry position -1: without the mask the
+        # gather would wrap to in_idx[..., -1] and return a real id
+        mapped = jnp.take_along_axis(in_idx, jnp.maximum(idxs, 0), axis=1)
+        idxs = jnp.where(idxs < 0, jnp.asarray(-1, mapped.dtype), mapped)
     if squeeze:
         return vals[0], idxs[0]
     return vals, idxs
@@ -105,10 +108,13 @@ def _tournament_topk(in_val, k: int, select_min: bool):
     ~n(log^2(2K)/2 + 2 log(2K)) vs the full sort's n log^2(n)/2.
 
     Output contract matches the top_k arm: values are returned in the
-    input dtype. Rows with fewer than k finite entries fill the tail
-    with +/-inf values carrying id -1 (the pad id; lax.top_k would
-    return an arbitrary real index there — -1 is the honest answer and
-    is what the bitset/pad conventions elsewhere in the package use)."""
+    input dtype, and in-data non-finite entries keep their real column
+    index (exactly like lax.top_k). The one divergence: STRUCTURAL pad
+    slots (from rounding n up to the power-of-two block grid) carry
+    index -1 — they can only reach the output when a row has fewer than
+    k finite entries, where they tie with the row's own +/-inf entries
+    and -1 is the honest no-candidate answer (the library-wide pad
+    convention)."""
     from raft_tpu.matrix.bitonic import merge_bitonic, sort_by_key
 
     m, n = in_val.shape

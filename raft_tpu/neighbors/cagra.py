@@ -189,7 +189,8 @@ _QUERY_TILE = 128
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
+def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14,
+                 scale=None):
     """Build the packed inline layout: per node one int32 row
     ``[deg*d/4 code words | deg norm bitcasts | deg ids]`` (norms
     omitted for IP), plus flat int8 codes [n, d] for seed scoring.
@@ -197,11 +198,14 @@ def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
     Code words pack 4 bytes by shift-or (a narrowing
     lax.bitcast_convert_type lowers to a catastrophic widened
     intermediate on TPU) — the kernel decode (beam_step.py) mirrors the
-    byte order by construction."""
+    byte order by construction. ``scale`` overrides the derived int8
+    dequant scale (the sharded build passes a GLOBAL scale so every
+    shard's codes share one dequant constant)."""
     n, d = dataset.shape
     deg = graph.shape[1]
     d32 = dataset.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(d32)), 1e-30) / 127.0
+    if scale is None:
+        scale = _code_scale(d32)
     codes = jnp.clip(jnp.round(d32 / scale), -127, 127).astype(jnp.int8)
     norms = jnp.sum(d32 * d32, axis=1) if need_norms else None
 
@@ -237,17 +241,33 @@ def _pack_tables(dataset, graph, need_norms: bool, chunk: int = 1 << 14):
     return pack, codes, scale
 
 
+def _inline_eligible(n: int, d: int, deg: int, need_norms: bool,
+                     max_rows: Optional[int] = None) -> bool:
+    """The one inline-layout gate shared by single-device _attach_inline
+    and the sharded stacked build: dim word-alignment, packed-table
+    budget (row bytes incl. per-region 128-lane padding), and the
+    (id<<1)|flag id-packing row bound."""
+    from raft_tpu.ops.beam_step import packed_row_layout
+
+    if d % 4:
+        return False
+    row_bytes = 4 * packed_row_layout(deg, d, not need_norms)[3]
+    rows = n if max_rows is None else max_rows
+    return n * row_bytes <= _INLINE_BUDGET and rows < (1 << 30)
+
+
+def _code_scale(dataset) -> jax.Array:
+    """The int8 dequant scale formula shared by _pack_tables and the
+    sharded build's global-scale packing."""
+    return jnp.maximum(
+        jnp.max(jnp.abs(dataset.astype(jnp.float32))), 1e-30) / 127.0
+
+
 def _attach_inline(index: Index, inline: bool) -> Index:
     n, d = index.dataset.shape
     deg = index.graph.shape[1]
-    from raft_tpu.ops.beam_step import packed_row_layout
-
     need_norms = index.metric != DistanceType.InnerProduct
-    # true packed-row bytes incl. the per-region 128-lane alignment pad
-    row_bytes = (4 * packed_row_layout(deg, d, not need_norms)[3]
-                 if d % 4 == 0 else 0)
-    if not inline or d % 4 or n * row_bytes > _INLINE_BUDGET \
-            or n >= (1 << 30):   # beam kernel packs ids as (id<<1)|flag
+    if not inline or not _inline_eligible(n, d, deg, need_norms):
         return index
     nbr_pack, flat_codes, scale = _pack_tables(
         index.dataset, index.graph, need_norms

@@ -109,17 +109,30 @@ def merge_topk(
     ``approx_max_k`` (the TPU-KNN partial-reduce op) — dramatically faster
     than a full sort for k << c, at a configurable ``recall_target``. Use it
     for inner candidate-generation stages whose output feeds an exact merge.
+
+    The exact arm routes through ``matrix.select_k``, so large-k merges
+    (k > 256, c >> k — CAGRA-build candidate selection, cross-probe
+    merges at high refine ratios) dispatch to the compacting tournament
+    instead of ``lax.top_k``'s full-row sort (the reference serves this
+    regime with radix select, matrix/detail/select_radix.cuh:231).
+    Tournament rows with fewer than k finite entries return id -1 — the
+    library-wide no-neighbor convention callers already mask on.
     """
     if approx and k < dists.shape[-1]:
         fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
         vals, sel = fn(dists, k, recall_target=recall_target)
         return vals, jnp.take_along_axis(idxs, sel, axis=-1)
-    if select_min:
-        vals, sel = jax.lax.top_k(-dists, k)
-        vals = -vals
-    else:
-        vals, sel = jax.lax.top_k(dists, k)
-    return vals, jnp.take_along_axis(idxs, sel, axis=-1)
+    from raft_tpu.matrix.select_k import select_k
+
+    shape = dists.shape
+    if dists.ndim != 2:
+        dists = dists.reshape(-1, shape[-1])
+        idxs = idxs.reshape(-1, shape[-1])
+    vals, out_i = select_k(dists, k, in_idx=idxs, select_min=select_min)
+    if len(shape) != 2:
+        vals = vals.reshape(*shape[:-1], k)
+        out_i = out_i.reshape(*shape[:-1], k)
+    return vals, out_i
 
 
 def knn_merge_parts(

@@ -195,6 +195,44 @@ def test_sharded_cagra_build_search(rng, eight_device_mesh):
         assert live.max() < n
 
 
+def test_sharded_cagra_fused_beam_parity(rng, eight_device_mesh):
+    """The sharded CAGRA search runs the REAL fused Pallas beam kernel
+    per shard (stacked inline tables through shard_map, interpret mode
+    on the CPU mesh) and must match the scattered exact path's recall —
+    VERDICT r4 #6 (previously a placeholder xla_exact fallback)."""
+    from raft_tpu.comms import sharded_cagra_build, sharded_cagra_search
+    from raft_tpu.neighbors import cagra
+
+    centers = rng.uniform(-5, 5, (16, 32)).astype(np.float32)
+    n, m, k = 4096, 32, 10
+    x = (centers[rng.integers(0, 16, n)]
+         + 0.7 * rng.standard_normal((n, 32))).astype(np.float32)
+    q = (centers[rng.integers(0, 16, m)]
+         + 0.7 * rng.standard_normal((m, 32))).astype(np.float32)
+    params = cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16)   # inline default
+    sidx = sharded_cagra_build(params, x, eight_device_mesh)
+    assert sidx.nbr_pack is not None
+    assert sidx.nbr_pack.shape[0] == 8
+    assert sidx.flat_codes.dtype == np.int8
+    sp_x = cagra.SearchParams(itopk_size=64, scan_impl="xla")
+    _, i_x = sharded_cagra_search(sp_x, sidx, q, k, eight_device_mesh)
+    sp_p = cagra.SearchParams(itopk_size=64, scan_impl="pallas_interpret")
+    _, i_p = sharded_cagra_search(sp_p, sidx, q, k, eight_device_mesh)
+    _, want = naive_knn(q, x, k)
+    r_x = eval_recall(np.asarray(i_x), want)
+    r_p = eval_recall(np.asarray(i_p), want)
+    assert r_x > 0.9
+    # int8 traversal scoring may reorder near-ties; recall parity is the
+    # contract (mirrors the single-device pallas-vs-xla parity test)
+    assert r_p > r_x - 0.05, (r_p, r_x)
+    ii = np.asarray(i_p)
+    assert (ii < n).all()
+    for r in range(ii.shape[0]):
+        live = ii[r][ii[r] >= 0]
+        assert len(set(live.tolist())) == len(live)
+
+
 def test_sharded_ivf_build_row_search(rng, eight_device_mesh):
     from raft_tpu.comms import sharded_ivf_build, sharded_ivf_row_search
     from raft_tpu.neighbors import ivf_flat

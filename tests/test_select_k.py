@@ -82,3 +82,58 @@ def test_tournament_topk_non_pow2_n():
     v, i = _tournament_topk(jnp.asarray(x), 512, True)
     np.testing.assert_allclose(np.asarray(v), np.sort(x, axis=1)[:, :512])
     assert (np.asarray(i) >= 0).all()
+
+
+def test_merge_topk_routes_large_k_through_tournament(monkeypatch):
+    """VERDICT r4 #5: the large-k dispatch must be reachable from a real
+    library path — brute_force.knn's exact merge at k=512 over 8k rows
+    lands in _tournament_topk (the radix-select-analog regime,
+    select_radix.cuh:231), with ids agreeing with the numpy oracle."""
+    import importlib
+
+    sk = importlib.import_module("raft_tpu.matrix.select_k")
+    from raft_tpu.neighbors import brute_force
+
+    calls = []
+    orig = sk._tournament_topk
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(sk, "_tournament_topk", spy)
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((8192, 16)).astype(np.float32)
+    q = rng.standard_normal((4, 16)).astype(np.float32)
+    d, i = brute_force.knn(q, x, 512)
+    assert calls, "k=512 exact merge did not reach the tournament"
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.sort(d2, axis=1)[:, :512]
+    np.testing.assert_allclose(np.asarray(d), want, rtol=1e-4, atol=1e-4)
+    got = np.take_along_axis(d2, np.asarray(i), axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_select_k_in_idx_pad_slots_never_wrap():
+    """Tournament pad slots (structural -1 positions from the
+    power-of-two padding) must map to -1 through an in_idx mapping — an
+    unmasked take_along_axis would WRAP to in_idx[..., -1] and return
+    that row's last id once per selected pad slot. Detector: with only
+    100 finite entries and k=512, hundreds of pad slots reach the
+    output; the last column's distinctive id may appear at most once
+    (itself), so any repeat is the wrap artifact. In-data inf entries
+    legitimately keep their real ids (same as lax.top_k)."""
+    from raft_tpu.matrix.select_k import select_k
+
+    rng = np.random.default_rng(12)
+    n, k = 5000, 512          # pads to 8*1024: 3192 structural pad slots
+    x = np.full((2, n), np.inf, np.float32)
+    x[:, :100] = rng.standard_normal((2, 100)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(n, dtype=np.int32) + 1000, (2, n))
+    v, i = select_k(jnp.asarray(x), k, in_idx=jnp.asarray(ids))
+    i = np.asarray(i)
+    assert (i[:, :100] >= 1000).all()
+    last_id = 1000 + n - 1
+    assert (i == last_id).sum(axis=1).max() <= 1
+    # every emitted id is -1 or a real mapped id
+    assert (((i == -1) | (i >= 1000)) & (i <= last_id)).all()
