@@ -156,6 +156,22 @@ def main():
     res["timing"] = "scan-chained (iters 2->6 slope)"
     print(f"qps={res['qps']} recall={res['recall_at_10']}", flush=True)
 
+    # ---- cache-resident refine point (search_refined: slot-substituted
+    # search + f32 re-rank decoded from the same i4 cache — removes the
+    # kernel's bf16/extraction losses at no extra index bytes) ----------
+    _, idx_r = ivf_pq.search_refined(sp, index, queries, k, refine_ratio=3)
+    np.asarray(idx_r[0, 0])
+    res["refined_recall_at_10"] = round(
+        float(compute_recall(np.asarray(idx_r[:sub]), cur_i)), 4)
+
+    def step_r(qb, ops):
+        return ivf_pq.search_refined(sp, ops, qb, k, refine_ratio=3)
+
+    s = scan_qps_time(step_r, queries, n1=2, n2=6, operands=index)
+    res["refined_qps"] = round(nq / s, 1)
+    print(f"refined: qps={res['refined_qps']} "
+          f"recall={res['refined_recall_at_10']}", flush=True)
+
     with open(out_path, "w") as f:
         json.dump(res, f, indent=1)
     print(json.dumps(res))

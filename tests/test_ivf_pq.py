@@ -640,6 +640,14 @@ def test_build_streamed_cache_only_i4():
     _, idx = ivf_pq.search(sp, got, q, k)
     _, want = naive_knn(q, x, k)
     assert eval_recall(np.asarray(idx), want) > 0.65
+    # cache-resident refine works on a CACHE-ONLY index (the DEEP-100M
+    # scripted path): slot substitution + f32 re-rank from the i4 cache
+    _, idx_r = ivf_pq.search_refined(sp, got, q, k, refine_ratio=3)
+    r_plain = eval_recall(np.asarray(idx), want)
+    r_ref = eval_recall(np.asarray(idx_r), want)
+    assert r_ref >= r_plain - 0.02, (r_plain, r_ref)
+    ii = np.asarray(idx_r)
+    assert ((ii >= -1) & (ii < n)).all()
     with tempfile.TemporaryDirectory() as td:
         p = os.path.join(td, "pq_i4.idx")
         ivf_pq.save(p, got)
