@@ -29,7 +29,7 @@ from raft_tpu.bench.harness import latency_percentiles
 
 
 def main():
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "LATENCY_r04.json"
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "LATENCY_r05.json"
     n, d, k = 1_000_000, 128, 10
     print(f"devices: {jax.devices()}", flush=True)
     x = jax.device_put(sift_like(n, d, seed=1))

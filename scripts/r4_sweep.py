@@ -22,7 +22,7 @@ from bench import _sift_like as sift_like
 from raft_tpu.bench.harness import compute_recall, scan_qps_time
 
 
-def sweep_flat(x, q, want):
+def sweep_flat(x, q, want, rows):
     from raft_tpu.neighbors import ivf_flat
 
     nq, k = q.shape[0], 10
@@ -51,12 +51,17 @@ def sweep_flat(x, q, want):
                     operands=index)
                 print(f"[flat {sd}] grp={grp} bb={bb} lrt={lrt} mrt={mrt}: "
                       f"{nq/s:.0f} QPS r={rec:.3f}", flush=True)
+                rows.append({"algo": "ivf_flat", "storage": sd,
+                             "query_group": grp, "bucket_batch": bb,
+                             "lrt": lrt, "mrt": mrt,
+                             "qps": round(nq / s, 1),
+                             "recall_at_10": round(float(rec), 4)})
             except Exception as e:  # noqa: BLE001
                 print(f"[flat {sd}] grp={grp} bb={bb}: FAIL {e!r}"[:200],
                       flush=True)
 
 
-def sweep_cagra(x, q, want):
+def sweep_cagra(x, q, want, rows):
     from raft_tpu.neighbors import cagra
 
     nq, k = q.shape[0], 10
@@ -83,6 +88,10 @@ def sweep_cagra(x, q, want):
                 operands=index)
             print(f"[cagra] w={width} it={iters} seeds={seeds} "
                   f"itopk={itopk}: {nq/s:.0f} QPS r={rec:.3f}", flush=True)
+            rows.append({"algo": "cagra", "search_width": width,
+                         "iters": iters, "n_seeds": seeds, "itopk": itopk,
+                         "qps": round(nq / s, 1),
+                         "recall_at_10": round(float(rec), 4)})
         except Exception as e:  # noqa: BLE001
             print(f"[cagra] w={width} it={iters}: FAIL {e!r}"[:200],
                   flush=True)
@@ -100,10 +109,16 @@ def main():
     _, bf_idx = brute_force.knn(q[:1000], x, 10)
     want = np.asarray(bf_idx)
     print("oracle done", flush=True)
+    rows = []
     if which in ("flat", "both"):
-        sweep_flat(x, q, want)
+        sweep_flat(x, q, want, rows)
     if which in ("cagra", "both"):
-        sweep_cagra(x, q, want)
+        sweep_cagra(x, q, want, rows)
+    import json
+
+    with open("SWEEP_r05.json", "w") as f:
+        json.dump(rows, f, indent=1)
+    print(json.dumps(rows))
 
 
 if __name__ == "__main__":

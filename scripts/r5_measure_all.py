@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Round-5 measurement battery: run EVERYTHING the verdict asks for in
+value-per-minute order, each stage in its own subprocess with a hard
+timeout, artifacts written incrementally — so a partial TPU window still
+captures the most important numbers (the round-4 outage taught that
+lesson: a full battery staged behind one long build captured nothing).
+
+Stages (artifact, rough budget):
+  1. probe            — TPU reachable? (fast-fail JSON if not)
+  2. bench.py         — BENCH_r05_local.json   (~45 min, headline configs)
+  3. deep100m         — DEEP100M_r05.json      (~30 min total at 100M)
+  4. r4_sweep         — SWEEP_r05.json         (~25 min, flat+cagra levers)
+  5. latency_table    — LATENCY_r05.json       (~10 min, batch 1/10/100)
+  6. select_crossover — SELECT_CROSSOVER_r05.json (~10 min)
+
+Run: python scripts/r5_measure_all.py [--only stage1,stage2] [--skip ...]
+Progress + per-stage rc stream to stdout and R5_MEASURE_STATUS.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def probe(timeout=120):
+    try:
+        r = subprocess.run(
+            [PY, "-c",
+             "import jax; d = jax.devices(); "
+             "assert d[0].platform.lower() in ('tpu', 'axon'), d; "
+             "print(d)"],
+            timeout=timeout, capture_output=True, cwd=ROOT)
+        return r.returncode == 0, (r.stdout + r.stderr).decode(errors="replace")[-200:]
+    except subprocess.TimeoutExpired:
+        return False, "probe timeout (backend hang)"
+
+
+STAGES = [
+    # (name, argv, timeout_s)
+    ("bench", [PY, "bench.py"], 5400),
+    ("deep100m", [PY, "scripts/deep100m.py", "DEEP100M_r05.json"], 4200),
+    ("sweep", [PY, "scripts/r4_sweep.py", "both"], 3600),
+    ("latency", [PY, "scripts/latency_table.py"], 1800),
+    ("crossover", [PY, "scripts/select_crossover.py"], 1800),
+]
+
+
+def main():
+    only = skip = None
+    if "--only" in sys.argv:
+        only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
+    if "--skip" in sys.argv:
+        skip = set(sys.argv[sys.argv.index("--skip") + 1].split(","))
+    status = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              "stages": {}}
+
+    def flush():
+        with open(os.path.join(ROOT, "R5_MEASURE_STATUS.json"), "w") as f:
+            json.dump(status, f, indent=1)
+
+    ok, detail = probe()
+    status["tpu_probe"] = {"ok": ok, "detail": detail}
+    flush()
+    if not ok:
+        print(f"TPU unreachable: {detail}", flush=True)
+        return 1
+    print(f"TPU up: {detail}", flush=True)
+
+    for name, argv, tmo in STAGES:
+        if only is not None and name not in only:
+            continue
+        if skip is not None and name in skip:
+            continue
+        t0 = time.time()
+        print(f"=== {name}: {' '.join(argv)} (timeout {tmo}s)", flush=True)
+        try:
+            r = subprocess.run(argv, timeout=tmo, cwd=ROOT,
+                               capture_output=True)
+            out = r.stdout.decode(errors="replace")
+            err = r.stderr.decode(errors="replace")
+            status["stages"][name] = {
+                "rc": r.returncode, "s": round(time.time() - t0, 1),
+                "tail": (out + err)[-2000:],
+            }
+            # bench.py prints its JSON line to stdout — persist it
+            if name == "bench" and r.returncode == 0:
+                last = [ln for ln in out.splitlines() if ln.startswith("{")]
+                if last:
+                    with open(os.path.join(ROOT, "BENCH_r05_local.json"),
+                              "w") as f:
+                        f.write(last[-1] + "\n")
+            print(f"--- {name}: rc={r.returncode} "
+                  f"{round(time.time() - t0, 1)}s", flush=True)
+            print((out + err)[-1500:], flush=True)
+        except subprocess.TimeoutExpired:
+            status["stages"][name] = {"rc": "timeout", "s": tmo}
+            print(f"--- {name}: TIMEOUT after {tmo}s", flush=True)
+        flush()
+        # between stages, re-probe: if the TPU died mid-battery, stop
+        # burning stage timeouts on a dead backend
+        ok, detail = probe(60)
+        if not ok:
+            status["aborted"] = f"tpu lost after {name}: {detail}"
+            flush()
+            print(status["aborted"], flush=True)
+            return 1
+    flush()
+    print("battery complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,7 @@ def main():
                 "speedup": round(t_top / t_trn, 2),
             })
             print(rows[-1], flush=True)
-    with open("SELECT_CROSSOVER_r04.json", "w") as f:
+    with open("SELECT_CROSSOVER_r05.json", "w") as f:
         json.dump(rows, f, indent=1)
     print(json.dumps(rows))
 
