@@ -41,6 +41,15 @@ def main():
     scan_impl = "pallas"
     if "--scan-impl" in sys.argv:   # CPU smoke: pass pallas_interpret
         scan_impl = sys.argv[sys.argv.index("--scan-impl") + 1]
+    # cache rung: i4 (0.5 B/comp, the 100M default — 6.4 GB at rot128)
+    # or i8 with pq_dim=96/rot=96 (9.6 GB cache-only; the rehearsal
+    # measured i8-raw ~0.95 vs i4 ~0.9 recall on IP-like data —
+    # SHARDED_r05.json) for a second recall/QPS Pareto point on chip
+    cache_dtype = "i4"
+    if "--cache-dtype" in sys.argv:
+        cache_dtype = sys.argv[sys.argv.index("--cache-dtype") + 1]
+    pq_dim = 96 if cache_dtype == "i8" else 64   # i8: rot=96 keeps the
+    # cache at 9.6 GB (rot128 would be 12.8 GB and miss HBM)
     d, nq, k = 96, 10_000, 10
     bs = 500_000
     n_lists = 32768 if n > 20_000_000 else 4096
@@ -64,7 +73,8 @@ def main():
     jax.block_until_ready(queries)
 
     res = {"config": {"n": n, "dim": d, "n_lists": n_lists,
-                      "pq_dim": 64, "pq_bits": 8, "n_probes": n_probes,
+                      "pq_dim": pq_dim, "pq_bits": 8,
+                      "cache_dtype": cache_dtype, "n_probes": n_probes,
                       "k": k, "batch_rows": bs}}
 
     # ---- build ---------------------------------------------------------
@@ -74,8 +84,8 @@ def main():
     # kernel with in-kernel nibble decode — the round-4 answer to the
     # round-3 195-QPS decode-gather fallback.
     params = ivf_pq.IndexParams(
-        n_lists=n_lists, pq_dim=64, pq_bits=8, kmeans_n_iters=10,
-        cache_dtype="i4",
+        n_lists=n_lists, pq_dim=pq_dim, pq_bits=8, kmeans_n_iters=10,
+        cache_dtype=cache_dtype,
     )
     t0 = time.time()
 
