@@ -248,36 +248,19 @@ def bench_ivfpq_deep10m(results):
 def main():
     # Fail fast and parseably when the TPU backend is unreachable (the
     # round-4 outage left BENCH_r04.json holding a 40-line traceback;
-    # the driver's record should stay one JSON line either way). The
-    # outage mode is a HANG inside device init — C code holding the GIL,
-    # so no in-process deadline (SIGALRM never fires) — hence the probe
-    # is a SUBPROCESS under a hard timeout.
-    import subprocess
-    import sys
+    # the driver's record should stay one JSON line either way).
+    from raft_tpu.bench.harness import probe_tpu
 
-    try:
-        # clean init failures fall back to the CPU backend (non-empty
-        # device list, rc 0) — require an actual TPU-class platform so a
-        # CPU fallback is recorded as tpu_unavailable, not as a 0.001x
-        # "regression"
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "assert d[0].platform.lower() in ('tpu', 'axon'), d"],
-            timeout=float(os.environ.get("BENCH_INIT_TIMEOUT_S", "120")),
-            capture_output=True,
-        )
-        if probe.returncode != 0:
-            raise RuntimeError(
-                probe.stderr.decode(errors="replace")[-200:])
-    except Exception as e:
+    ok, detail = probe_tpu(float(os.environ.get("BENCH_INIT_TIMEOUT_S",
+                                                "120")))
+    if not ok:
         print(json.dumps({
             "metric": "ivfflat_sift1m_qps",
             "value": 0,
             "unit": "QPS",
             "vs_baseline": 0.0,
             "error": "tpu_unavailable",
-            "detail": repr(e)[:200],
+            "detail": detail[:200],
         }))
         return
 

@@ -137,6 +137,31 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13,
     return per_iter
 
 
+def probe_tpu(timeout_s: float = 120.0):
+    """Subprocess probe for a live TPU-class backend (platform 'tpu' or
+    'axon'). Returns (ok, detail). A subprocess because the known outage
+    mode HANGS inside device init holding the GIL (no in-process
+    deadline can fire), and because a clean init failure silently falls
+    back to the CPU backend — which must read as unavailable, not as a
+    catastrophically slow TPU. Shared by bench.py and the measurement
+    battery runner."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "assert d[0].platform.lower() in ('tpu', 'axon'), d; "
+             "print(d)"],
+            timeout=timeout_s, capture_output=True,
+        )
+        out = (r.stdout + r.stderr).decode(errors="replace")[-200:]
+        return r.returncode == 0, out
+    except subprocess.TimeoutExpired:
+        return False, "probe timeout (backend init hang)"
+
+
 def latency_percentiles(search_step, queries, batch: int,
                         n_calls: int = 50, operands=None) -> dict:
     """Per-call latency distribution for small-batch serving (the

@@ -125,11 +125,12 @@ def merge_topk(
     from raft_tpu.matrix.select_k import select_k
 
     shape = dists.shape
-    if dists.ndim != 2:
+    reshaped = dists.ndim != 2
+    if reshaped:
         dists = dists.reshape(-1, shape[-1])
         idxs = idxs.reshape(-1, shape[-1])
     vals, out_i = select_k(dists, k, in_idx=idxs, select_min=select_min)
-    if len(shape) != 2:
+    if reshaped:
         vals = vals.reshape(*shape[:-1], k)
         out_i = out_i.reshape(*shape[:-1], k)
     return vals, out_i

@@ -28,16 +28,10 @@ PY = sys.executable
 
 
 def probe(timeout=120):
-    try:
-        r = subprocess.run(
-            [PY, "-c",
-             "import jax; d = jax.devices(); "
-             "assert d[0].platform.lower() in ('tpu', 'axon'), d; "
-             "print(d)"],
-            timeout=timeout, capture_output=True, cwd=ROOT)
-        return r.returncode == 0, (r.stdout + r.stderr).decode(errors="replace")[-200:]
-    except subprocess.TimeoutExpired:
-        return False, "probe timeout (backend hang)"
+    sys.path.insert(0, ROOT)
+    from raft_tpu.bench.harness import probe_tpu
+
+    return probe_tpu(timeout)
 
 
 STAGES = [
