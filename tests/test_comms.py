@@ -132,11 +132,14 @@ def test_sharded_ivf_pq_search(rng, eight_device_mesh):
     assert eval_recall(np.asarray(idx), np.asarray(i1)) > 0.7
 
 
-def test_sharded_ivf_pq_search_refined(rng, eight_device_mesh):
+@pytest.mark.parametrize("cache", ["i4", "i8raw"])
+def test_sharded_ivf_pq_search_refined(rng, eight_device_mesh, cache):
     """refine_ratio>1: per-shard exact re-rank decoded from each shard's
     OWN residual-cache shard (no raw dataset anywhere in the search+refine
     path — the DEEP-1B model where the f32 dataset can never be
-    resident). Recall must not drop vs the raw sharded search."""
+    resident). Recall must not drop vs the raw sharded search. The i8raw
+    variant is the SHARDED_r05.json headline config in miniature
+    (attach_raw_residual_cache dtype='i8', per-list scales sharded)."""
     from raft_tpu.comms import sharded_ivf_pq_search
     from raft_tpu.neighbors import ivf_pq
 
@@ -145,9 +148,16 @@ def test_sharded_ivf_pq_search_refined(rng, eight_device_mesh):
     q = rng.standard_normal((m, d)).astype(np.float32)
     params = ivf_pq.IndexParams(
         n_lists=16, pq_dim=8, pq_bits=8, kmeans_n_iters=5,
-        kmeans_trainset_fraction=1.0, cache_dtype="i4",
+        kmeans_trainset_fraction=1.0,
+        cache_dtype="i4" if cache == "i4" else "auto",
+        cache_decoded=cache == "i4",
     )
     index = ivf_pq.build(params, x)
+    if cache == "i8raw":
+        index = ivf_pq.attach_raw_residual_cache(index, x, block_lists=5,
+                                                 dtype="i8")
+        assert index.cache_kind == "i8"
+        assert index.cache_scales is not None
     assert index.recon_cache is not None
     sp = ivf_pq.SearchParams(
         n_probes=16, query_group=8, local_recall_target=1.0
