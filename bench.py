@@ -244,6 +244,30 @@ def bench_ivfpq_deep10m(results):
     results["ivfpq_refined_qps"] = round(nq / s, 1)
     results["ivfpq_refined_recall"] = round(float(recall_r), 3)
 
+    # + cache-resident refine: raw-residual i8 cache as both scan operand
+    # and refine source — the billion-scale pattern (SHARDED_r05.json)
+    # measured here as a DATASET-FREE Pareto point (the f32-refined
+    # config above reads the 3.8 GB dataset per query batch; this one
+    # reads only the 1 B/dim cache)
+    try:
+        index_raw = ivf_pq.attach_raw_residual_cache(index, x_dev,
+                                                     dtype="i8")
+        np.asarray(index_raw.cache_scales[0, 0])   # sync the attach
+
+        def search_cache_refined(qq, ix):
+            return ivf_pq.search_refined(sp, ix, qq, k, refine_ratio=3)
+
+        _, idx_cr = search_cache_refined(q, index_raw)
+        results["ivfpq_cache_refined_recall"] = round(float(
+            compute_recall(np.asarray(idx_cr[:sub]), np.asarray(mi))), 3)
+        s = _median_s(results, "ivfpq_cache_refined", lambda: scan_qps_time(
+            search_cache_refined, q, n1=n1, n2=n2, operands=index_raw),
+            n_draws=3)
+        results["ivfpq_cache_refined_qps"] = round(nq / s, 1)
+        del index_raw
+    except Exception as e:  # noqa: BLE001 - keep the headline alive
+        results["ivfpq_cache_refined_error"] = repr(e)[:200]
+
 
 def main():
     # Fail fast and parseably when the TPU backend is unreachable (the
