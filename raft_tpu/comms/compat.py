@@ -1,0 +1,37 @@
+"""jax version compatibility for ``shard_map``.
+
+The sharded layer targets the modern API (``jax.shard_map`` with the
+``check_vma`` knob, jax >= 0.7); older runtimes ship it as
+``jax.experimental.shard_map.shard_map`` with the same knob spelled
+``check_rep``. One wrapper keeps every call site on the modern spelling
+so the comms layer (and its tests) import on both."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.7
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis (``lax.axis_size`` on modern
+    jax; reconstructed from the axis env on older runtimes, where
+    ``core.axis_frame`` hands back the size directly)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as jc
+
+    frame = jc.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size

@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.comms.compat import axis_size
+
 
 def allreduce(x, axis_name: str, op: str = "sum"):
     """comms_iface::allreduce (core/comms.hpp)."""
@@ -65,7 +67,7 @@ def alltoall(x, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
 def device_sendrecv(x, axis_name: str, shift: int = 1):
     """comms_iface::device_sendrecv — ring permute by ``shift``
     (ppermute rides ICI neighbor links)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

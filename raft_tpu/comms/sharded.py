@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from raft_tpu.comms.compat import shard_map
 
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force
@@ -399,7 +399,12 @@ def sharded_cagra_build(
         raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
     rows = n // nshards
     # per-shard inline packing happens below with a GLOBAL dequant scale
-    # (per-shard scales would diverge and the stacked Index carries one)
+    # (per-shard scales would diverge and the stacked Index carries one).
+    # Eligibility is budgeted on the PER-SHARD residency (max_rows=rows):
+    # search-time HBM holds one shard's table under shard_map, so an
+    # S-way mesh keeps the fused beam kernel at S times the single-chip
+    # scale (the build still materializes the stacked pack host-side —
+    # transient, not the search-time bound)
     want_inline = bool(params.inline_codes)
     params = dataclasses.replace(params, inline_codes=False)
     subs = []

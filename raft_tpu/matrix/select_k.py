@@ -7,7 +7,10 @@ learned heuristic (matrix/detail/select_k-inl.cuh:51-79). The dispatch
 here has two arms: XLA's ``lax.top_k`` (hardware sort unit — near-optimal
 for small k) and the exact tournament network ``_tournament_topk`` for
 large k at n >> k — the compacting radix-select analog, built on the
-reshape-bitonic networks with no gathers. The entry point also (a) maps
+reshape-bitonic networks with no gathers. Like the reference, the arm is
+chosen from MEASUREMENTS: ``dispatch_select_impl`` consults the
+per-backend dispatch table (``raft_tpu.tuning``) and falls back to the
+analytic crossover projection only where the table has no entry. The entry point also (a) maps
 select-min onto top_k by negation and (b) carries pass-through source
 indices (the reference's ``in_idx``). A two-pass histogram-threshold
 variant is kept as ``select_k_threshold`` for callers wanting that
@@ -30,6 +33,7 @@ def select_k(
     in_idx=None,
     select_min: bool = True,
     sorted: bool = True,  # noqa: A002 - matches reference arg name
+    impl: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Select the k smallest (or largest) per row.
 
@@ -39,6 +43,7 @@ def select_k(
     in_idx : optional [batch, n] source indices carried with the values
         (defaults to 0..n-1 per row).
     select_min : True → smallest-k (the reference's SelectMinK).
+    impl : "auto" (measured dispatch, below) | "top_k" | "tournament".
 
     Returns (out_val [batch, k], out_idx [batch, k]).
     """
@@ -49,20 +54,19 @@ def select_k(
     batch, n = in_val.shape
     if not 0 < k <= n:
         raise ValueError(f"k={k} out of range for row length {n}")
-    # Dispatch (the reference's learned heuristic, select_k-inl.cuh:51-79):
-    # lax.top_k's full-row sort is near-optimal for small k, but its
-    # O(n log^2 n) compare-exchange cost loses badly once k is large and
-    # n >> k — the regime the reference serves with multi-pass radix
-    # select (select_radix.cuh:231,546). There the tournament network
-    # (sorted 2K blocks + log rounds of keep-smallest-2K pair merges,
-    # each round HALVING the data — the compaction) wins. The k>256 /
-    # n>=8K thresholds below are asymptotic-cost projections pending an
-    # on-chip crossover measurement (scripts/select_crossover.py emits
-    # the table; see BASELINE.md for the artifact once captured). Small
-    # k stays on the hardware top_k.
-    K = 1 << (int(k) - 1).bit_length()
-    if (k > 256 and n >= 8 * K
-            and jnp.issubdtype(in_val.dtype, jnp.floating)):
+    if impl not in ("auto", "top_k", "tournament"):
+        raise ValueError(
+            f"impl must be 'auto' | 'top_k' | 'tournament', got {impl!r}")
+    if impl == "tournament" and not jnp.issubdtype(in_val.dtype,
+                                                  jnp.floating):
+        # the tournament's merge space is f32 — forcing it onto integers
+        # would reintroduce the >2^24 ordering collapse the integer
+        # top_k path exists to avoid
+        raise ValueError(
+            f"impl='tournament' is float-only, got {in_val.dtype}")
+    if impl == "auto":
+        impl = dispatch_select_impl(batch, n, int(k), in_val.dtype)
+    if impl == "tournament":
         vals, idxs = _tournament_topk(in_val, int(k), bool(select_min))
     else:
         vals, idxs = _select_k(in_val, int(k), bool(select_min))
@@ -79,15 +83,58 @@ def select_k(
     return vals, idxs
 
 
+def dispatch_select_impl(batch: int, n: int, k: int, dtype,
+                         op: str = "select_k",
+                         fallback: Optional[str] = None) -> str:
+    """The measured selection dispatch (the reference's learned
+    heuristic, select_k-inl.cuh:51-79): consult the per-backend dispatch
+    table (``raft_tpu/tuning/tables/<backend>.json``, captured by
+    scripts/capture_dispatch_tables.py; see docs/dispatch_tuning.md)
+    through ``tuning.choose``. The analytic fallback — used on a table
+    miss or with RAFT_TPU_TUNING=off — keeps the asymptotic-cost
+    projection: lax.top_k's full-row sort is near-optimal for small k,
+    but its O(n log^2 n) compare-exchange cost loses to the tournament
+    network (sorted 2K blocks + log rounds of keep-smallest-2K pair
+    merges, each round HALVING the data — the compaction the reference
+    buys with multi-pass radix select, select_radix.cuh:231,546) once
+    k > 256 and n >= 8K. The tournament is float-only (its pad/merge
+    space is f32).
+
+    ``op`` lets callers with their own shape regime (merge_topk's
+    wide-batch candidate pools) look up a dedicated table section with
+    the same candidate constraints; ``fallback`` overrides the analytic
+    projection on a miss (merge_topk passes "auto" to defer to this
+    op's own dispatch at the inner select)."""
+    from raft_tpu import tuning
+
+    floating = jnp.issubdtype(dtype, jnp.floating)
+    candidates = ["top_k"] + (["tournament"] if floating else [])
+    if fallback is None:
+        K = 1 << (int(k) - 1).bit_length()
+        fallback = ("tournament" if k > 256 and n >= 8 * K and floating
+                    else "top_k")
+    return tuning.choose(
+        op,
+        {"n": int(n), "k": int(k), "batch": int(batch),
+         "dtype": jnp.dtype(dtype).name},
+        candidates, fallback,
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _select_k(in_val, k: int, select_min: bool):
     if select_min:
-        # top_k selects max; negate. Use where-safe negation for ints.
+        # top_k selects max; negate.
         if jnp.issubdtype(in_val.dtype, jnp.floating):
             vals, idxs = jax.lax.top_k(-in_val, k)
             return -vals, idxs.astype(jnp.int32)
-        vals, idxs = jax.lax.top_k(-in_val.astype(jnp.float32), k)
-        return jnp.take_along_axis(in_val, idxs, axis=1), idxs.astype(jnp.int32)
+        # Integers: bitwise NOT is the order-reversing map that stays in
+        # the integer domain — exact at every value (monotone decreasing
+        # for signed AND unsigned, no INT_MIN negation overflow, none of
+        # the f32 cast's precision loss above 2^24).
+        work = in_val.astype(jnp.int32) if in_val.dtype == jnp.bool_ else in_val
+        vals, idxs = jax.lax.top_k(~work, k)
+        return (~vals).astype(in_val.dtype), idxs.astype(jnp.int32)
     vals, idxs = jax.lax.top_k(in_val, k)
     return vals, idxs.astype(jnp.int32)
 

@@ -180,8 +180,10 @@ jax.tree_util.register_dataclass(
     meta_fields=["metric", "code_scale"],
 )
 
-# inline layout is skipped when the packed table exceeds this budget
-# (bytes); the scattered-gather search path is used instead
+# inline layout is skipped when the packed table's PER-SHARD residency
+# exceeds this budget (bytes); the scattered-gather search path is used
+# instead. Analytic default — the per-backend dispatch table can
+# override it ("cagra_inline_bytes", see raft_tpu.tuning)
 _INLINE_BUDGET = 6 << 30
 
 # queries per Pallas beam-step grid tile (the kernel's lane dimension)
@@ -246,14 +248,27 @@ def _inline_eligible(n: int, d: int, deg: int, need_norms: bool,
     """The one inline-layout gate shared by single-device _attach_inline
     and the sharded stacked build: dim word-alignment, packed-table
     budget (row bytes incl. per-region 128-lane padding), and the
-    (id<<1)|flag id-packing row bound."""
+    (id<<1)|flag id-packing row bound.
+
+    The budget applies to the PER-SHARD residency ``rows * row_bytes``
+    (``max_rows`` = rows per shard; search-time HBM holds one shard's
+    table), not the total ``n * row_bytes`` — an S-way mesh keeps the
+    fused beam kernel up to S times the single-chip scale, which is the
+    scale sharding exists for (ADVICE r5 finding 3). Single-device
+    callers pass no ``max_rows``, so rows == n and nothing changes. The
+    byte budget itself is tunable per backend
+    (``tuning.budget("cagra_inline_bytes")`` — captured from the
+    device's real HBM limit by scripts/capture_dispatch_tables.py;
+    analytic default ``_INLINE_BUDGET``)."""
+    from raft_tpu import tuning
     from raft_tpu.ops.beam_step import packed_row_layout
 
     if d % 4:
         return False
     row_bytes = 4 * packed_row_layout(deg, d, not need_norms)[3]
     rows = n if max_rows is None else max_rows
-    return n * row_bytes <= _INLINE_BUDGET and rows < (1 << 30)
+    budget = tuning.budget("cagra_inline_bytes", _INLINE_BUDGET)
+    return rows * row_bytes <= budget and rows < (1 << 30)
 
 
 def _code_scale(dataset) -> jax.Array:
@@ -1010,11 +1025,9 @@ def _resolve_beam_impl(requested: str, index: Index,
     # path (the documented SearchParams contract)
     if index.nbr_pack is None or compute_dtype != "auto":
         return "xla"
-    try:
-        platform = jax.devices()[0].platform.lower()
-    except Exception:  # noqa: BLE001 - backend probing must never fail search
-        platform = "cpu"
-    return "pallas" if platform in ("tpu", "axon") else "xla"
+    from raft_tpu import tuning
+
+    return "pallas" if tuning.backend_name() == "tpu" else "xla"
 
 
 def search_plan(search_params: SearchParams, k: int):

@@ -112,24 +112,35 @@ def merge_topk(
 
     The exact arm routes through ``matrix.select_k``, so large-k merges
     (k > 256, c >> k — CAGRA-build candidate selection, cross-probe
-    merges at high refine ratios) dispatch to the compacting tournament
-    instead of ``lax.top_k``'s full-row sort (the reference serves this
-    regime with radix select, matrix/detail/select_radix.cuh:231).
-    Tournament rows with fewer than k finite entries return id -1 — the
-    library-wide no-neighbor convention callers already mask on.
+    merges at high refine ratios) can dispatch to the compacting
+    tournament instead of ``lax.top_k``'s full-row sort (the reference
+    serves this regime with radix select,
+    matrix/detail/select_radix.cuh:231). The arm is picked from the
+    per-backend dispatch table under the dedicated ``merge_topk`` op key
+    (merge pools are wider-batch / shorter-row than raw selects, so they
+    get their own measured crossover); a table miss defers to
+    ``select_k``'s own dispatch. Tournament rows with fewer than k
+    finite entries return id -1 — the library-wide no-neighbor
+    convention callers already mask on.
     """
     if approx and k < dists.shape[-1]:
         fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
         vals, sel = fn(dists, k, recall_target=recall_target)
         return vals, jnp.take_along_axis(idxs, sel, axis=-1)
-    from raft_tpu.matrix.select_k import select_k
+    from raft_tpu.matrix.select_k import dispatch_select_impl, select_k
 
     shape = dists.shape
     reshaped = dists.ndim != 2
     if reshaped:
         dists = dists.reshape(-1, shape[-1])
         idxs = idxs.reshape(-1, shape[-1])
-    vals, out_i = select_k(dists, k, in_idx=idxs, select_min=select_min)
+    impl = dispatch_select_impl(
+        int(dists.shape[0]), int(dists.shape[-1]), int(k), dists.dtype,
+        op="merge_topk",
+        fallback="auto",  # miss -> select_k's own (table-driven) dispatch
+    )
+    vals, out_i = select_k(dists, k, in_idx=idxs, select_min=select_min,
+                           impl=impl)
     if reshaped:
         vals = vals.reshape(*shape[:-1], k)
         out_i = out_i.reshape(*shape[:-1], k)

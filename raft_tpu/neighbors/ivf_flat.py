@@ -667,7 +667,8 @@ def search(
     filt = as_filter(prefilter)
     bits = getattr(filt, "bitset", None)
     scan_impl = _resolve_scan_impl(
-        str(search_params.scan_impl), cap, min(int(k), cap)
+        str(search_params.scan_impl), cap, min(int(k), cap),
+        approx=float(search_params.local_recall_target) < 1.0,
     )
     if scan_impl.startswith("pallas") and k > n_probes * min(cap, 256):
         raise ValueError(
@@ -700,26 +701,33 @@ def search(
     )
 
 
-def _resolve_scan_impl(requested: str, cap: int, kl: int) -> str:
-    """Pick the scan backend: the fused Pallas kernel needs a TPU, a
-    lane-aligned list capacity and k <= 64 (exact in-kernel extraction)
-    — or k <= 256 on the approx path, where the R-deep lane binning
-    (ivf_scan._extract_topk_binned_deep) holds 512 candidates per list;
-    everything else runs the XLA bucketized scan."""
+def _resolve_scan_impl(requested: str, cap: int, kl: int,
+                       approx: bool = True) -> str:
+    """Pick the scan backend through the per-backend dispatch table
+    (``tuning.choose("ivf_scan", ...)`` — docs/dispatch_tuning.md). The
+    fused Pallas kernel is only a candidate on TPU with a lane-aligned
+    list capacity; the analytic fallback (table miss /
+    RAFT_TPU_TUNING=off) additionally requires k <= 64: the kernel's
+    R-deep binned extraction supports k <= 256 (force with
+    scan_impl="pallas"), but the k-pass unrolled extraction measured
+    ~7x slower end-to-end than the XLA path at k=130 (CAGRA
+    self-search, SIFT-100k). Everything else runs the XLA bucketized
+    scan."""
     if requested != "auto":
         return requested
-    try:
-        platform = jax.devices()[0].platform.lower()
-    except Exception:  # noqa: BLE001 - backend probing must never fail search
-        platform = "cpu"
-    on_tpu = platform in ("tpu", "axon")
-    # k > 64 stays on the XLA scan even though the kernel's R-deep binned
-    # extraction supports k <= 256 (force with scan_impl="pallas"): the
-    # k-pass unrolled extraction measured ~7x slower end-to-end than the
-    # XLA path at k=130 (CAGRA self-search, SIFT-100k)
-    if on_tpu and cap % 128 == 0 and kl <= 64:
-        return "pallas"
-    return "xla"
+    from raft_tpu import tuning
+
+    on_tpu = tuning.backend_name() == "tpu"
+    # kl <= 256 is structural (the kernel's per-list extraction budget,
+    # the reference's kMaxCapacity analog) — beyond it pallas is not a
+    # candidate no matter what the table interpolates
+    pallas_ok = on_tpu and cap % 128 == 0 and kl <= 256
+    candidates = ["xla"] + (["pallas"] if pallas_ok else [])
+    analytic = "pallas" if pallas_ok and kl <= 64 else "xla"
+    return tuning.choose(
+        "ivf_scan", {"cap": cap, "k": kl, "approx": bool(approx)},
+        candidates, analytic,
+    )
 
 
 # ---------------------------------------------------------------------------

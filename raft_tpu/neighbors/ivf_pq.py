@@ -95,9 +95,12 @@ class IndexParams:
     # auto-skipped above _CACHE_BUDGET bytes
     cache_decoded: bool = True
     # cache precision: "auto" picks int8 when it fits _CACHE_BUDGET and
-    # falls to packed int4 (0.5 B/component — the 100M-scale regime where
-    # int8 cannot share HBM with the codes) when that fits; "i8" / "i4"
-    # force a precision (still budget-gated)
+    # falls to a half-byte rung (0.5 B/component — the 100M-scale regime
+    # where int8 cannot share HBM with the codes) when that fits; which
+    # half-byte rung (packed int4 residuals vs pq4 codes, recall-tied at
+    # equal bytes) comes from the measured dispatch table
+    # (docs/dispatch_tuning.md), defaulting to int4. "i8" / "i4" / "pq4"
+    # force a kind (still budget-gated)
     cache_dtype: str = "auto"
 
     def __post_init__(self):
@@ -636,6 +639,35 @@ def build_streamed(
                 f"{n} rows x {index.rot_dim} rot dims (i4 additionally "
                 "needs cache_decoded=True and rot_dim % 8 == 0)"
             )
+        # An EXPLICIT cache_dtype passing only on the optimistic floor
+        # n*rot (C*cap >= n) with no cap_rows ceiling under budget can
+        # still miss after the hours-long labeling pass once list
+        # padding inflates C*cap past n — and unlike "auto" it has no
+        # i4 fallback to degrade to. Mirror _i8_may_miss's conservative
+        # <= 2x padding factor and warn up front (ADVICE r5 finding 4).
+        if cd != "auto" and not (_cap_bound is not None
+                                 and (_cap_bound if cd == "i8"
+                                      else _cap_bound // 2)
+                                 <= _CACHE_BUDGET):
+            floor = (n * index.rot_dim if cd == "i8"
+                     else n * index.rot_dim // 2)
+            if floor * 2 > _CACHE_BUDGET:
+                import warnings
+
+                warnings.warn(
+                    f"build_streamed(keep_codes=False, cache_dtype={cd!r}): "
+                    f"the padded {cd} cache fits _CACHE_BUDGET only if "
+                    "list padding stays under "
+                    f"{_CACHE_BUDGET / max(floor, 1):.2f}x the row floor — "
+                    "the build may fail AFTER the labeling pass. Set "
+                    "cap_rows to bound list capacity (or lower n_lists "
+                    "imbalance) to make feasibility decidable up front.",
+                    RuntimeWarning, stacklevel=2,
+                )
+                print("[build_streamed] WARNING: explicit "
+                      f"cache_dtype={cd!r} feasibility depends on list "
+                      "padding (floor*2 exceeds _CACHE_BUDGET); consider "
+                      "cap_rows", flush=True)
         if i4_can and not i8_can:
             # only i4 can fit: make sure its scales actually get computed
             # (the auto heuristic above may not have triggered)
@@ -1349,14 +1381,21 @@ def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
                     ) -> Optional[str]:
     """The budget/dtype ladder shared by batch and streamed builds.
 
-    "auto" is perf-first: i8 (1 matmul pass, 1 B/component) when it fits,
-    else packed i4 (1 pass, 0.5 B/component, slightly lossy). "pq4" — the
-    transposed packed-CODE scan at pq_bits=4 (exact PQ distances, 0.5
-    B/code, 16 MXU passes; see ops/ivf_scan one-hot contraction) — is
-    explicit opt-in: at equal bytes the i4 residual cache is ~16x cheaper
-    on the MXU, but pq4 is exact and the only fast path when pq_dim < dim
-    pushes compression below 0.5 B/dim (the reference's high-compression
-    regime, ivf_pq_compute_similarity-inl.cuh LUT scoring)."""
+    "auto" is fidelity-first at the top: i8 (1 matmul pass,
+    1 B/component, the finest cache) whenever it fits. Below the i8
+    budget the two half-byte rungs — packed i4 raw residuals (1 MXU
+    pass + in-kernel nibble decode, slightly lossy) and pq4 transposed
+    codes (exact PQ distances, 16-pass one-hot contraction) — measured
+    recall-TIED at equal bytes (EQUAL_BYTES_r05.json), so picking
+    between them is a pure throughput question: it goes through the
+    per-backend dispatch table (the measured ``pq_scan`` race,
+    docs/dispatch_tuning.md), with i4 as the analytic fallback (~16x
+    less MXU work per the projection; a table can overturn that where
+    the one-hot contraction's locality actually wins). pq4 stays the
+    explicit choice for pq_dim < dim compression below 0.5 B/dim —
+    the reference's high-compression regime
+    (ivf_pq_compute_similarity-inl.cuh LUT scoring) where no residual
+    cache can operate."""
     if not cache_decoded or cap == 0:
         return None
     i8_ok = C * cap * rot <= _CACHE_BUDGET
@@ -1365,7 +1404,20 @@ def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
               and pq_dim % 8 == 0
               and C * cap * pq_dim // 2 <= _CACHE_BUDGET)
     if cache_dtype == "auto":
-        return "i8" if i8_ok else ("i4" if i4_ok else None)
+        if i8_ok:
+            return "i8"
+        feasible = [kind for kind, ok in
+                    (("i4", i4_ok), ("pq4", pq4_ok)) if ok]
+        if not feasible:
+            return None
+        from raft_tpu import tuning
+
+        return tuning.choose(
+            "pq_scan",
+            {"n_lists": C, "cap": cap, "rot": rot, "pq_dim": pq_dim,
+             "pq_bits": pq_bits},
+            feasible, "i4" if i4_ok else None,
+        )
     if cache_dtype == "i8":
         return "i8" if i8_ok else None
     if cache_dtype == "i4":
@@ -1746,7 +1798,10 @@ def search(
     else:
         # cache-only indexes are fine on BOTH impls here: the XLA body
         # also scores from recon_cache when lut_dtype is auto/i8
-        impl = _resolve_scan_impl(requested, cap, min(k, cap))
+        impl = _resolve_scan_impl(
+            requested, cap, min(k, cap),
+            approx=float(search_params.local_recall_target) < 1.0,
+        )
         if impl.startswith("pallas") and k > n_probes * min(cap, 256):
             raise ValueError(
                 f"k={k} exceeds the fused kernel's candidate pool "

@@ -142,8 +142,8 @@ def _extract_topk_binned_deep(dist, ids_row, k: int, cap: int,
 
 def _scan_kernel(
     bl_ref, ls_ref, *refs,
-    k: int, metric_kind: int, approx: bool, has_norms: bool, has_filter: bool,
-    packed_i4: bool = False, packed_pq4: bool = False,
+    k: int, metric_kind: int, extract: str, has_norms: bool,
+    has_filter: bool, packed_i4: bool = False, packed_pq4: bool = False,
 ):
     refs = list(refs)
     storage_ref = refs.pop(0)
@@ -243,18 +243,14 @@ def _scan_kernel(
         valid = valid & (keep_ref[0, 0][None, :] > 0)
     dist = jnp.where(valid, dist, jnp.inf)
     ids_row = ids_ref[0, 0]                             # [cap] int32
-    if approx and cap % 128 == 0 and cap > 128 and k <= 64:
+    if extract == "binned":
         _extract_topk_binned(dist, ids_row, k, cap, outd_ref, outi_ref)
-    elif approx and cap % 128 == 0 and cap > 128 and k <= 256:
+    elif extract == "binned_deep":
         _extract_topk_binned_deep(dist, ids_row, k, cap, outd_ref, outi_ref)
     else:
         _extract_topk(dist, ids_row, k, outd_ref, outi_ref)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "metric_kind", "approx", "interpret", "packed_i4"),
-)
 def fused_list_scan_topk(
     storage,        # [C, cap, d] source dtype | [C, d//8, cap] u32 (packed_i4)
     indices,        # [C, cap] int32 stored global ids
@@ -271,6 +267,7 @@ def fused_list_scan_topk(
     approx: bool = True,
     interpret: bool = False,
     packed_i4: bool = False,
+    extract: str = None,
 ):
     """Scan each bucket's list block against its query group and return the
     per-pair top-k in min-space.
@@ -298,6 +295,56 @@ def fused_list_scan_topk(
     reconstruction norms. Distances equal the decode-then-matmul path's
     exactly (same codes, same codebook).
     """
+    # Extraction variant: the exact k-pass min sweep vs the lane-binned
+    # approximations (k <= 64 single-slot, k <= 256 R-deep). Eligibility
+    # is structural (approx opt-in, lane-aligned cap); within the
+    # eligible set the winner comes from the per-backend dispatch table
+    # ("ivf_scan_extract", captured by microbench.bench_scan_extract),
+    # analytic fallback = binned whenever legal (the k-pass sweep's
+    # unrolled extraction is the known slow arm). Resolved HERE, outside
+    # the jit boundary, so the choice participates in the jit cache key
+    # and mode/table changes take effect per call. An explicit
+    # ``extract`` bypasses the table (the microbench forcing each arm).
+    from raft_tpu import tuning
+
+    cap = (storage.shape[2] if (packed_i4 or lut_weights is not None)
+           else storage.shape[1])
+    binned_ok = approx and cap % 128 == 0 and cap > 128
+    eligible = ["exact"]
+    if binned_ok and k <= 64:
+        eligible.append("binned")
+    if binned_ok and k <= 256:
+        eligible.append("binned_deep")
+    if extract is None:
+        analytic = ("binned" if binned_ok and k <= 64
+                    else "binned_deep" if binned_ok and k <= 256
+                    else "exact")
+        extract = tuning.choose(
+            "ivf_scan_extract",
+            {"cap": int(cap), "k": int(k), "g": int(qv.shape[1])},
+            eligible, analytic,
+        )
+    elif extract not in eligible:
+        raise ValueError(
+            f"extract={extract!r} not eligible here (allowed: {eligible})")
+    return _fused_list_scan_topk(
+        storage, indices, list_sizes, bucket_list, qv, qaux, norms, keep,
+        lut_weights, k=k, metric_kind=metric_kind, interpret=interpret,
+        packed_i4=packed_i4, extract=extract,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric_kind", "interpret", "packed_i4",
+                     "extract"),
+)
+def _fused_list_scan_topk(
+    storage, indices, list_sizes, bucket_list, qv, qaux=None, norms=None,
+    keep=None, lut_weights=None, *,
+    k: int, metric_kind: int, interpret: bool = False,
+    packed_i4: bool = False, extract: str = "exact",
+):
     packed_pq4 = lut_weights is not None
     if packed_pq4 and packed_i4:
         raise ValueError("packed_i4 and lut_weights are mutually exclusive")
@@ -355,7 +402,7 @@ def fused_list_scan_topk(
 
     kernel = functools.partial(
         _scan_kernel,
-        k=k, metric_kind=metric_kind, approx=approx,
+        k=k, metric_kind=metric_kind, extract=extract,
         has_norms=has_norms, has_filter=has_filter, packed_i4=packed_i4,
         packed_pq4=packed_pq4,
     )

@@ -1,0 +1,211 @@
+"""Measurement-driven dispatch for the select/scan/merge hot paths.
+
+The reference library chooses between its radix and warpsort ``select_k``
+backends with a heuristic *learned from benchmark measurements*
+(matrix/detail/select_k-inl.cuh:51-79). This package is the TPU analog,
+generalized to every hot-path dispatch the repo used to hard-code:
+
+* ``select_k``   — hardware ``lax.top_k`` vs the compacting tournament
+* ``merge_topk`` — the cross-probe/parts merge's selection backend
+* ``ivf_scan``   — fused Pallas list scan vs the XLA bucketized scan
+* ``pq_scan``    — IVF-PQ cache/scoring kind (i8 / i4 / pq4 one-hot)
+* budgets        — e.g. CAGRA's inline packed-table byte budget
+
+Consumers call ``choose(op, key, candidates, fallback)`` with a static
+shape key; the answer comes from a **persisted per-backend table** of
+microbenchmark measurements (``tables/<backend>.json``, captured by
+``scripts/capture_dispatch_tables.py``), falling back to the caller's
+analytic projection when no measurement covers the key. Behavior is
+frozen with ``RAFT_TPU_TUNING``:
+
+    RAFT_TPU_TUNING=off       always use the analytic fallback
+    RAFT_TPU_TUNING=table     consult the persisted table (default)
+    RAFT_TPU_TUNING=measure   table mode + measure cheap ops (select_k /
+                              merge_topk) on first use at uncovered keys,
+                              caching the winner in-process
+
+``RAFT_TPU_TUNING_TABLE=/path.json`` overrides the packaged table — the
+user-writable slot for site-captured tables (point
+``capture_dispatch_tables.py --out`` there).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from raft_tpu.tuning.table import DispatchTable
+
+_MODES = ("off", "table", "measure")
+
+# ops cheap enough to measure synchronously at first use in "measure"
+# mode; scan-path ops need an index built around them — capture those
+# with scripts/capture_dispatch_tables.py instead
+MEASURABLE_INLINE = ("select_k", "merge_topk")
+
+_lock = threading.Lock()
+_mode_override: Optional[str] = None
+_table_path_override: Optional[str] = None
+_table_cache: Dict[str, Optional[DispatchTable]] = {}
+_measured: Dict = {}
+
+
+def mode() -> str:
+    """Active tuning mode: the ``set_mode`` override if any, else
+    ``RAFT_TPU_TUNING`` (default "table")."""
+    if _mode_override is not None:
+        return _mode_override
+    m = os.environ.get("RAFT_TPU_TUNING", "table").strip().lower()
+    return m if m in _MODES else "table"
+
+
+def set_mode(m: Optional[str]) -> None:
+    """Override the env knob in-process (None restores env control)."""
+    global _mode_override
+    if m is not None and m not in _MODES:
+        raise ValueError(f"mode must be one of {_MODES}, got {m!r}")
+    _mode_override = m
+
+
+def backend_name() -> str:
+    """Table filename stem for the active backend. The axon-tunnelled
+    TPU is still a TPU for dispatch purposes."""
+    try:
+        import jax
+
+        p = jax.devices()[0].platform.lower()
+    except Exception:  # noqa: BLE001 - dispatch must never fail a search
+        return "cpu"
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+def tables_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tables")
+
+
+def table_path() -> Optional[str]:
+    """Resolved table path: ``set_table_path`` override, then
+    ``RAFT_TPU_TUNING_TABLE``, then the packaged per-backend table.
+    None when none of those files exist."""
+    if _table_path_override is not None:
+        return _table_path_override
+    env = os.environ.get("RAFT_TPU_TUNING_TABLE", "").strip()
+    if env:
+        return env
+    packaged = os.path.join(tables_dir(), backend_name() + ".json")
+    return packaged if os.path.exists(packaged) else None
+
+
+def set_table_path(path: Optional[str]) -> None:
+    """Point dispatch at a specific table file (None restores the
+    default resolution) and drop the cache."""
+    global _table_path_override
+    _table_path_override = path
+    reload()
+
+
+def reload() -> None:
+    """Drop the cached table and in-process measurements (tests, or
+    after re-capturing a table)."""
+    with _lock:
+        _table_cache.clear()
+        _measured.clear()
+
+
+def get_table() -> Optional[DispatchTable]:
+    """The active DispatchTable, or None when no table file resolves or
+    the file is unreadable (dispatch then always falls back)."""
+    path = table_path()
+    if path is None:
+        return None
+    with _lock:
+        if path not in _table_cache:
+            try:
+                _table_cache[path] = DispatchTable.load(path)
+            except Exception:  # noqa: BLE001 - bad table == no table
+                _table_cache[path] = None
+        return _table_cache[path]
+
+
+def _tracing() -> bool:
+    """True while under a jax trace — measure mode must not launch
+    microbenchmarks from inside someone else's jit."""
+    try:
+        import jax
+
+        return not jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _freeze_key(op: str, key: Dict) -> tuple:
+    return (op,) + tuple(sorted(key.items()))
+
+
+def _measure_inline(op: str, key: Dict,
+                    candidates: List[str]) -> Optional[str]:
+    fk = _freeze_key(op, key)
+    with _lock:
+        if fk in _measured:
+            return _measured[fk]
+    try:
+        from raft_tpu.tuning import microbench
+
+        times = microbench.measure_op(op, key, candidates)
+        winner = min(times, key=times.get) if times else None
+    except Exception:  # noqa: BLE001 - measurement failure => fallback
+        winner = None
+    with _lock:
+        _measured[fk] = winner
+    return winner
+
+
+def choose(op: str, key: Dict, candidates: List[str],
+           fallback: Optional[str]) -> Optional[str]:
+    """Pick an implementation for ``op`` at static shape ``key``.
+
+    ``candidates`` is the ELIGIBLE set at this call site (dtype/layout
+    constraints already applied); a table winner outside it is ignored.
+    ``fallback`` is the caller's analytic projection — returned verbatim
+    in ``off`` mode, on a table miss, or on any error. ``key`` values
+    must be static python scalars (shapes at trace time are), so a
+    choice is a pure trace-time decision.
+    """
+    m = mode()
+    if m == "off" or not candidates:
+        return fallback
+    t = get_table()
+    if t is not None:
+        w = t.lookup(op, key, candidates)
+        if w in candidates:
+            return w
+    # only genuinely UNCOVERED keys get measured in measure mode — a
+    # persisted measurement always wins over an ad-hoc in-process one
+    if (m == "measure" and op in MEASURABLE_INLINE and len(candidates) > 1
+            and not _tracing()):
+        w = _measure_inline(op, key, candidates)
+        if w in candidates:
+            return w
+    return fallback
+
+
+def budget(name: str, default: int) -> int:
+    """A tuned byte budget (e.g. ``cagra_inline_bytes``), or ``default``
+    when tuning is off or the table has no entry."""
+    if mode() == "off":
+        return int(default)
+    t = get_table()
+    if t is not None:
+        v = t.budget(name)
+        if v is not None:
+            return v
+    return int(default)
+
+
+__all__ = [
+    "DispatchTable", "MEASURABLE_INLINE", "backend_name", "budget",
+    "choose", "get_table", "mode", "reload", "set_mode",
+    "set_table_path", "table_path", "tables_dir",
+]

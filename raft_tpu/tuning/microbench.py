@@ -1,0 +1,412 @@
+"""Microbenchmark harness behind the dispatch tables.
+
+Times the competing implementations behind each hot-path dispatch over a
+grid of static shape keys and records the winners into a
+``DispatchTable`` (the measurement half of the reference's learned
+``select_k`` heuristic, matrix/detail/select_k-inl.cuh:51-79 /
+cpp/scripts/heuristics/select_k). Ops:
+
+``select_k`` / ``merge_topk``
+    ``lax.top_k`` (hardware sort) vs the compacting tournament network,
+    at selection shapes (large n, moderate k) and merge shapes
+    (n = n_probes x kl candidate pools) respectively. Cheap — also run
+    inline by ``RAFT_TPU_TUNING=measure``.
+``ivf_scan``
+    end-to-end IVF-Flat search with the fused Pallas list-scan kernel vs
+    the XLA bucketized scan (key: cap, k, approx).
+``ivf_scan_extract``
+    the kernel's in-kernel extraction arms raced head-to-head (exact
+    k-pass sweep vs lane-binned vs R-deep binned) by forcing each via
+    ``fused_list_scan_topk(extract=...)``; TPU-only by default (the
+    kernel's compile target).
+``pq_scan``
+    end-to-end IVF-PQ search per cache kind — i8 decoded residuals
+    (1 MXU pass), packed-i4 raw residuals (1 pass, in-kernel nibble
+    decode), pq4 transposed codes (16-pass one-hot contraction). Only
+    the recall-tied half-byte rungs (i4/pq4) compete for
+    ``cache_dtype="auto"``'s sub-i8-budget slot (``_cache_kind_for``
+    keeps the finest rung whenever it fits); i8's time is captured for
+    the record.
+
+Index-building ops (ivf_scan, pq_scan) are only captured by
+``scripts/capture_dispatch_tables.py``; measuring them at dispatch time
+would build an index inside a search call.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DEF_REPS = 5
+
+
+def _median_ms(fn, reps: int = _DEF_REPS) -> float:
+    """Median wall-clock ms of ``fn()`` after one warmup (compile) call.
+    ``fn`` must return jax arrays; completion is forced per rep."""
+    import jax
+
+    jax.block_until_ready(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts))
+
+
+def _rand(shape, dtype, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+    return jax.block_until_ready(x.astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# select_k / merge_topk: top_k vs tournament
+# ---------------------------------------------------------------------------
+
+
+def select_candidates(key: Dict) -> List[str]:
+    """Eligible select_k implementations at ``key`` (mirrors the
+    constraints in matrix/select_k.py): the tournament is float-only and
+    needs k <= n."""
+    cands = ["top_k"]
+    dtype = str(key.get("dtype", "float32"))
+    if dtype.startswith(("float", "bfloat")):
+        cands.append("tournament")
+    return cands
+
+
+def bench_select(key: Dict, candidates: Optional[List[str]] = None,
+                 reps: int = _DEF_REPS) -> Dict[str, float]:
+    """Time the select_k implementations at ``key``
+    ({n, k, batch, dtype}); returns {candidate: median_ms}."""
+    import jax.numpy as jnp
+
+    from raft_tpu.matrix.select_k import _select_k, _tournament_topk
+
+    n = int(key["n"])
+    k = int(key["k"])
+    batch = int(key.get("batch", 64))
+    dtype = jnp.dtype(key.get("dtype", "float32"))
+    if candidates is None:
+        candidates = select_candidates(key)
+    x = _rand((batch, n), dtype)
+    times: Dict[str, float] = {}
+    if "top_k" in candidates:
+        times["top_k"] = _median_ms(lambda: _select_k(x, k, True), reps)
+    if "tournament" in candidates:
+        times["tournament"] = _median_ms(
+            lambda: _tournament_topk(x, k, True), reps
+        )
+    return times
+
+
+# ---------------------------------------------------------------------------
+# ivf_scan: fused Pallas kernel vs XLA bucketized scan
+# ---------------------------------------------------------------------------
+
+# shared small-but-representative search workload for the end-to-end ops
+_SCAN_N = 20_000
+_SCAN_D = 64
+_SCAN_M = 512
+
+
+def _scan_dataset(n=_SCAN_N, d=_SCAN_D, m=_SCAN_M):
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((m, d)).astype(np.float32)
+    return data, queries
+
+
+def bench_ivf_scan(key: Dict, candidates: List[str],
+                   reps: int = _DEF_REPS):
+    """Time end-to-end IVF-Flat search per scan impl at ``key``
+    ({k, approx, ...}). Candidates: "xla" | "pallas" |
+    "pallas_interpret" (CPU-debug kernel — orders of magnitude slower
+    than compiled, only meaningful relative to itself). Returns
+    (times, key) with the key enriched by the built index's list
+    capacity — the field ``_resolve_scan_impl`` looks up by."""
+    from raft_tpu.neighbors import ivf_flat
+
+    key = dict(key)
+    k = int(key.get("k", 10))
+    n_lists = int(key.get("n_lists", 64))
+    n_probes = int(key.get("n_probes", 8))
+    approx = bool(key.get("approx", True))
+    data, queries = _scan_dataset(n=int(key.get("n", _SCAN_N)))
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4), data
+    )
+    key["cap"] = int(index.storage.shape[1])
+    times: Dict[str, float] = {}
+    for impl in candidates:
+        sp = ivf_flat.SearchParams(
+            n_probes=n_probes, scan_impl=impl,
+            local_recall_target=0.95 if approx else 1.0,
+        )
+        try:
+            times[impl] = _median_ms(
+                lambda sp=sp: ivf_flat.search(sp, index, queries, k), reps
+            )
+        except Exception:  # noqa: BLE001 - impl unavailable on backend
+            continue
+    return times, key
+
+
+def bench_scan_extract(key: Dict, candidates: Optional[List[str]] = None,
+                       reps: int = _DEF_REPS,
+                       interpret: bool = False) -> Dict[str, float]:
+    """Time the fused kernel's in-kernel extraction variants directly
+    (exact k-pass sweep vs lane-binned vs R-deep binned) by forcing each
+    arm through ``fused_list_scan_topk(extract=...)`` on a synthetic
+    list-block workload. ``interpret`` runs the kernel in interpret mode
+    (CPU debug — numbers only meaningful relative to each other)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.ops import ivf_scan
+
+    k = int(key.get("k", 10))
+    cap = int(key.get("cap", 512))
+    G = int(key.get("g", 64))
+    C = int(key.get("n_lists", 8))
+    d = int(key.get("d", 64))
+    nb = int(key.get("nb", 16))
+    if candidates is None:
+        candidates = ["exact"]
+        if cap % 128 == 0 and cap > 128:
+            if k <= 64:
+                candidates.append("binned")
+            if k <= 256:
+                candidates.append("binned_deep")
+    storage = _rand((C, cap, d), jnp.float32, seed=1)
+    qv = _rand((nb, G, d), jnp.bfloat16, seed=2)
+    import jax
+
+    indices = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32)[None],
+                               (C, cap))
+    sizes = jnp.full((C,), cap, jnp.int32)
+    buckets = (jnp.arange(nb, dtype=jnp.int32) % C)
+    qaux = jnp.sum(qv.astype(jnp.float32) ** 2, axis=2)
+    norms = jnp.sum(storage.astype(jnp.float32) ** 2, axis=2)
+    jax.block_until_ready((indices, qaux, norms))
+    times: Dict[str, float] = {}
+    for arm in candidates:
+        try:
+            times[arm] = _median_ms(
+                lambda arm=arm: ivf_scan.fused_list_scan_topk(
+                    storage, indices, sizes, buckets, qv, qaux, norms,
+                    None, k=k, metric_kind=ivf_scan.L2,
+                    approx=arm != "exact", interpret=interpret,
+                    extract=arm,
+                ), reps)
+        except Exception:  # noqa: BLE001 - arm unavailable on backend
+            continue
+    return times
+
+
+def bench_pq_scan(key: Dict, candidates: List[str],
+                  reps: int = _DEF_REPS):
+    """Time end-to-end IVF-PQ search per cache kind at ``key``. The
+    build uses pq_bits=4 so all three kinds (i8/i4/pq4) are feasible on
+    one quantizer config; search runs with lut_dtype="auto" (cache scan
+    — the path the choice governs). Returns (times, key) with the key
+    enriched by the built geometry (cap/rot/pq_bits — the fields
+    ``_cache_kind_for`` looks up by)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    key = dict(key)
+    k = int(key.get("k", 10))
+    n_lists = int(key.get("n_lists", 64))
+    n_probes = int(key.get("n_probes", 8))
+    pq_dim = int(key.get("pq_dim", 32))
+    data, queries = _scan_dataset(n=int(key.get("n", _SCAN_N)))
+    times: Dict[str, float] = {}
+    for kind in ("i8", "i4", "pq4"):
+        if kind not in candidates:
+            continue
+        params = ivf_pq.IndexParams(
+            n_lists=n_lists, pq_bits=4, pq_dim=pq_dim, kmeans_n_iters=4,
+            cache_decoded=True, cache_dtype=kind,
+        )
+        try:
+            index = ivf_pq.build(params, data)
+            if index.cache_kind != kind:
+                continue  # budget-gated out: not a competitor here
+            key.setdefault("cap", int(index.indices.shape[1]))
+            key.setdefault("rot", int(index.rot_dim))
+            key.setdefault("pq_bits", 4)
+            sp = ivf_pq.SearchParams(n_probes=n_probes)
+            times[kind] = _median_ms(
+                lambda sp=sp, ix=index: ivf_pq.search(sp, ix, queries, k),
+                reps,
+            )
+        except Exception:  # noqa: BLE001 - kind unavailable on backend
+            continue
+    return times, key
+
+
+# ---------------------------------------------------------------------------
+# inline measurement (RAFT_TPU_TUNING=measure) + capture grids
+# ---------------------------------------------------------------------------
+
+
+def measure_op(op: str, key: Dict,
+               candidates: List[str]) -> Dict[str, float]:
+    """Measure one (op, key) synchronously — only the cheap selection
+    ops; the index-building ops raise (capture those with
+    scripts/capture_dispatch_tables.py)."""
+    if op in ("select_k", "merge_topk"):
+        return bench_select(key, candidates, reps=3)
+    raise ValueError(
+        f"op {op!r} cannot be measured inline; run "
+        "scripts/capture_dispatch_tables.py"
+    )
+
+
+def select_grid(quick: bool = True) -> List[Dict]:
+    """(n, k, batch) grid for the select_k op — spans the projected
+    crossover region (k ~ 256, n >= 8K)."""
+    ns = [8_192, 65_536] if quick else [8_192, 65_536, 262_144]
+    ks = [64, 256, 1024] if quick else [64, 256, 1024, 4096]
+    batches = [64] if quick else [16, 64, 256]
+    grid = []
+    for n in ns:
+        for k in ks:
+            if k * 4 > n:
+                continue
+            for b in batches:
+                grid.append({"n": n, "k": k, "batch": b,
+                             "dtype": "float32"})
+    return grid
+
+
+def merge_grid(quick: bool = True) -> List[Dict]:
+    """(c, k, batch) grid for merge_topk — candidate pools are
+    n_probes x kl wide and batch is the query count, so the regime is
+    wider-batch / narrower-n than select_k's."""
+    grid = []
+    shapes = ([(1280, 10), (8192, 64), (16384, 512)] if quick else
+              [(1280, 10), (2560, 32), (8192, 64), (8192, 512),
+               (16384, 512), (32768, 1024)])
+    for c, k in shapes:
+        for b in ([256] if quick else [64, 256, 1024]):
+            grid.append({"n": c, "k": k, "batch": b, "dtype": "float32"})
+    return grid
+
+
+def scan_grid(quick: bool = True) -> List[Dict]:
+    del quick
+    # the k=130 exact row covers the known pallas weak spot (the k-pass
+    # unrolled extraction measured ~7x slower than XLA at k=130) so the
+    # table's interpolation radius cannot route mid-k exact searches
+    # onto an unmeasured arm
+    return [{"n": _SCAN_N, "k": 10, "approx": True, "n_lists": 64,
+             "n_probes": 8},
+            {"n": _SCAN_N, "k": 64, "approx": False, "n_lists": 64,
+             "n_probes": 8},
+            {"n": _SCAN_N, "k": 130, "approx": False, "n_lists": 64,
+             "n_probes": 8}]
+
+
+def pq_grid(quick: bool = True) -> List[Dict]:
+    del quick
+    return [{"n": _SCAN_N, "k": 10, "pq_dim": 32, "n_lists": 64,
+             "n_probes": 8}]
+
+
+def extract_grid(quick: bool = True) -> List[Dict]:
+    ks = [10, 64, 130] if quick else [10, 32, 64, 130, 256]
+    return [{"cap": 512, "k": k, "g": 64, "n_lists": 8, "d": 64,
+             "nb": 16} for k in ks]
+
+
+def default_budgets() -> Dict[str, int]:
+    """Measured-environment byte budgets. The CAGRA inline budget tracks
+    the device HBM actually present (packed table + dataset + transients
+    must co-reside: cap at ~40% of the per-device byte limit), falling
+    back to the analytic default when the backend doesn't report one."""
+    from raft_tpu.neighbors.cagra import _INLINE_BUDGET
+
+    budget = _INLINE_BUDGET
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            budget = int(limit * 0.4)
+    except Exception:  # noqa: BLE001 - no stats on this backend
+        pass
+    return {"cagra_inline_bytes": int(budget)}
+
+
+def capture(backend: Optional[str] = None, quick: bool = True,
+            include_interpret: bool = False, reps: int = _DEF_REPS,
+            ops: Optional[List[str]] = None, verbose: bool = True):
+    """Run the full grid and return a populated DispatchTable."""
+    import jax
+
+    from raft_tpu import tuning
+    from raft_tpu.tuning.table import TABLE_VERSION, DispatchTable
+
+    backend = backend or tuning.backend_name()
+    on_tpu = backend == "tpu"
+    t = DispatchTable({
+        "version": TABLE_VERSION,
+        "backend": backend,
+        "captured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device": str(jax.devices()[0]),
+        "ops": {},
+        "budgets": {},
+    })
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    want = set(ops) if ops else {"select_k", "merge_topk", "ivf_scan",
+                                 "pq_scan", "ivf_scan_extract"}
+    if "select_k" in want:
+        for key in select_grid(quick):
+            times = bench_select(key, reps=reps)
+            log(f"select_k {key} -> {t.record('select_k', key, times)} "
+                f"{times}")
+    if "merge_topk" in want:
+        for key in merge_grid(quick):
+            times = bench_select(key, select_candidates(key), reps=reps)
+            log(f"merge_topk {key} -> "
+                f"{t.record('merge_topk', key, times)} {times}")
+    scan_cands = ["xla"] + (["pallas"] if on_tpu else
+                            ["pallas_interpret"] if include_interpret
+                            else [])
+    if "ivf_scan" in want:
+        for key in scan_grid(quick):
+            times, key = bench_ivf_scan(key, scan_cands, reps=reps)
+            if times:
+                log(f"ivf_scan {key} -> "
+                    f"{t.record('ivf_scan', key, times)} {times}")
+    if "pq_scan" in want:
+        for key in pq_grid(quick):
+            times, key = bench_pq_scan(key, ["i8", "i4", "pq4"], reps=reps)
+            if times:
+                log(f"pq_scan {key} -> "
+                    f"{t.record('pq_scan', key, times)} {times}")
+    # in-kernel extraction arms: the kernel only compiles on TPU, so the
+    # CPU capture records this op solely under --interpret (debug-only
+    # relative numbers); a CPU table without it falls back analytically,
+    # which is correct — the choice never fires off-TPU
+    if "ivf_scan_extract" in want and (on_tpu or include_interpret):
+        for key in extract_grid(quick):
+            times = bench_scan_extract(key, reps=reps,
+                                       interpret=not on_tpu)
+            if times:
+                log(f"ivf_scan_extract {key} -> "
+                    f"{t.record('ivf_scan_extract', key, times)} {times}")
+    for name, val in default_budgets().items():
+        t.set_budget(name, val)
+    return t

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Capture the per-backend dispatch table (the measurement artifact the
+reference generates with cpp/scripts/heuristics/select_k and bakes into
+matrix/detail/select_k-inl.cuh:51-79).
+
+Times the competing implementations behind every tuned hot-path
+dispatch — select_k / merge_topk (lax.top_k vs tournament), ivf_scan
+(fused Pallas kernel vs XLA bucketized scan), pq_scan (i8/i4/pq4 cache
+kinds) — over a shape grid, plus the environment byte budgets, and
+writes ``raft_tpu/tuning/tables/<backend>.json``. Consumers pick these
+winners up automatically through ``raft_tpu.tuning.choose`` (knob:
+``RAFT_TPU_TUNING``; docs/dispatch_tuning.md).
+
+Run on CPU today (committed table), re-run the moment a TPU answers —
+it is part of the r5+ measurement battery (scripts/r5_measure_all.py).
+
+    python scripts/capture_dispatch_tables.py                # quick grid
+    python scripts/capture_dispatch_tables.py --full         # wide grid
+    python scripts/capture_dispatch_tables.py --out /path.json
+    python scripts/capture_dispatch_tables.py --ops select_k,merge_topk
+    python scripts/capture_dispatch_tables.py --interpret    # time the
+        # pallas kernel in interpret mode on CPU (debug-only numbers)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="output path (default: the packaged "
+                         "raft_tpu/tuning/tables/<backend>.json)")
+    ap.add_argument("--backend", default=None,
+                    help="override the table's backend name")
+    ap.add_argument("--full", action="store_true",
+                    help="wide grid (quick grid is the default)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--ops", default=None,
+                    help="comma list: select_k,merge_topk,ivf_scan,"
+                         "pq_scan,ivf_scan_extract (extract arms need a "
+                         "TPU, or --interpret on CPU)")
+    ap.add_argument("--interpret", action="store_true",
+                    help="on CPU, also time the Pallas kernels in "
+                         "interpret mode (debug-only numbers)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from raft_tpu import tuning
+    from raft_tpu.tuning import microbench
+
+    backend = args.backend or tuning.backend_name()
+    print(f"devices: {jax.devices()}  backend table: {backend}",
+          flush=True)
+    table = microbench.capture(
+        backend=backend,
+        quick=not args.full,
+        include_interpret=args.interpret,
+        reps=args.reps,
+        ops=args.ops.split(",") if args.ops else None,
+    )
+    out = args.out or os.path.join(tuning.tables_dir(), backend + ".json")
+    table.save(out)
+    print(f"wrote {out}: ops={table.ops()} entries={table.n_entries()} "
+          f"budgets={table.data['budgets']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

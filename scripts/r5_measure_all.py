@@ -12,6 +12,7 @@ Stages (artifact, rough budget):
   4. r4_sweep         — SWEEP_r05.json         (~25 min, flat+cagra levers)
   5. latency_table    — LATENCY_r05.json       (~10 min, batch 1/10/100)
   6. select_crossover — SELECT_CROSSOVER_r05.json (~10 min)
+  7. dispatch_tables  — raft_tpu/tuning/tables/tpu.json (~15 min)
 
 Run: python scripts/r5_measure_all.py [--only stage1,stage2] [--skip ...]
 Progress + per-stage rc stream to stdout and R5_MEASURE_STATUS.json.
@@ -41,6 +42,11 @@ STAGES = [
     ("sweep", [PY, "scripts/r4_sweep.py", "both"], 3600),
     ("latency", [PY, "scripts/latency_table.py"], 1800),
     ("crossover", [PY, "scripts/select_crossover.py"], 1800),
+    # per-backend dispatch table (select/merge/scan winners + budgets):
+    # writes raft_tpu/tuning/tables/tpu.json the instant a chip answers —
+    # commit the artifact so tuning.choose serves measured winners
+    ("dispatch_tables",
+     [PY, "scripts/capture_dispatch_tables.py", "--full"], 1800),
 ]
 
 

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from raft_tpu.comms.compat import shard_map
 
 from raft_tpu.comms import Comms, local_handle, sharded_knn, sharded_pairwise_distance
 from tests.oracles import eval_recall, naive_knn, naive_pairwise
@@ -301,7 +301,7 @@ def test_comms_session_registry(eight_device_mesh):
     raft-dask Comms, raft_dask/common/comms.py:173,248,269)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from raft_tpu.comms.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from raft_tpu.comms import CommsSession, get_comm_state, session_handle
 

@@ -30,7 +30,7 @@ _WORKER = textwrap.dedent(
 
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from raft_tpu.comms.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     devs = np.array(jax.devices())

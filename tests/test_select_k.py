@@ -32,6 +32,38 @@ def test_select_k_with_in_idx(rng):
         assert set(idxs[b].tolist()) <= set(src[b].tolist())
 
 
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_integer_exact_above_2p24(select_min):
+    """ADVICE r5 finding 1: the integer select-min path used to cast to
+    f32 before top_k, collapsing adjacent values above 2^24 (2^24+1
+    rounds onto 2^24). The integer-domain bitwise-NOT mapping is exact
+    everywhere, including INT32_MIN (whose two's-complement negation
+    overflows)."""
+    base = 1 << 24
+    x = np.array(
+        [[base + 3, base + 1, base + 2, base, -base - 1, -base - 2,
+          -(2**31), 2**31 - 1, 0]], np.int32,
+    )
+    k = 4
+    v, i = select_k(jnp.asarray(x), k, select_min=select_min)
+    v, i = np.asarray(v), np.asarray(i)
+    srt = np.sort(x, axis=1)
+    want = srt[:, :k] if select_min else srt[:, ::-1][:, :k]
+    np.testing.assert_array_equal(v, want)
+    np.testing.assert_array_equal(np.take_along_axis(x, i, axis=1), v)
+    assert v.dtype == x.dtype
+
+
+def test_select_k_unsigned_min():
+    """Unsigned select-min through the same bitwise-NOT order reversal
+    (~x = UINT_MAX - x): exact at full-range values."""
+    x = np.array([[2**32 - 1, (1 << 24) + 1, (1 << 24) + 2, 7, 0]],
+                 np.uint32)
+    v, i = select_k(jnp.asarray(x), 3, select_min=True)
+    np.testing.assert_array_equal(np.asarray(v), [[0, 7, (1 << 24) + 1]])
+    assert np.asarray(v).dtype == x.dtype
+
+
 def test_select_k_1d(rng):
     x = rng.standard_normal(64).astype(np.float32)
     vals, idxs = select_k(x, 4)
@@ -88,11 +120,18 @@ def test_merge_topk_routes_large_k_through_tournament(monkeypatch):
     """VERDICT r4 #5: the large-k dispatch must be reachable from a real
     library path — brute_force.knn's exact merge at k=512 over 8k rows
     lands in _tournament_topk (the radix-select-analog regime,
-    select_radix.cuh:231), with ids agreeing with the numpy oracle."""
+    select_radix.cuh:231), with ids agreeing with the numpy oracle.
+    Runs with RAFT_TPU_TUNING=off: this pins the ANALYTIC projection's
+    routing (the measured CPU table legitimately prefers top_k — the
+    whole point of measuring)."""
     import importlib
+
+    from raft_tpu import tuning
 
     sk = importlib.import_module("raft_tpu.matrix.select_k")
     from raft_tpu.neighbors import brute_force
+
+    monkeypatch.setattr(tuning, "_mode_override", "off")
 
     calls = []
     orig = sk._tournament_topk

@@ -1,0 +1,357 @@
+"""Tuning subsystem: dispatch-table round-trip, mode knob, consumer
+wiring, and the per-shard CAGRA inline-eligibility budget.
+
+The reference's select_k backend choice is a decision tree learned from
+measurements (matrix/detail/select_k-inl.cuh:51-79); these tests pin the
+TPU analog's machinery — measure -> persist -> load -> choose returns
+the measured winner, analytic fallback on a miss — without depending on
+which arm actually wins on this host's hardware.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu import tuning
+from raft_tpu.tuning.table import DispatchTable
+
+
+@pytest.fixture(autouse=True)
+def _isolated_tuning(monkeypatch, tmp_path):
+    """Every test starts with no mode override and no table resolved
+    (packaged tables and RAFT_TPU_TUNING* env must not leak in)."""
+    monkeypatch.delenv("RAFT_TPU_TUNING", raising=False)
+    monkeypatch.delenv("RAFT_TPU_TUNING_TABLE", raising=False)
+    monkeypatch.setattr(tuning, "_mode_override", None)
+    missing = str(tmp_path / "missing.json")
+    monkeypatch.setattr(tuning, "_table_path_override", missing)
+    tuning.reload()
+    yield
+    tuning.reload()
+
+
+def _write_table(path, op, entries, budgets=None):
+    t = DispatchTable()
+    for key, times in entries:
+        t.record(op, key, times)
+    for name, val in (budgets or {}).items():
+        t.set_budget(name, val)
+    t.save(str(path))
+    return t
+
+
+# ---------------------------------------------------------------------------
+# table round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_measure_persist_load_choose_round_trip(tmp_path):
+    """The full loop: measure the real implementations, persist the
+    winner, reload from JSON, and have tuning.choose return exactly the
+    measured winner at that key."""
+    from raft_tpu.tuning import microbench
+
+    key = {"n": 2048, "k": 300, "batch": 4, "dtype": "float32"}
+    times = microbench.bench_select(key, reps=2)
+    assert set(times) == {"top_k", "tournament"}
+    assert all(t > 0 for t in times.values())
+
+    t = DispatchTable()
+    winner = t.record("select_k", key, times)
+    assert winner == min(times, key=times.get)
+    path = tmp_path / "host.json"
+    t.save(str(path))
+
+    loaded = DispatchTable.load(str(path))
+    assert loaded.lookup("select_k", key) == winner
+
+    tuning.set_table_path(str(path))
+    got = tuning.choose("select_k", key, ["top_k", "tournament"],
+                        "analytic-fallback")
+    assert got == winner
+
+
+def test_choose_falls_back_on_missing_entry(tmp_path):
+    path = tmp_path / "t.json"
+    _write_table(path, "select_k",
+                 [({"n": 8192, "k": 512, "batch": 16, "dtype": "float32"},
+                   {"top_k": 5.0, "tournament": 1.0})])
+    tuning.set_table_path(str(path))
+    # nearby key interpolates to the measured winner
+    assert tuning.choose(
+        "select_k", {"n": 10000, "k": 600, "batch": 16, "dtype": "float32"},
+        ["top_k", "tournament"], "top_k") == "tournament"
+    # far-away key (outside the log2 trust radius) -> analytic fallback
+    assert tuning.choose(
+        "select_k", {"n": 128, "k": 2, "batch": 1, "dtype": "float32"},
+        ["top_k", "tournament"], "FALLBACK") == "FALLBACK"
+    # unknown op -> fallback
+    assert tuning.choose(
+        "nonesuch", {"n": 8192}, ["a", "b"], "FALLBACK") == "FALLBACK"
+    # categorical mismatch (dtype) -> fallback
+    assert tuning.choose(
+        "select_k", {"n": 8192, "k": 512, "batch": 16, "dtype": "int32"},
+        ["top_k"], "FALLBACK") == "FALLBACK"
+
+
+def test_choose_ignores_winner_outside_candidates(tmp_path):
+    """A table winner the call site can't use (dtype/layout constraint)
+    must never be returned — the entry is skipped, not clamped."""
+    path = tmp_path / "t.json"
+    _write_table(path, "select_k",
+                 [({"n": 8192, "k": 512, "batch": 16},
+                   {"top_k": 5.0, "tournament": 1.0})])
+    tuning.set_table_path(str(path))
+    assert tuning.choose("select_k", {"n": 8192, "k": 512, "batch": 16},
+                         ["top_k"], "top_k") == "top_k"
+
+
+def test_mode_off_freezes_to_analytic(tmp_path):
+    path = tmp_path / "t.json"
+    _write_table(path, "select_k",
+                 [({"n": 8192, "k": 512, "batch": 16, "dtype": "float32"},
+                   {"top_k": 5.0, "tournament": 1.0})])
+    tuning.set_table_path(str(path))
+    tuning.set_mode("off")
+    assert tuning.choose(
+        "select_k", {"n": 8192, "k": 512, "batch": 16, "dtype": "float32"},
+        ["top_k", "tournament"], "ANALYTIC") == "ANALYTIC"
+    assert tuning.budget("cagra_inline_bytes", 123) == 123
+
+
+def test_env_knob_and_bad_table(monkeypatch, tmp_path):
+    monkeypatch.setenv("RAFT_TPU_TUNING", "off")
+    assert tuning.mode() == "off"
+    monkeypatch.setenv("RAFT_TPU_TUNING", "bogus")
+    assert tuning.mode() == "table"
+    # unreadable table == no table: choose degrades to fallback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    tuning.set_table_path(str(bad))
+    assert tuning.choose("select_k", {"n": 8192}, ["top_k"],
+                         "FB") == "FB"
+
+
+def test_budget_lookup(tmp_path):
+    path = tmp_path / "t.json"
+    _write_table(path, "select_k", [], budgets={"cagra_inline_bytes": 999})
+    tuning.set_table_path(str(path))
+    assert tuning.budget("cagra_inline_bytes", 5) == 999
+    assert tuning.budget("unknown_budget", 5) == 5
+
+
+def test_table_version_gate(tmp_path):
+    p = tmp_path / "v0.json"
+    p.write_text(json.dumps({"version": 0, "ops": {}}))
+    with pytest.raises(ValueError, match="version"):
+        DispatchTable.load(str(p))
+
+
+# ---------------------------------------------------------------------------
+# consumer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_select_k_consults_table(tmp_path):
+    """A table entry overrides the analytic projection at a real
+    select_k call — and the tournament answer stays exact."""
+    import jax.numpy as jnp
+
+    from raft_tpu.matrix.select_k import select_k
+
+    path = tmp_path / "t.json"
+    # force the tournament where the analytic rule says top_k (k=64)
+    _write_table(path, "select_k",
+                 [({"n": 4096, "k": 64, "batch": 4, "dtype": "float32"},
+                   {"top_k": 9.0, "tournament": 1.0})])
+    tuning.set_table_path(str(path))
+    from raft_tpu.matrix.select_k import dispatch_select_impl
+
+    assert dispatch_select_impl(4, 4096, 64, jnp.float32) == "tournament"
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 4096)).astype(np.float32)
+    v, i = select_k(jnp.asarray(x), 64)
+    np.testing.assert_allclose(np.asarray(v), np.sort(x, axis=1)[:, :64])
+    # integers can never land on the float-only tournament
+    assert dispatch_select_impl(4, 4096, 64, jnp.int32) == "top_k"
+
+
+def test_merge_topk_consults_its_own_op(tmp_path, monkeypatch):
+    """merge_topk looks up the dedicated 'merge_topk' op key; a winner
+    there routes the exact merge arm."""
+    import importlib
+
+    import jax.numpy as jnp
+
+    sk = importlib.import_module("raft_tpu.matrix.select_k")
+    from raft_tpu.neighbors.common import merge_topk
+
+    path = tmp_path / "t.json"
+    _write_table(path, "merge_topk",
+                 [({"n": 2048, "k": 32, "batch": 8, "dtype": "float32"},
+                   {"top_k": 9.0, "tournament": 1.0})])
+    tuning.set_table_path(str(path))
+    calls = []
+    orig = sk._tournament_topk
+    monkeypatch.setattr(sk, "_tournament_topk",
+                        lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1])
+    rng = np.random.default_rng(4)
+    d = rng.standard_normal((8, 2048)).astype(np.float32)
+    ids = np.broadcast_to(np.arange(2048, dtype=np.int32), (8, 2048))
+    v, i = merge_topk(jnp.asarray(d), jnp.asarray(ids), 32)
+    assert calls, "merge_topk ignored its table entry"
+    np.testing.assert_allclose(np.asarray(v), np.sort(d, axis=1)[:, :32],
+                               rtol=1e-6)
+
+
+def test_resolve_scan_impl_consults_table(tmp_path):
+    """ivf_flat/_pq scan-impl resolution honors a measured winner within
+    the eligible set (xla-only on CPU: a 'pallas' entry can't leak in)."""
+    from raft_tpu.neighbors.ivf_flat import _resolve_scan_impl
+
+    path = tmp_path / "t.json"
+    _write_table(path, "ivf_scan",
+                 [({"cap": 512, "k": 10, "approx": True},
+                   {"pallas": 1.0, "xla": 9.0})])
+    tuning.set_table_path(str(path))
+    # CPU: pallas not a candidate regardless of the table
+    assert _resolve_scan_impl("auto", 512, 10, approx=True) == "xla"
+    # explicit request always wins
+    assert _resolve_scan_impl("xla", 512, 10) == "xla"
+
+
+def test_pq_cache_kind_auto_consults_table(tmp_path):
+    """cache_dtype='auto' stays fidelity-first (i8 whenever it fits —
+    the table must NOT flip a recall-affecting rung), and consults the
+    measured pq_scan race only between the recall-tied half-byte rungs
+    (i4 vs pq4) once i8 is over budget."""
+    from raft_tpu.neighbors.ivf_pq import _cache_kind_for
+
+    # i8 infeasible, i4 + pq4 feasible: C*cap*rot = 16G > 10G budget,
+    # half-byte footprint 8G fits
+    C, cap, rot, pqd = 1024, 16384, 1024, 1024
+    path = tmp_path / "t.json"
+    _write_table(path, "pq_scan",
+                 [({"n_lists": C, "cap": cap, "rot": rot, "pq_dim": pqd,
+                    "pq_bits": 4},
+                   {"i4": 9.0, "pq4": 1.0})])
+    tuning.set_table_path(str(path))
+    got = _cache_kind_for(True, "auto", C, cap, rot, pq_bits=4,
+                          pq_dim=pqd, per_subspace=True)
+    assert got == "pq4"
+    # miss (mode off) -> analytic i4-first compressed rung
+    tuning.set_mode("off")
+    got = _cache_kind_for(True, "auto", C, cap, rot, pq_bits=4,
+                          pq_dim=pqd, per_subspace=True)
+    assert got == "i4"
+    # i8 within budget: always i8, whatever the table says
+    assert _cache_kind_for(True, "auto", 64, 512, 64, pq_bits=4,
+                           pq_dim=32, per_subspace=True) == "i8"
+
+
+# ---------------------------------------------------------------------------
+# measure mode
+# ---------------------------------------------------------------------------
+
+
+def test_measure_mode_measures_and_caches(monkeypatch):
+    """RAFT_TPU_TUNING=measure: an uncovered select_k key is measured
+    once (result cached in-process) and the measured winner returned."""
+    from raft_tpu.tuning import microbench
+
+    tuning.set_mode("measure")
+    calls = []
+    real = microbench.bench_select
+
+    def spy(key, candidates=None, reps=3):
+        calls.append(key)
+        return real(key, candidates, reps=1)
+
+    monkeypatch.setattr(microbench, "measure_op",
+                        lambda op, key, cands: spy(key, cands))
+    key = {"n": 1024, "k": 16, "batch": 2, "dtype": "float32"}
+    w1 = tuning.choose("select_k", key, ["top_k", "tournament"], "top_k")
+    w2 = tuning.choose("select_k", key, ["top_k", "tournament"], "top_k")
+    assert w1 == w2
+    assert w1 in ("top_k", "tournament")
+    assert len(calls) == 1, "second call must hit the in-process cache"
+
+
+# ---------------------------------------------------------------------------
+# per-shard CAGRA inline eligibility (ADVICE r5 finding 3)
+# ---------------------------------------------------------------------------
+
+
+def test_cagra_inline_eligible_budgets_per_shard(monkeypatch):
+    """The inline gate budgets rows*row_bytes (per-shard search-time
+    residency), not total n*row_bytes: an 8-way sharded dataset 4x over
+    the single-device budget stays eligible because each shard holds
+    only 1/8 of the table."""
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops.beam_step import packed_row_layout
+
+    d, deg = 64, 32
+    row_bytes = 4 * packed_row_layout(deg, d, False)[3]
+    budget = cagra._INLINE_BUDGET
+    # single-device: n over budget -> ineligible (unchanged behavior)
+    n_big = budget // row_bytes * 4
+    assert not cagra._inline_eligible(n_big, d, deg, True)
+    # same dataset 8-way sharded: per-shard residency is n/8 * row_bytes
+    # = budget/2 -> eligible
+    assert cagra._inline_eligible(n_big, d, deg, True,
+                                  max_rows=n_big // 8)
+    # per-shard rows alone over budget -> still ineligible
+    assert not cagra._inline_eligible(n_big, d, deg, True,
+                                      max_rows=n_big)
+    # misaligned dim never packs
+    assert not cagra._inline_eligible(1000, 63, deg, True)
+
+
+def test_cagra_inline_budget_tunable(tmp_path):
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops.beam_step import packed_row_layout
+
+    d, deg = 64, 32
+    row_bytes = 4 * packed_row_layout(deg, d, False)[3]
+    n = 4096
+    path = tmp_path / "t.json"
+    # a table budget below this dataset's residency disables inlining
+    _write_table(path, "select_k", [],
+                 budgets={"cagra_inline_bytes": n * row_bytes // 2})
+    tuning.set_table_path(str(path))
+    assert not cagra._inline_eligible(n, d, deg, True)
+    tuning.set_mode("off")        # off-mode restores the analytic budget
+    assert cagra._inline_eligible(n, d, deg, True)
+
+
+# ---------------------------------------------------------------------------
+# capture pipeline (tiny grid)
+# ---------------------------------------------------------------------------
+
+
+def test_capture_emits_valid_loadable_table(tmp_path, monkeypatch):
+    """capture() on a stubbed-down grid emits a table that loads and
+    serves winners — the committed-artifact pipeline end to end."""
+    from raft_tpu.tuning import microbench
+
+    monkeypatch.setattr(
+        microbench, "select_grid",
+        lambda quick=True: [{"n": 1024, "k": 16, "batch": 2,
+                             "dtype": "float32"}])
+    monkeypatch.setattr(
+        microbench, "merge_grid",
+        lambda quick=True: [{"n": 512, "k": 8, "batch": 4,
+                             "dtype": "float32"}])
+    t = microbench.capture(backend="testhost", quick=True, reps=1,
+                           ops=["select_k", "merge_topk"], verbose=False)
+    assert t.n_entries("select_k") == 1
+    assert t.n_entries("merge_topk") == 1
+    assert t.budget("cagra_inline_bytes") is not None
+    path = tmp_path / "testhost.json"
+    t.save(str(path))
+    tuning.set_table_path(str(path))
+    w = tuning.choose("select_k",
+                      {"n": 1024, "k": 16, "batch": 2, "dtype": "float32"},
+                      ["top_k", "tournament"], "FB")
+    assert w in ("top_k", "tournament")
