@@ -1,0 +1,27 @@
+"""raft_tpu.analysis — static analysis for TPU correctness hazards.
+
+Two engines, one rule set (see ``docs/static_analysis.md``):
+
+* :mod:`raft_tpu.analysis.lint` — AST lint over package source
+  (GL001-GL006: host syncs, tracer branches, int->float ordering
+  casts, f64, undated perf claims, off-tile BlockSpecs).
+* :mod:`raft_tpu.analysis.jaxpr_audit` — traces the registered public
+  entry points on CPU and walks the jaxprs (GL001/GL003/GL004 with
+  real dataflow, plus the GL007 recompile audit).
+
+CLI: ``graft-lint`` (console script) or ``python scripts/graft_lint.py``.
+The tier-1 gate test (``tests/test_graft_lint.py``) runs both engines
+over ``raft_tpu/`` and fails on any unsuppressed finding — the JAX-port
+analog of the reference failing the build on an unvetted template
+instantiation (``util/raft_explicit.hpp``).
+"""
+
+from raft_tpu.analysis.rules import RULES, Finding, Rule  # noqa: F401
+from raft_tpu.analysis.lint import lint_file, lint_paths, lint_source  # noqa: F401
+from raft_tpu.analysis.jaxpr_audit import (  # noqa: F401
+    ENTRY_POINTS,
+    audit_entry_point,
+    audit_entry_points,
+    audit_select_k_recompiles,
+    run_audit,
+)

@@ -1,0 +1,120 @@
+"""graft-lint command line.
+
+::
+
+    graft-lint [paths...]                  # AST lint (default: raft_tpu/)
+    graft-lint --engine=both raft_tpu/     # AST + jaxpr audit
+    graft-lint --format=json raft_tpu/    # machine-readable
+    graft-lint --list-rules
+
+Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
+findings, 2 internal/usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="AST + jaxpr static analysis for TPU correctness "
+                    "hazards (docs/static_analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: raft_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--engine", choices=("ast", "jaxpr", "both"),
+                    default="ast",
+                    help="ast = source lint only (fast); jaxpr = trace the "
+                         "entry-point registry; both = the tier-1 gate")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of rule ids to run (AST engine), "
+                         "e.g. GL001,GL005")
+    ap.add_argument("--entry-points", default=None,
+                    help="comma list of jaxpr entry points "
+                         "(default: all registered)")
+    ap.add_argument("--no-recompile-audit", action="store_true",
+                    help="skip the select_k shape-sweep recompile audit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text format)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  allow-{rule.slug:<20} {rule.summary}")
+        return 0
+
+    if args.paths:
+        paths = args.paths
+    else:
+        # installed console script may run from anywhere: fall back to the
+        # package's own location when cwd has no raft_tpu/ checkout
+        from pathlib import Path
+
+        if Path("raft_tpu").is_dir():
+            paths = ["raft_tpu/"]
+        else:
+            import raft_tpu
+
+            paths = [str(Path(raft_tpu.__file__).parent)]
+    rules = set(args.rules.split(",")) if args.rules else None
+    if rules is not None:
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = []
+    report: dict = {}
+    try:
+        if args.engine in ("ast", "both"):
+            from raft_tpu.analysis.lint import lint_paths
+
+            findings.extend(lint_paths(paths, rules))
+        if args.engine in ("jaxpr", "both"):
+            from raft_tpu.analysis.jaxpr_audit import run_audit
+
+            names = args.entry_points.split(",") if args.entry_points else None
+            jf, report = run_audit(
+                names, recompile=not args.no_recompile_audit)
+            findings.extend(jf)
+    except Exception as e:  # noqa: BLE001 — engines must not crash the CLI
+        print(f"graft-lint internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    open_findings = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in open_findings],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "counts": {"open": len(open_findings),
+                       "suppressed": len(suppressed)},
+            "report": report,
+        }, indent=1))
+    else:
+        for f in open_findings:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f.render())
+        rec = report.get("recompile")
+        tail = f"; recompile audit: {rec['status']}" if rec else ""
+        print(f"graft-lint: {len(open_findings)} finding(s), "
+              f"{len(suppressed)} suppressed{tail}")
+
+    return 1 if open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

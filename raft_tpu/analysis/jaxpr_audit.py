@@ -1,0 +1,407 @@
+"""graft-lint engine 2: jaxpr auditor over the public entry points.
+
+Where :mod:`raft_tpu.analysis.lint` screens *syntax*, this engine traces
+the registered entry points under tiny CPU-concrete indexes (no TPU, no
+execution of the hot loop — ``jax.make_jaxpr`` only runs trace-time
+Python) and walks the closed jaxprs for hazards the AST cannot see
+through aliasing:
+
+* **GL003** — ``convert_element_type`` from a >=32-bit integer to a
+  float whose mantissa cannot hold it (f32: 24 bits), where the
+  converted value flows through order-preserving ops into an ordering
+  primitive (``sort`` / ``top_k`` / ``approx_top_k`` / ``argmin`` /
+  ``argmax`` / ``reduce_min`` / ``reduce_max``). This is the exact
+  >2^24 id-collapse class ADVICE r5 called out and PR 1 fixed in
+  ``select_k``; the auditor keeps it fixed everywhere.
+* **GL004** — any float64 value materialising in the traced graph.
+  Note: under *disabled* x64 (the repo default) f64 requests downcast at
+  trace time and never reach the jaxpr — there the AST rule is the only
+  screen; this check guards x64-enabled runs.
+* **GL001** — callback/transfer primitives (``pure_callback`` etc.)
+  inside traced code: host round trips hiding in a "compiled" path. A
+  ``ConcretizationTypeError`` while tracing is reported the same way —
+  it means query-path Python branched on a traced value.
+* **GL007** — the recompile audit: a repeated shape sweep through
+  ``select_k`` must add zero traces (steady-state serving never
+  recompiles — TPU-KNN's zero-recompile requirement).
+
+Entry points register with :func:`register_entry`; each may carry an
+``allow={rule_id: reason}`` dict — the audit-side analog of the inline
+``# graft-lint: allow-*`` comment, needed because jaxpr findings have no
+source line to anchor a comment to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu.analysis.rules import Finding
+
+# mantissa bits (incl. the implicit leading 1) per float dtype
+_MANTISSA = {"float64": 53, "float32": 24, "bfloat16": 8, "float16": 11}
+
+_ORDERING_PRIMS = {
+    "sort", "top_k", "approx_top_k", "argmin", "argmax",
+    "reduce_min", "reduce_max",
+}
+# ops through which an exact-int-in-float value stays an ordering key
+_STRUCTURAL_PRIMS = {
+    "neg", "reshape", "broadcast_in_dim", "transpose", "slice",
+    "dynamic_slice", "squeeze", "rev", "copy", "concatenate", "gather",
+    "select_n", "convert_element_type", "pad", "stop_gradient",
+    "expand_dims", "add", "sub", "mul", "max", "min",
+}
+_CALLBACK_PRIMS = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call",
+}
+_TRANSFER_PRIMS = {"device_put"}
+
+# sub-jaxpr carrying params, by name
+_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
+                    "branches")
+
+
+# ---------------------------------------------------------------------------
+# entry-point registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    name: str
+    build: Callable[[], Tuple[Callable, tuple]]   # -> (fn, traced_args)
+    allow: Dict[str, str]                          # rule id -> reason
+
+
+ENTRY_POINTS: Dict[str, EntryPoint] = {}
+
+
+def register_entry(name: str, allow: Optional[Dict[str, str]] = None):
+    def deco(build):
+        ENTRY_POINTS[name] = EntryPoint(name, build, dict(allow or {}))
+        return build
+    return deco
+
+
+def _rng(shape, seed=0, dtype="float32"):
+    import numpy as np
+    return np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+
+
+@register_entry("select_k")
+def _ep_select_k():
+    import jax.numpy as jnp
+    from raft_tpu.matrix.select_k import select_k
+
+    v = jnp.asarray(_rng((4, 256)))
+    return (lambda x: select_k(x, 16)), (v,)
+
+
+@register_entry("pairwise")
+def _ep_pairwise():
+    import jax.numpy as jnp
+    from raft_tpu.distance.pairwise import pairwise_distance
+
+    x = jnp.asarray(_rng((8, 16)))
+    y = jnp.asarray(_rng((32, 16), seed=1))
+    return (lambda a, b: pairwise_distance(a, b, "sqeuclidean")), (x, y)
+
+
+@register_entry("brute_force")
+def _ep_brute_force():
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import brute_force
+
+    idx = brute_force.build(_rng((128, 16)), metric="sqeuclidean")
+    q = jnp.asarray(_rng((4, 16), seed=1))
+    return (lambda queries: brute_force.search(idx, queries, 8)), (q,)
+
+
+@register_entry("ivf_flat")
+def _ep_ivf_flat():
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import ivf_flat
+
+    params = ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2)
+    idx = ivf_flat.build(params, _rng((128, 16)))
+    sp = ivf_flat.SearchParams(n_probes=2, scan_impl="xla")
+    q = jnp.asarray(_rng((4, 16), seed=1))
+    return (lambda queries: ivf_flat.search(sp, idx, queries, 4)), (q,)
+
+
+@register_entry("ivf_pq")
+def _ep_ivf_pq():
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import ivf_pq
+
+    params = ivf_pq.IndexParams(n_lists=4, pq_dim=4, kmeans_n_iters=2)
+    idx = ivf_pq.build(params, _rng((256, 16)))
+    sp = ivf_pq.SearchParams(n_probes=2, scan_impl="xla")
+    q = jnp.asarray(_rng((4, 16), seed=1))
+    return (lambda queries: ivf_pq.search(sp, idx, queries, 4)), (q,)
+
+
+@register_entry("cagra")
+def _ep_cagra():
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import brute_force, cagra
+
+    data = _rng((128, 16))
+    _, nbrs = brute_force.knn(data, data, 5)       # k=deg+1, col 0 = self
+    idx = cagra.from_graph(data, nbrs[:, 1:], "sqeuclidean")
+    sp = cagra.SearchParams(itopk_size=16, scan_impl="xla")
+    q = jnp.asarray(_rng((4, 16), seed=1))
+    return (lambda queries: cagra.search(sp, idx, queries, 4)), (q,)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walk
+# ---------------------------------------------------------------------------
+
+
+def _dtype_name(aval) -> str:
+    return getattr(getattr(aval, "dtype", None), "name", "")
+
+
+def _is_wide_int(aval) -> bool:
+    name = _dtype_name(aval)
+    return name.startswith(("int", "uint")) and name[-2:] in ("32", "64")
+
+
+class _Auditor:
+    """Taint-tracking walk over one closed jaxpr (recursing into
+    sub-jaxprs with taint mapped through call boundaries)."""
+
+    def __init__(self, entry: str):
+        self.entry = entry
+        self.findings: List[Finding] = []
+        self.f64_count = 0
+
+    def _emit(self, rule: str, message: str) -> None:
+        self.findings.append(
+            Finding(rule, f"<jaxpr:{self.entry}>", 0, message, engine="jaxpr")
+        )
+
+    def walk(self, closed_jaxpr, taint: Optional[Dict] = None) -> Dict:
+        """taint: var -> origin string for tainted *invars*; returns taint
+        for outvars (positional list mapped by caller)."""
+        jaxpr = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
+        t: Dict = dict(taint or {})
+        # closure constants + traced args: a device_put of these is the
+        # one-time upload XLA hoists out of the steady-state loop, not a
+        # mid-graph transfer
+        boundary = {id(v) for v in list(jaxpr.constvars) + list(jaxpr.invars)}
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            in_taints = []
+            for v in eqn.invars:
+                origin = t.get(id(v)) if not self._is_literal(v) else None
+                in_taints.append(origin)
+
+            # GL004: f64 output anywhere in the graph
+            for ov in eqn.outvars:
+                if _dtype_name(ov.aval) == "float64":
+                    self.f64_count += 1
+
+            # GL001: host callbacks / transfers in traced code. device_put
+            # of a constant or of a traced input is the benign one-time
+            # upload; only mid-graph transfers count.
+            if prim in _CALLBACK_PRIMS:
+                self._emit("GL001",
+                           f"{self.entry}: traced graph contains host "
+                           f"round-trip primitive {prim!r}")
+            elif prim in _TRANSFER_PRIMS and any(
+                    not self._is_literal(v) and id(v) not in boundary
+                    for v in eqn.invars):
+                self._emit("GL001",
+                           f"{self.entry}: mid-graph {prim!r} on a derived "
+                           "value — a transfer inside the hot loop")
+
+            # GL003 taint source: wide-int -> narrow-float convert
+            out_taint: Optional[str] = None
+            if prim == "convert_element_type" and eqn.invars:
+                src = eqn.invars[0].aval
+                dst = eqn.outvars[0].aval
+                if _is_wide_int(src):
+                    bits = 64 if _dtype_name(src).endswith("64") else 32
+                    mant = _MANTISSA.get(_dtype_name(dst), 0)
+                    if mant and mant < bits - (0 if _dtype_name(src).startswith("u") else 1):
+                        out_taint = (f"{_dtype_name(src)}->{_dtype_name(dst)} "
+                                     f"(mantissa {mant} < {bits}-bit payload)")
+
+            # GL003 sink: ordering primitive consuming a tainted operand
+            if prim in _ORDERING_PRIMS:
+                for v, origin in zip(eqn.invars, in_taints):
+                    if origin:
+                        self._emit("GL003",
+                                   f"{self.entry}: ordering primitive "
+                                   f"{prim!r} consumes an integer value "
+                                   f"converted {origin}; keys above 2^24 "
+                                   "collapse — select in integer domain")
+
+            # recurse into sub-jaxprs, mapping taint through the call
+            sub_results = self._walk_subjaxprs(eqn, t, in_taints)
+            if sub_results is not None:
+                for ov, origin in zip(eqn.outvars, sub_results):
+                    if origin:
+                        t[id(ov)] = origin
+                continue
+
+            # taint propagation through structural/order-preserving ops
+            if out_taint is None and prim in _STRUCTURAL_PRIMS:
+                out_taint = next((o for o in in_taints if o), None)
+            if out_taint is not None:
+                for ov in eqn.outvars:
+                    t[id(ov)] = out_taint
+
+        return {id(v): t.get(id(v)) for v in jaxpr.outvars if not self._is_literal(v)}
+
+    @staticmethod
+    def _is_literal(v) -> bool:
+        return type(v).__name__ == "Literal"
+
+    def _walk_subjaxprs(self, eqn, t: Dict, in_taints: List) -> Optional[List]:
+        """Recurse into any sub-jaxpr params; returns outvar taints
+        (positional) when sub-jaxprs were found, else None."""
+        subs = []
+        for key in _SUBJAXPR_PARAMS:
+            val = eqn.params.get(key)
+            if val is None:
+                continue
+            if key == "branches":
+                subs.extend(val)
+            else:
+                subs.append(val)
+        if not subs:
+            return None
+        out_taints: List = [None] * len(eqn.outvars)
+        for sub in subs:
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            # map outer operand taint onto inner invars (positional; scan
+            # prepends consts/carry — zip from the tail is close enough
+            # for a screen, so align from the end)
+            inner_taint: Dict = {}
+            invars = list(inner.invars)
+            operands = list(eqn.invars)
+            for iv, (ov, origin) in zip(reversed(invars),
+                                        reversed(list(zip(operands, in_taints)))):
+                if origin:
+                    inner_taint[id(iv)] = origin
+            result = self.walk(sub, inner_taint)
+            inner_outs = list(inner.outvars)
+            for pos, iv in enumerate(inner_outs[-len(eqn.outvars):] if eqn.outvars else []):
+                origin = result.get(id(iv))
+                if origin and pos < len(out_taints):
+                    out_taints[pos] = out_taints[pos] or origin
+        return out_taints
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def audit_entry_point(name: str) -> List[Finding]:
+    """Trace one registered entry point and walk its jaxpr."""
+    import jax
+
+    entry = ENTRY_POINTS[name]
+    auditor = _Auditor(name)
+    try:
+        fn, args = entry.build()
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any trace failure is a finding
+        kind = type(e).__name__
+        rule = "GL002" if "Concretization" in kind or "Tracer" in kind else "GL001"
+        auditor._emit(rule,
+                      f"{name}: tracing failed with {kind}: {e}"[:500])
+        return auditor.findings
+    auditor.walk(closed)
+    if auditor.f64_count:
+        auditor._emit("GL004",
+                      f"{name}: {auditor.f64_count} float64 value(s) in the "
+                      "traced graph (silently downcast under disabled x64)")
+    findings = auditor.findings
+    for f in findings:
+        reason = entry.allow.get(f.rule)
+        if reason:
+            f.suppressed = True
+            f.reason = reason
+    return findings
+
+
+def audit_entry_points(names: Optional[Sequence[str]] = None) -> List[Finding]:
+    names = list(names) if names else sorted(ENTRY_POINTS)
+    out: List[Finding] = []
+    for n in names:
+        out.extend(audit_entry_point(n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recompile audit
+# ---------------------------------------------------------------------------
+
+_DEFAULT_SWEEP = ((4, 512), (4, 1024), (8, 1024), (4, 2048), (16, 4096))
+
+
+def audit_select_k_recompiles(
+    shapes: Sequence[Tuple[int, int]] = _DEFAULT_SWEEP, k: int = 16
+) -> Tuple[List[Finding], dict]:
+    """Run the select_k shape sweep twice; the second pass must add zero
+    traces (steady-state serving never recompiles). Returns (findings,
+    report)."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    # the package re-exports the function under the module's name
+    sk_mod = importlib.import_module("raft_tpu.matrix.select_k")
+
+    tracked = [sk_mod._select_k, sk_mod._tournament_topk]
+    if not all(hasattr(f, "_cache_size") for f in tracked):
+        return [], {"status": "skipped",
+                    "detail": "no _cache_size on this jax version"}
+
+    jax.clear_caches()
+
+    def total() -> int:
+        return sum(f._cache_size() for f in tracked)
+
+    def sweep(seed: int) -> None:
+        for i, (b, n) in enumerate(shapes):
+            v = jnp.asarray(_rng((b, n), seed=seed * 100 + i))
+            sk_mod.select_k(v, k)
+
+    sweep(0)
+    first = total()
+    sweep(1)
+    delta = total() - first
+    report = {
+        "status": "ok" if delta == 0 else "fail",
+        "shapes": list(map(list, shapes)),
+        "compiles_first_sweep": first,
+        "retraces_second_sweep": delta,
+    }
+    findings: List[Finding] = []
+    if delta:
+        findings.append(Finding(
+            "GL007", "<jaxpr:select_k>", 0,
+            f"select_k shape sweep retraced {delta} time(s) on identical "
+            "shapes — steady-state serving would recompile", engine="jaxpr"))
+    return findings, report
+
+
+def run_audit(names: Optional[Sequence[str]] = None,
+              recompile: bool = True) -> Tuple[List[Finding], dict]:
+    findings = audit_entry_points(names)
+    report: dict = {"entry_points": sorted(names or ENTRY_POINTS)}
+    if recompile:
+        rf, rr = audit_select_k_recompiles()
+        findings.extend(rf)
+        report["recompile"] = rr
+    return findings, report

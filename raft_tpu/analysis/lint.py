@@ -1,0 +1,738 @@
+"""graft-lint engine 1: AST lint over package source.
+
+Static screens for the TPU hazard classes in :mod:`raft_tpu.analysis.rules`.
+Everything here is a *heuristic over syntax* — the precise, shape-aware
+version of GL003/GL004 lives in :mod:`raft_tpu.analysis.jaxpr_audit`,
+which walks real jaxprs. The two engines overlap on purpose: the AST
+pass sees code the tracer never reaches (error branches, dead configs),
+the jaxpr pass sees through aliasing the AST cannot.
+
+Traced-scope detection: a function is considered traced when it is
+decorated with ``jax.jit`` (directly or via ``functools.partial``), is
+passed callable-first to ``pl.pallas_call`` / ``lax.scan`` /
+``lax.while_loop`` / ``lax.cond`` / ``lax.switch`` / ``lax.fori_loop`` /
+``jax.vmap`` / ``jax.jit``, or is lexically nested inside a traced
+function. ``static_argnums`` named in the jit decorator demote those
+positional params from the traced-param set.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis.rules import (
+    Finding,
+    apply_suppressions,
+    scan_suppressions,
+)
+
+# Module aliases treated as "device" roots: an expression mentioning one
+# of these produces/consumes device arrays.
+_DEVICE_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu"}
+_NUMPY_ROOTS = {"np", "numpy"}
+
+# callables whose callable-argument(s) run under trace
+_TRACING_CALLERS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jit": (0,),
+    "jax.lax.scan": (0,),
+    "lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "lax.switch": (1,),
+    "jax.lax.associative_scan": (0,),
+    "lax.associative_scan": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "pl.pallas_call": (0,),
+    "pallas_call": (0,),
+}
+
+_ORDERING_CALLS = {
+    "jnp.sort", "jnp.argsort", "jnp.lexsort", "jnp.argmin", "jnp.argmax",
+    "jnp.searchsorted",
+    "jax.lax.top_k", "lax.top_k", "jax.lax.sort", "lax.sort",
+    "jax.lax.approx_min_k", "lax.approx_min_k",
+    "jax.lax.approx_max_k", "lax.approx_max_k",
+}
+# local helpers that select/order — matched on the trailing name so both
+# `select_k(...)` and `matrix.select_k(...)` hit
+_ORDERING_SUFFIXES = ("select_k", "merge_topk", "top_k", "knn_merge_parts")
+
+_NARROW_FLOAT_ATTRS = {
+    "jnp.float32", "np.float32", "numpy.float32",
+    "jnp.bfloat16", "jnp.float16", "np.float16",
+}
+_NARROW_FLOAT_STRINGS = {"float32", "bfloat16", "float16", "f32", "bf16"}
+
+_F64_ATTRS = {"jnp.float64", "np.float64", "numpy.float64",
+              "jnp.double", "np.double", "numpy.double"}
+
+_INT_PRODUCERS = {
+    "jnp.arange", "jnp.argsort", "jnp.argmin", "jnp.argmax", "jnp.bincount",
+    "jnp.searchsorted", "jnp.nonzero", "jnp.flatnonzero",
+    "jax.lax.iota", "lax.iota", "jax.lax.broadcasted_iota",
+}
+_INT_DTYPE_ATTRS = {"jnp.int32", "jnp.int64", "np.int32", "np.int64",
+                    "jnp.uint32", "jnp.uint64", "np.uint32", "np.uint64"}
+# names that *smell* like >= 32-bit integer payloads (ids/positions)
+_INT_NAME_RE = re.compile(
+    r"(^|_)(idx|idxs|ids?|indices|index|labels?|perm|order|ranks?|offsets?|"
+    r"rows?|cols?|positions?|sizes?|counts?)(_|$)", re.IGNORECASE,
+)
+
+# GL005 ---------------------------------------------------------------------
+
+_PERF_CLAIM_RE = re.compile(
+    r"""
+    (?: \d[\d.,]*\s*k?\s*QPS )                                  # 14.7k QPS
+  | (?: \d[\d.,]*\s*[x×]\s*(?:QPS|recall) )                     # 1.2x QPS
+  | (?: ~?\s*\d[\d.]*\s*[x×]\s*(?:faster|slower|speedup|
+        throughput|the\ bandwidth) )                            # ~7x faster
+  | (?: \d[\d.,]*\s*[GMT]B/s )                                  # 123 GB/s
+  | (?: \d[\d.,]*\s*[GT]FLOP )                                  # 9 GFLOP/s
+  | (?: [+\-]\d[\d.]*\s*%\s*(?:QPS|recall|throughput|latency) ) # +20% QPS
+    """,
+    re.VERBOSE | re.IGNORECASE,
+)
+_DATED_RE = re.compile(
+    r"""
+    \br[1-9]\d?\b                     # round marker: r2, r5 ...
+  | \bround\s+[1-9]\d?\b              # spelled-out round marker
+  | \b(?:BENCH|SWEEP|LATENCY|DEEP100M|MULTICHIP|SHARDED|
+       PALLAS_PARITY|SELECT_CROSSOVER)_r?\d* \b                 # artifacts
+  | \b20\d\d\b                        # a year
+  | \b[\w/]+\.json\b                  # an artifact file
+    """,
+    re.VERBOSE,
+)
+
+# GL006 ---------------------------------------------------------------------
+
+_SUBLANE_MULTIPLE = 8       # f32 floor; bf16 needs 16, int8 32 (message notes)
+_LANE_MULTIPLE = 128
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024   # ~VMEM per core (pallas guide)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jnp.asarray' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _contains_device_expr(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            root = sub
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in _DEVICE_ROOTS:
+                return True
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[List[Optional[int]]]:
+    """[8, 128] for a literal int tuple; None entries for non-literal dims;
+    None overall when not a tuple/list."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: List[Optional[int]] = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            out.append(el.value)
+        elif isinstance(el, ast.Constant) and el.value is None:
+            out.append(None)      # pallas "whole axis" dim
+        else:
+            out.append(None)
+    return out
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    node: ast.AST                       # FunctionDef / Lambda
+    traced: bool = False
+    traced_params: Set[str] = dataclasses.field(default_factory=set)
+
+
+# ---------------------------------------------------------------------------
+# the linter
+# ---------------------------------------------------------------------------
+
+
+class FileLinter:
+    def __init__(self, path: str, source: str, rules: Optional[Set[str]] = None):
+        self.path = path
+        self.source = source
+        self.rules = rules          # None = all
+        self.findings: List[Finding] = []
+        self.tree = ast.parse(source, filename=path)
+        self._fn_infos: Dict[ast.AST, _FnInfo] = {}
+        self._fn_stack: List[_FnInfo] = []
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, rule: str, node_or_line, message: str) -> None:
+        if self.rules is not None and rule not in self.rules:
+            return
+        line = node_or_line if isinstance(node_or_line, int) else node_or_line.lineno
+        self.findings.append(Finding(rule, self.path, line, message))
+
+    def run(self) -> List[Finding]:
+        self._mark_traced_functions()
+        self._lint_tree()
+        self._lint_comments_and_docstrings()
+        # nested defs are revisited by the per-function GL003 pass; dedupe
+        seen: Set[Tuple[str, int, str]] = set()
+        unique: List[Finding] = []
+        for f in self.findings:
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        sup = scan_suppressions(self.source)
+        return apply_suppressions(self.findings, sup, self.path)
+
+    # -- traced-scope discovery -------------------------------------------
+
+    def _decorator_static_argnums(self, deco: ast.AST) -> Tuple[bool, Set[int], Set[str]]:
+        """(is_jit, static positions, static names) for one decorator."""
+        name = _dotted(deco)
+        if name in ("jax.jit", "jit"):
+            return True, set(), set()
+        if isinstance(deco, ast.Call):
+            fname = _dotted(deco.func)
+            if fname in ("jax.jit", "jit"):
+                call = deco
+            elif fname in ("functools.partial", "partial") and deco.args and \
+                    _dotted(deco.args[0]) in ("jax.jit", "jit"):
+                call = deco
+            else:
+                return False, set(), set()
+            nums: Set[int] = set()
+            names: Set[str] = set()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                            nums.add(el.value)
+                elif kw.arg == "static_argnames":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            names.add(el.value)
+            return True, nums, names
+        return False, set(), set()
+
+    def _mark_traced_functions(self) -> None:
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                info = _FnInfo(node)
+                self._fn_infos[node] = info
+                if not isinstance(node, ast.Lambda):
+                    defs_by_name.setdefault(node.name, []).append(node)
+
+        # 1) jit decorators
+        for node, info in self._fn_infos.items():
+            if isinstance(node, ast.Lambda):
+                continue
+            for deco in node.decorator_list:
+                is_jit, nums, names = self._decorator_static_argnums(deco)
+                if is_jit:
+                    info.traced = True
+                    info.traced_params = self._param_names(node, nums, names)
+
+        # 2) callables handed to tracing callers (by name or inline lambda)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            positions = _TRACING_CALLERS.get(fname or "")
+            if not positions:
+                continue
+            for pos in positions:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                targets: List[ast.AST] = []
+                if isinstance(arg, ast.Name):
+                    targets = defs_by_name.get(arg.id, [])
+                elif isinstance(arg, ast.Lambda):
+                    targets = [arg]
+                elif isinstance(arg, (ast.List, ast.Tuple)):    # lax.switch
+                    for el in arg.elts:
+                        if isinstance(el, ast.Name):
+                            targets += defs_by_name.get(el.id, [])
+                        elif isinstance(el, ast.Lambda):
+                            targets.append(el)
+                for t in targets:
+                    info = self._fn_infos[t]
+                    info.traced = True
+                    if not info.traced_params:
+                        info.traced_params = self._param_names(t, set(), set())
+
+        # 3) lexical nesting: children of traced functions are traced
+        def propagate(node: ast.AST, inherited: bool) -> None:
+            info = self._fn_infos.get(node)
+            here = inherited
+            if info is not None:
+                info.traced = info.traced or inherited
+                here = info.traced
+                if info.traced and not info.traced_params:
+                    info.traced_params = self._param_names(node, set(), set())
+            for child in ast.iter_child_nodes(node):
+                propagate(child, here)
+
+        propagate(self.tree, False)
+
+    @staticmethod
+    def _param_names(node: ast.AST, static_nums: Set[int], static_names: Set[str]) -> Set[str]:
+        args = node.args
+        out: Set[str] = set()
+        for i, a in enumerate(args.posonlyargs + args.args):
+            if i in static_nums or a.arg in static_names:
+                continue
+            out.add(a.arg)
+        for a in args.kwonlyargs:
+            if a.arg not in static_names:
+                out.add(a.arg)
+        out.discard("self")
+        return out
+
+    def _in_traced_scope(self) -> bool:
+        return any(f.traced for f in self._fn_stack)
+
+    def _traced_params(self) -> Set[str]:
+        for f in reversed(self._fn_stack):
+            if f.traced:
+                return f.traced_params
+        return set()
+
+    # -- main walk ---------------------------------------------------------
+
+    def _lint_tree(self) -> None:
+        self._walk(self.tree)
+
+    def _walk(self, node: ast.AST) -> None:
+        info = self._fn_infos.get(node)
+        if info is not None:
+            self._fn_stack.append(info)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_gl003_function(node)
+        try:
+            self._visit(node)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child)
+        finally:
+            if info is not None:
+                self._fn_stack.pop()
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._check_host_sync_call(node)
+            self._check_f64_call(node)
+            self._check_blockspec(node)
+        elif isinstance(node, ast.Attribute):
+            self._check_f64_attr(node)
+        elif isinstance(node, (ast.If, ast.While)):
+            self._check_tracer_branch(node.test, kind="branch")
+        elif isinstance(node, ast.For):
+            self._check_tracer_branch(node.iter, kind="iteration")
+        elif isinstance(node, ast.FunctionDef):
+            self._check_vmem_budget(node)
+
+    # -- GL001 host-sync ---------------------------------------------------
+
+    def _check_host_sync_call(self, node: ast.Call) -> None:
+        fname = _dotted(node.func)
+        in_traced = self._in_traced_scope()
+
+        # .item() / .tolist() force a device->host transfer wherever they run
+        if isinstance(node.func, ast.Attribute) and node.func.attr in ("item", "tolist") \
+                and not node.args and not node.keywords:
+            where = "inside traced scope" if in_traced else "on a device value"
+            self._emit("GL001", node,
+                       f".{node.func.attr}() {where}: device->host sync; hoist "
+                       "to host-side setup or batch it out of the hot path")
+            return
+
+        if fname in ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                     "np.copy", "numpy.copy"):
+            if in_traced:
+                self._emit("GL001", node,
+                           f"{fname}() inside traced scope materialises the "
+                           "tracer on host (breaks tracing or constant-folds)")
+            elif node.args and _contains_device_expr(node.args[0]):
+                self._emit("GL001", node,
+                           f"{fname}() of a jax expression blocks on "
+                           "device->host transfer")
+            return
+
+        if fname in ("float", "int", "bool") and node.args:
+            arg = node.args[0]
+            if _contains_device_expr(arg):
+                self._emit("GL001", node,
+                           f"{fname}() of a jax expression forces a blocking "
+                           "device->host sync")
+            elif in_traced and isinstance(arg, ast.Name) and \
+                    arg.id in self._traced_params():
+                self._emit("GL001", node,
+                           f"{fname}({arg.id}) on a traced parameter inside "
+                           "jit scope: concretisation error or silent "
+                           "trace-time constant")
+
+    # -- GL002 tracer control flow ----------------------------------------
+
+    _METADATA_ATTRS = {"dtype", "shape", "ndim", "size", "itemsize", "aval"}
+    _METADATA_CALLS = {
+        "jnp.issubdtype", "jnp.result_type", "jnp.promote_types",
+        "jnp.dtype", "jnp.finfo", "jnp.iinfo", "jnp.isdtype", "jnp.ndim",
+        "jnp.shape", "len", "isinstance", "getattr", "hasattr",
+    }
+
+    def _is_none_checked_names(self, test: ast.AST) -> Set[str]:
+        """Names only compared against None (`x is None` is a static
+        structural check, not a value branch)."""
+        out: Set[str] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops):
+                operands = [sub.left] + list(sub.comparators)
+                if any(isinstance(o, ast.Constant) and o.value is None
+                       for o in operands):
+                    for o in operands:
+                        out |= _names_in(o)
+        return out
+
+    def _check_tracer_branch(self, test: ast.AST, kind: str) -> None:
+        if not self._in_traced_scope():
+            return
+        # branches on trace-time metadata (dtype/shape/ndim) are static
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) and sub.attr in self._METADATA_ATTRS:
+                return
+            if isinstance(sub, ast.Call) and \
+                    (_dotted(sub.func) or "") in self._METADATA_CALLS:
+                return
+        device_call = any(
+            isinstance(sub, ast.Call) and _contains_device_expr(sub.func)
+            for sub in ast.walk(test)
+        )
+        if device_call:
+            self._emit("GL002", test,
+                       f"Python {kind} on a jax expression inside traced "
+                       "scope; use lax.cond/lax.while_loop/jnp.where")
+            return
+        hits = (_names_in(test) & self._traced_params()) \
+            - self._is_none_checked_names(test)
+        if hits:
+            self._emit("GL002", test,
+                       f"Python {kind} on traced parameter(s) "
+                       f"{sorted(hits)} inside traced scope; use "
+                       "lax.cond/lax.select or mark the arg static")
+
+    # -- GL003 int->float ordering ----------------------------------------
+
+    def _is_narrow_float_cast(self, node: ast.Call) -> Optional[ast.AST]:
+        """The value being cast when `node` narrows to f32/bf16/f16."""
+        fname = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+                and node.args:
+            dt = node.args[0]
+            if _dotted(dt) in _NARROW_FLOAT_ATTRS or (
+                    isinstance(dt, ast.Constant) and dt.value in _NARROW_FLOAT_STRINGS):
+                return node.func.value
+        if fname in _NARROW_FLOAT_ATTRS and node.args:
+            return node.args[0]
+        if fname in ("jnp.asarray", "jnp.array") and len(node.args) >= 2:
+            dt = node.args[1]
+            if _dotted(dt) in _NARROW_FLOAT_ATTRS or (
+                    isinstance(dt, ast.Constant) and dt.value in _NARROW_FLOAT_STRINGS):
+                return node.args[0]
+        return None
+
+    def _int_hinted(self, node: ast.AST, int_vars: Set[str]) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                    sub.id in int_vars or _INT_NAME_RE.search(sub.id)):
+                return True
+            if isinstance(sub, ast.Call):
+                fn = _dotted(sub.func)
+                if fn in _INT_PRODUCERS:
+                    return True
+                if isinstance(sub.func, ast.Attribute) and sub.func.attr == "astype" \
+                        and sub.args and _dotted(sub.args[0]) in _INT_DTYPE_ATTRS:
+                    return True
+        return False
+
+    def _int_producer_expr(self, node: ast.AST, int_vars: Set[str]) -> bool:
+        """Is `node` *itself* (not merely containing) an int-array value?
+        Deliberately does not see through jnp.where/comparisons/boolean
+        masks — a mask built FROM ids is not an id payload."""
+        if isinstance(node, ast.Name):
+            return node.id in int_vars
+        if isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            if fn in _INT_PRODUCERS:
+                return True
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+                    and node.args and _dotted(node.args[0]) in _INT_DTYPE_ATTRS:
+                return True
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _dotted(kw.value) in _INT_DTYPE_ATTRS:
+                    return True
+        if isinstance(node, ast.BinOp):
+            return self._int_producer_expr(node.left, int_vars) or \
+                self._int_producer_expr(node.right, int_vars)
+        if isinstance(node, ast.Subscript):
+            return self._int_producer_expr(node.value, int_vars)
+        return False
+
+    def _check_gl003_function(self, fn: ast.FunctionDef) -> None:
+        # pass 1: names DIRECTLY assigned an integer-array expression
+        int_vars: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                if self._int_producer_expr(sub.value, int_vars):
+                    int_vars.add(sub.targets[0].id)
+
+        # pass 2: narrow casts of int-hinted values -> record tainted names
+        tainted: Dict[str, int] = {}     # name -> cast line
+        direct: List[Tuple[ast.Call, int]] = []
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            src = self._is_narrow_float_cast(sub)
+            if src is None or not self._int_hinted(src, int_vars):
+                continue
+            direct.append((sub, sub.lineno))
+        # map casts assigned to a name
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                for cast_node, line in direct:
+                    if cast_node in ast.walk(sub.value):
+                        tainted[sub.targets[0].id] = line
+
+        if not direct:
+            return
+
+        # pass 3: ordering sinks consuming a tainted cast (nested or by name)
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = _dotted(sub.func) or ""
+            is_sink = fname in _ORDERING_CALLS or any(
+                fname == s or fname.endswith("." + s) for s in _ORDERING_SUFFIXES
+            )
+            if not is_sink:
+                continue
+            for argnode in list(sub.args) + [kw.value for kw in sub.keywords]:
+                for cast_node, line in direct:
+                    if cast_node in ast.walk(argnode):
+                        self._emit("GL003", sub,
+                                   f"ordering op {fname}() consumes a >=32-bit "
+                                   "integer value cast to narrow float "
+                                   f"(cast at line {line}): keys above 2^24 "
+                                   "collapse; select in integer domain")
+                names = _names_in(argnode) & set(tainted)
+                for nm in names:
+                    self._emit("GL003", sub,
+                               f"ordering op {fname}() consumes {nm!r}, a "
+                               ">=32-bit integer value cast to narrow float "
+                               f"at line {tainted[nm]}: keys above 2^24 "
+                               "collapse; select in integer domain")
+
+    # -- GL004 f64 ---------------------------------------------------------
+
+    def _check_f64_attr(self, node: ast.Attribute) -> None:
+        if _dotted(node) in _F64_ATTRS:
+            self._emit("GL004", node,
+                       f"{_dotted(node)} in package code: silently downcast "
+                       "on device under disabled x64; if intentionally "
+                       "host-side, suppress with a reason")
+
+    def _check_f64_call(self, node: ast.Call) -> None:
+        is_dtype_sink = (
+            isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "astype", "asarray", "array", "zeros", "ones", "full",
+                "empty", "arange")
+        )
+        if not is_dtype_sink:
+            return
+        for cand in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(cand, ast.Constant) and cand.value in ("float64", "f64", "double"):
+                self._emit("GL004", node,
+                           "dtype 'float64' requested: silently downcast on "
+                           "device under disabled x64")
+
+    # -- GL006 BlockSpec ---------------------------------------------------
+
+    def _check_blockspec(self, node: ast.Call) -> None:
+        fname = _dotted(node.func)
+        if fname not in ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec"):
+            return
+        if not node.args:
+            return
+        dims = _const_int_tuple(node.args[0])
+        if dims is None:
+            return  # symbolic block shape — the static screen cannot judge
+        lits = [d for d in dims if d is not None]
+        if not lits or len(dims) < 1:
+            return
+        last = dims[-1]
+        if last is not None and last != 1 and last % _LANE_MULTIPLE != 0:
+            self._emit("GL006", node,
+                       f"BlockSpec trailing dim {last} is not a multiple of "
+                       f"{_LANE_MULTIPLE} (TPU lane width): forces relayout")
+        if len(dims) >= 2:
+            sub = dims[-2]
+            if sub is not None and sub != 1 and sub % _SUBLANE_MULTIPLE != 0:
+                self._emit("GL006", node,
+                           f"BlockSpec sublane dim {sub} is not a multiple of "
+                           f"{_SUBLANE_MULTIPLE} (f32 tile; bf16 needs 16, "
+                           "int8 32): forces relayout")
+
+    def _check_vmem_budget(self, fn: ast.FunctionDef) -> None:
+        """Static VMEM estimate: sum of fully-literal BlockSpec blocks used
+        in this function, at 4 B/elem (f32 upper bound for this codebase's
+        kernels)."""
+        total = 0
+        count = 0
+        for sub in ast.walk(fn):
+            dims = None
+            if isinstance(sub, ast.Call):
+                fname = _dotted(sub.func)
+                if fname in ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec") \
+                        and sub.args:
+                    dims = _const_int_tuple(sub.args[0])
+            if not dims or any(d is None for d in dims):
+                continue
+            n = 1
+            for d in dims:
+                n *= d
+            total += 4 * n
+            count += 1
+        if count and total > _VMEM_BUDGET_BYTES:
+            self._emit("GL006", fn,
+                       f"{count} literal BlockSpecs in {fn.name}() total "
+                       f"~{total / 2**20:.1f} MiB of blocks, over the "
+                       f"~{_VMEM_BUDGET_BYTES // 2**20} MiB VMEM budget")
+
+    # -- GL005 undated perf claims ----------------------------------------
+
+    def _lint_comments_and_docstrings(self) -> None:
+        if self.rules is not None and "GL005" not in self.rules:
+            return
+        blocks: List[Tuple[int, str]] = []   # (start line, text)
+
+        # contiguous comment runs
+        run_start, run_lines, run_text = None, 0, []
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except tokenize.TokenError:
+            tokens = []
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                line = tok.start[0]
+                if run_start is not None and line == run_lines + 1:
+                    run_text.append((line, tok.string))
+                    run_lines = line
+                else:
+                    if run_text:
+                        blocks.append((run_text[0][0],
+                                       "\n".join(t for _, t in run_text)))
+                    run_text = [(line, tok.string)]
+                    run_start, run_lines = line, line
+        if run_text:
+            blocks.append((run_text[0][0], "\n".join(t for _, t in run_text)))
+
+        # docstrings
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Module, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                body = getattr(node, "body", [])
+                if body and isinstance(body[0], ast.Expr) and \
+                        isinstance(body[0].value, ast.Constant) and \
+                        isinstance(body[0].value.value, str):
+                    blocks.append((body[0].lineno, body[0].value.value))
+
+        for start, text in blocks:
+            if "graft-lint:" in text and "allow-undated-perf" in text:
+                continue    # suppression handled by line machinery
+            m = _PERF_CLAIM_RE.search(text)
+            if m and not _DATED_RE.search(text):
+                claim_line = start + text[: m.start()].count("\n")
+                self._emit("GL005", claim_line,
+                           f"perf claim {m.group(0).strip()!r} has no "
+                           "date/round/artifact citation (add e.g. "
+                           "'(r5, BENCH_r05.json)')")
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Set[str]] = None) -> List[Finding]:
+    return FileLinter(path, source, rules).run()
+
+
+def lint_file(path, rules: Optional[Set[str]] = None) -> List[Finding]:
+    p = Path(path)
+    try:
+        source = p.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("GL000", str(p), 0, f"unreadable: {e}")]
+    try:
+        return lint_source(source, str(p), rules)
+    except SyntaxError as e:
+        return [Finding("GL000", str(p), e.lineno or 0, f"syntax error: {e.msg}")]
+
+
+def lint_paths(paths: Sequence, rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint files and directories (``**/*.py``, skipping __pycache__)."""
+    findings: List[Finding] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files = sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        else:
+            files = [p]
+        for f in files:
+            findings.extend(lint_file(f, rules))
+    return findings

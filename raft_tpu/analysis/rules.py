@@ -1,0 +1,232 @@
+"""graft-lint rule registry, findings, and inline suppressions.
+
+The JAX port of the reference loses its compile-time invariant net (the
+``RAFT_EXPLICIT_INSTANTIATE_ONLY`` template guards of
+``util/raft_explicit.hpp`` fail the *build* when a hot path drifts from
+the vetted instantiations). This module is the registry half of the
+rebuilt net: every TPU-correctness hazard class we have actually hit
+gets a rule id, and every intentional exception gets an inline,
+*reasoned* suppression instead of silence.
+
+Suppression syntax (same line as the finding or the line above)::
+
+    x = np.asarray(counts)  # graft-lint: allow-host-sync build-time packing
+
+``allow-<slug> <reason>`` — the reason is required; a bare allow is
+itself reported (rule GL000) so suppressions stay auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str            # "GL001"
+    slug: str          # "host-sync" — the token used in suppressions
+    summary: str       # one line for --list-rules / docs
+    rationale: str     # why this class bites on TPU
+
+
+RULES: Dict[str, Rule] = {}
+_SLUG_TO_ID: Dict[str, str] = {}
+
+
+def register_rule(rule_id: str, slug: str, summary: str, rationale: str = "") -> Rule:
+    if rule_id in RULES:
+        raise ValueError(f"duplicate rule id {rule_id}")
+    if slug in _SLUG_TO_ID:
+        raise ValueError(f"duplicate rule slug {slug}")
+    rule = Rule(rule_id, slug, summary, rationale)
+    RULES[rule_id] = rule
+    _SLUG_TO_ID[slug] = rule_id
+    return rule
+
+
+def rule_for_slug(slug: str) -> Optional[Rule]:
+    rid = _SLUG_TO_ID.get(slug)
+    return RULES[rid] if rid else None
+
+
+register_rule(
+    "GL000", "bare-suppression",
+    "suppression without a reason",
+    "a suppression that does not say why cannot be audited; the reference's "
+    "template guards force a comment at every explicit instantiation",
+)
+register_rule(
+    "GL001", "host-sync",
+    "host synchronisation on a device value (.item()/float()/np.asarray) "
+    "or inside traced scope",
+    "each sync stalls the TPU pipeline for a host round trip; TPU-KNN "
+    "(arxiv 2206.14286) holds peak FLOP/s only with zero host round trips "
+    "per batch",
+)
+register_rule(
+    "GL002", "tracer-branch",
+    "Python control flow on a traced value inside jit/pallas scope",
+    "branching on a tracer either raises ConcretizationTypeError or forces "
+    "a silent host sync + recompile per branch outcome",
+)
+register_rule(
+    "GL003", "int-float-ordering",
+    "float32/bf16 cast of a >=32-bit integer value feeding an ordering op "
+    "(sort/top_k/argmin/select_k)",
+    "float32 has a 24-bit mantissa: ids/counts above 2^24 collapse to equal "
+    "keys and the selection silently reorders (the ADVICE-r5 class, fixed "
+    "in PR 1 by integer-domain select)",
+)
+register_rule(
+    "GL004", "f64",
+    "float64 in potential device code paths",
+    "with jax x64 disabled (our default), f64 requests silently downcast to "
+    "f32 on device — the computed result differs from the written intent; "
+    "host-side NumPy f64 is fine but must say so",
+)
+register_rule(
+    "GL007", "recompile",
+    "redundant retraces across a shape sweep (jaxpr engine only)",
+    "TPU-KNN holds peak FLOP/s only when steady-state serving never "
+    "recompiles; a repeat sweep over identical shapes must add zero "
+    "traces",
+)
+register_rule(
+    "GL005", "undated-perf",
+    "quantified performance claim without a date/round/artifact citation",
+    "undated claims outlive the code they measured (VERDICT weak #7); every "
+    "number must name its round or artifact so staleness is detectable",
+)
+register_rule(
+    "GL006", "blockspec",
+    "Pallas BlockSpec off the (sublane, 128) tile grid, or block set over "
+    "the VMEM budget",
+    "TPU tiles are (8,128) f32 / (16,128) bf16 / (32,128) int8; off-grid "
+    "trailing dims force relayouts, and blocks past ~16 MB VMEM per core "
+    "fail to lower or thrash",
+)
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                  # rule id, e.g. "GL003"
+    path: str
+    line: int
+    message: str
+    engine: str = "ast"        # "ast" | "jaxpr"
+    suppressed: bool = False
+    reason: str = ""           # the suppression's reason when suppressed
+
+    @property
+    def slug(self) -> str:
+        return RULES[self.rule].slug
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "slug": self.slug,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "engine": self.engine,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+    def render(self) -> str:
+        mark = " [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} ({self.slug}) {self.message}{mark}"
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*allow-([a-z0-9][a-z0-9-]*)(?:\s+(\S.*?))?\s*$"
+)
+
+
+@dataclasses.dataclass
+class Suppression:
+    slug: str
+    reason: str
+    line: int
+    used: bool = False
+
+
+def scan_suppressions(source: str) -> List[Suppression]:
+    """Parse ``# graft-lint: allow-<slug> <reason>`` markers from source.
+
+    Tokenize-based so markers quoted inside string literals/docstrings
+    (e.g. documentation showing the syntax) do not register as live
+    suppressions; falls back to a line scan only when the file does not
+    tokenize."""
+    out: List[Suppression] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                out.append(Suppression(
+                    m.group(1), (m.group(2) or "").strip(), tok.start[0]))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                out.append(Suppression(
+                    m.group(1), (m.group(2) or "").strip(), lineno))
+    return out
+
+
+def apply_suppressions(
+    findings: Iterable[Finding], suppressions: List[Suppression], path: str
+) -> List[Finding]:
+    """Mark findings covered by a suppression on the same or previous line.
+
+    Bare suppressions (no reason) and suppressions for unknown slugs are
+    reported as GL000 findings; unused suppressions are left alone (a
+    rule may legitimately stop firing after a refactor).
+    """
+    by_line: Dict[int, List[Suppression]] = {}
+    out: List[Finding] = []
+    for s in suppressions:
+        by_line.setdefault(s.line, []).append(s)
+        if rule_for_slug(s.slug) is None:
+            out.append(Finding(
+                "GL000", path, s.line,
+                f"suppression names unknown rule slug {s.slug!r}",
+            ))
+        elif not s.reason:
+            out.append(Finding(
+                "GL000", path, s.line,
+                f"allow-{s.slug} has no reason; write "
+                f"'# graft-lint: allow-{s.slug} <why>'",
+            ))
+    for f in findings:
+        for cand_line in (f.line, f.line - 1):
+            hit = next(
+                (s for s in by_line.get(cand_line, ()) if s.slug == f.slug),
+                None,
+            )
+            if hit is not None:
+                f.suppressed = True
+                f.reason = hit.reason or "(no reason given)"
+                hit.used = True
+                break
+        out.append(f)
+    return out

@@ -110,8 +110,9 @@ def synthetic_dataset_device(n, dim, n_queries, seed=0, intrinsic_dim=16,
                              block: int = 4 << 20):
     """Same manifold recipe as ``synthetic_dataset`` generated ON DEVICE
     with jax.random (bit-different values, identical structure). On the
-    tunnelled dev TPU, host->device of a 10M-row dataset costs minutes at
-    ~20 MB/s while real TPU hosts move it over PCIe in under a second —
+    tunnelled dev TPU (r4), host->device of a 10M-row dataset costs
+    minutes at ~20 MB/s while real TPU hosts move it over PCIe in under
+    a second —
     device-side generation keeps benchmarks about the framework, not the
     tunnel. Generated in fixed-shape row blocks so each generator
     program's temporaries stay at ``block`` rows; the assembled output

@@ -84,6 +84,7 @@ def build_dendrogram_host(
     cluster_of = np.arange(n, dtype=np.int64)  # root -> current cluster id
     size = np.ones(2 * n - 1, np.int64)
     children = np.zeros((n - 1, 2), np.int64)
+    # graft-lint: allow-f64 host-side SciPy-parity dendrogram accumulation
     deltas = np.zeros(n - 1, np.float64)
     sizes = np.zeros(n - 1, np.int64)
     t = 0
@@ -155,6 +156,7 @@ def _geometric_mst(x, metric) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     return (
         np.asarray(src_out, np.int64),
         np.asarray(dst_out, np.int64),
+        # graft-lint: allow-f64 host-side SciPy-parity linkage output dtype
         np.asarray(w_out, np.float64),
     )
 
@@ -191,6 +193,7 @@ def single_linkage(
         src_d, dst_d, w_d, colors_dev = sparse_solver.mst(sym)
         src = src_d.astype(np.int64)
         dst = dst_d.astype(np.int64)
+        # graft-lint: allow-f64 host-side SciPy-parity linkage output dtype
         w = w_d.astype(np.float64)
         # repair disconnected KNN graphs (cross_component_nn loop);
         # Borůvka's final colors give the components for free — the host

@@ -6,7 +6,8 @@ detail/cagra/bitonic.hpp): the CUDA warp-shuffle compare-exchange becomes
 a static [.., L/(2j), 2, j] reshape pair-up — every substage is pure
 elementwise min/max/select on the VPU, so sorting a row costs zero
 dynamic gathers (lax.sort / argsort + take_along_axis lower to serial
-per-row gathers on TPU and measure ~5-10x slower at beam-search shapes).
+per-row gathers on TPU and measure ~5-10x slower at beam-search shapes,
+r3 v5e).
 
 Rows sort along the LAST axis, ascending by key, payloads carried by the
 same compare-exchange predicate. Length must be a power of two — callers

@@ -121,7 +121,8 @@ class SearchParams:
     # random seed candidates scored per query at startup (0 = auto:
     # max(2*itopk, 128) — generous because sparse seeding under-covers
     # clustered data; on smooth manifolds n_seeds=64 measured +20% QPS
-    # for -0.002 recall at SIFT-1M). Coarse entry-point seeding was
+    # r3 on v5e for -0.002 recall at SIFT-1M). Coarse entry-point
+    # seeding was
     # prototyped and measured: it buys ~nothing (recall at reduced
     # iteration counts is exploration-limited, not start-limited) while
     # adding build cost, so seeds stay random like the reference's.
@@ -149,8 +150,8 @@ class Index:
     deg neighbor ids]`` holding its graph neighbors' vectors
     int8-quantized plus their exact norms and ids, so beam-search
     expansion gathers ``width`` contiguous ~4.5 KB rows per query
-    instead of ``3*width`` scattered ones (measured ~7x faster on v5e;
-    see ops/beam_step.py for the decode). Rebuilt on load; never
+    instead of ``3*width`` scattered ones (measured ~7x faster r3 on
+    v5e; see ops/beam_step.py for the decode). Rebuilt on load; never
     serialized."""
 
     dataset: jax.Array      # [n, d]
@@ -320,8 +321,8 @@ def build_knn_graph(
     k = int(intermediate_degree) + 1          # +1: drop self afterwards
     # The fused Pallas IVF scan auto-dispatches only at k <= 64 (its
     # exact in-kernel extraction budget); k=65 searches fall back to the
-    # XLA decode-scan, measured 5x slower (2.53 s vs 0.50 s per 16k-query
-    # batch at SIFT-1M). When 63 candidate columns still satisfy the
+    # XLA decode-scan, measured 5x slower r4 on v5e (2.53 s vs 0.50 s
+    # per 16k-query batch at SIFT-1M). When 63 candidate columns still satisfy the
     # final graph degree, search k=64 and drop self (-> 63 exact-reranked
     # neighbors) to keep the whole self-search on the fast path; optimize
     # prunes to graph_degree anyway, so 64-vs-63 intermediate candidates
@@ -400,7 +401,7 @@ def _detour_counts_block(graph, start, rows: int, chunk: int):
     For node A with rank-sorted neighbors N: count[kAB] = #{kAD < kAB :
     N[kAB] in graph[N[kAD]]}. Membership is a vectorized D³ equality
     compare per chunk (the VPU chews through it; a binary search lowers
-    to a serial gather loop on TPU and is ~100x slower)."""
+    to a serial gather loop on TPU and is ~100x slower, r3 v5e)."""
     n, D = graph.shape
     gb = jax.lax.dynamic_slice(graph, (start, 0), (rows, D))
     tri = jnp.arange(D)[:, None] < jnp.arange(D)[None, :]  # kAD < kAB
@@ -882,7 +883,8 @@ def _beam_search_pallas(
 ):
     """Fused beam search: XLA gathers the packed int32 neighbor rows
     (row gathers are XLA's strength; the int32 fused row measured ~7x
-    faster than separate int8-codes + norms + graph gathers); everything
+    faster r3 on v5e than separate int8-codes + norms + graph
+    gathers); everything
     else in the iteration — int8 decode + scoring, bitonic merge,
     windowed dedup, parent pickup — runs in one Pallas kernel with the
     itopk buffer resident in VMEM (ops/beam_step.py; the reference keeps

@@ -80,7 +80,8 @@ class IndexParams:
     add_data_on_build: bool = True
     conservative_memory_allocation: bool = False  # API parity; no-op here
     # coarse-quantizer training GEMM dtype: "f32" (HIGH-precision passes,
-    # safe for tightly clustered data) or "bf16" (~3x faster training)
+    # safe for tightly clustered data) or "bf16" (~3x faster training,
+    # r2 v5e)
     kmeans_compute_dtype: str = "f32"
     # stored-vector dtype: "f32" keeps the dataset bit-exact (reference
     # ivf_flat stores raw T); "bf16" halves list-scan HBM bytes — the
@@ -106,7 +107,7 @@ class SearchParams:
 
     n_probes: int = 20
     # TPU tuning knobs (no reference analog): queries per list-group matmul
-    # and list blocks processed per XLA scan step (measured on v5e:
+    # and list blocks processed per XLA scan step (measured r2 on v5e:
     # 8 -> 4.7k QPS, 32 -> 11.2k, 64 -> 14.7k on SIFT-1M; 32 balances
     # compile time vs throughput)
     query_group: int = 256
@@ -126,7 +127,8 @@ class SearchParams:
     # recall target for the FINAL cross-probe merge. Default 1.0 = exact
     # final selection, matching the reference (ivf_flat_search-inl.cuh:194
     # runs exact select_k); set < 1.0 to use lax.approx_min_k there too
-    # (measured ~1.2x QPS at 0.95 for ~0.5% recall on SIFT-1M).
+    # (measured r2 on v5e: ~1.2x QPS at 0.95 for ~0.5% recall on
+    # SIFT-1M).
     merge_recall_target: float = 1.0
     # scan backend: "auto" picks the fused Pallas kernel on TPU when the
     # index layout allows it, else the XLA bucketized scan. Explicit:
@@ -710,7 +712,7 @@ def _resolve_scan_impl(requested: str, cap: int, kl: int,
     RAFT_TPU_TUNING=off) additionally requires k <= 64: the kernel's
     R-deep binned extraction supports k <= 256 (force with
     scan_impl="pallas"), but the k-pass unrolled extraction measured
-    ~7x slower end-to-end than the XLA path at k=130 (CAGRA
+    ~7x slower end-to-end than the XLA path at k=130 (r4 v5e; CAGRA
     self-search, SIFT-100k). Everything else runs the XLA bucketized
     scan."""
     if requested != "auto":

@@ -8,9 +8,9 @@ in CTA shared memory lives here in VMEM:
 
 * the itopk result buffer (distances, ids, explored flags),
 * int8 candidate scoring from the PACKED neighbor rows (one int32 row
-  per parent carries codes + norms + neighbor ids; measured on v5e: one
-  fused int32 row gather is ~7x faster than separate int8-codes +
-  norms + graph gathers of the same bytes),
+  per parent carries codes + norms + neighbor ids; measured r3 on v5e
+  (PALLAS_PARITY_r03.json): one fused int32 row gather is ~7x faster
+  than separate int8-codes + norms + graph gathers of the same bytes),
 * the bitonic merge network,
 * windowed duplicate collapse (the visited-hashmap analog), and
 * next-iteration parent selection,
@@ -353,6 +353,7 @@ def beam_merge_step(
         dwq = qrep.shape[2]
         inputs += [qrep, pack.reshape(m, width * W), parents]
         in_specs += [
+            # graft-lint: allow-blockspec 4-row byte-lane query replication; padded sublane measured a net win (r3)
             pl.BlockSpec((g, 4, dwq), lambda i: (i, 0, 0)),
             pl.BlockSpec((g, width * W), lambda i: (i, 0)),
             pl.BlockSpec((width, g), col),

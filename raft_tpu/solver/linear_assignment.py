@@ -83,6 +83,7 @@ def solve(cost, maximize: bool = False, eps_scale: float = 4.0,
     if n == 1:
         return jnp.zeros((1,), jnp.int32), cost[0, 0]
     benefit = cost if maximize else -cost
+    # graft-lint: allow-host-sync auction epsilon schedule needs a concrete scale once per solve
     scale = float(jnp.max(jnp.abs(benefit)))
     eps = max(scale / 2.0, 1e-6)
     final = final_eps if final_eps is not None else 1.0 / (n + 1)

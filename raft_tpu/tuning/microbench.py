@@ -302,7 +302,8 @@ def merge_grid(quick: bool = True) -> List[Dict]:
 def scan_grid(quick: bool = True) -> List[Dict]:
     del quick
     # the k=130 exact row covers the known pallas weak spot (the k-pass
-    # unrolled extraction measured ~7x slower than XLA at k=130) so the
+    # unrolled extraction measured ~7x slower than XLA at k=130, r4
+    # v5e) so the
     # table's interpolation radius cannot route mid-k exact searches
     # onto an unmeasured arm
     return [{"n": _SCAN_N, "k": 10, "approx": True, "n_lists": 64,

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""graft-lint launcher (repo checkout form of the ``graft-lint`` console
+script): AST + jaxpr static analysis for TPU correctness hazards.
+
+    python scripts/graft_lint.py --format=json raft_tpu/
+    python scripts/graft_lint.py --engine=both raft_tpu/
+    python scripts/graft_lint.py --list-rules
+
+See docs/static_analysis.md for the rule catalog and suppression syntax.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,4 +115,21 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # dead-backend exit guard (VERDICT next-round #7): with axon installed
+    # but unreachable, plain sys.exit hangs in the plugin's atexit client
+    # teardown and the caller reads rc=124 instead of the probe's rc=1.
+    # Exception paths (argparse SystemExit, crashes mid-battery) must hit
+    # the guard too, or the hang recurs exactly when things go wrong.
+    sys.path.insert(0, ROOT)
+    from raft_tpu.core.exit_guard import guarded_exit
+
+    try:
+        rc = main()
+    except SystemExit as e:
+        rc = e.code if isinstance(e.code, int) else (0 if e.code is None else 1)
+    except BaseException:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        rc = 1
+    guarded_exit(rc)

@@ -1,0 +1,406 @@
+"""graft-lint engine 1 (AST) tests: per-rule positive/negative fixtures,
+suppression machinery, CLI exit codes, and the tier-1 gate over the
+shipped tree (zero unsuppressed findings — the JAX-port analog of the
+reference's RAFT_EXPLICIT_INSTANTIATE_ONLY build gate)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from raft_tpu.analysis.cli import main as cli_main
+from raft_tpu.analysis.lint import lint_paths, lint_source
+from raft_tpu.analysis.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "raft_tpu")
+
+
+def _rules(src, only=None):
+    findings = lint_source(textwrap.dedent(src), "fixture.py")
+    open_f = [f for f in findings if not f.suppressed]
+    if only:
+        open_f = [f for f in open_f if f.rule == only]
+    return [f.rule for f in open_f], open_f
+
+
+# ---------------------------------------------------------------------------
+# GL001 host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_gl001_item_in_jit_positive():
+    rules, _ = _rules("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def hot(x):
+            return x + x.max().item()
+    """)
+    assert "GL001" in rules
+
+
+def test_gl001_float_of_jnp_positive():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            return float(jnp.max(jnp.abs(x)))
+    """)
+    assert rules == ["GL001"]
+
+
+def test_gl001_np_asarray_in_scan_body_positive():
+    rules, _ = _rules("""
+        import jax, numpy as np
+
+        def outer(xs):
+            def step(carry, x):
+                return carry + np.asarray(x), None
+            return jax.lax.scan(step, 0.0, xs)
+    """)
+    assert "GL001" in rules
+
+
+def test_gl001_traced_param_float_positive():
+    rules, _ = _rules("""
+        import jax, functools
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def hot(x, k):
+            return float(x) + k
+    """)
+    assert "GL001" in rules
+
+
+def test_gl001_static_arg_and_host_code_negative():
+    rules, _ = _rules("""
+        import jax, functools, numpy as np
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def hot(x, k):
+            return x * int(k)          # static arg: fine
+
+        def host(meta):
+            return float(meta["arg"]) + int(3)   # no device values
+
+        def build(rows):
+            return np.asarray(rows)    # numpy-on-numpy: fine
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL002 tracer-branch
+# ---------------------------------------------------------------------------
+
+
+def test_gl002_branch_on_jnp_positive():
+    rules, _ = _rules("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def hot(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """)
+    assert "GL002" in rules
+
+
+def test_gl002_while_on_traced_param_positive():
+    rules, _ = _rules("""
+        import jax
+
+        @jax.jit
+        def hot(n):
+            while n > 0:
+                n = n - 1
+            return n
+    """)
+    assert "GL002" in rules
+
+
+def test_gl002_negatives():
+    rules, _ = _rules("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def hot(x, norms=None):
+            if norms is None:                 # structural: fine
+                norms = jnp.sum(x * x, 1)
+            if x.dtype == jnp.bfloat16:       # metadata: fine
+                x = x.astype(jnp.float32)
+            if jnp.issubdtype(x.dtype, jnp.floating):   # metadata call
+                x = x + 1
+            return x, norms
+
+        def host(x):
+            if jnp.any(x > 0):                # outside traced scope: fine
+                return 1
+            return 0
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL003 int->float ordering
+# ---------------------------------------------------------------------------
+
+
+def test_gl003_astype_into_topk_positive():
+    rules, _ = _rules("""
+        import jax, jax.numpy as jnp
+
+        def select(n, k):
+            ids = jnp.arange(n)
+            keys = ids.astype(jnp.float32)      # >2^24 collapse
+            return jax.lax.top_k(-keys, k)
+    """)
+    assert "GL003" in rules
+
+
+def test_gl003_direct_nesting_positive():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def worst(indices):
+            return jnp.argsort(indices.astype(jnp.float32))
+    """)
+    assert "GL003" in rules
+
+
+def test_gl003_negatives():
+    rules, _ = _rules("""
+        import jax, jax.numpy as jnp
+
+        def fine(dists, k):
+            return jax.lax.top_k(-dists.astype(jnp.float32), k)  # floats in
+
+        def also_fine(ids):
+            return ids.astype(jnp.float32) * 2.0   # no ordering consumer
+    """, only="GL003")
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 f64
+# ---------------------------------------------------------------------------
+
+
+def test_gl004_positive_and_string_dtype():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            return x.astype(jnp.float64)
+
+        def g(x):
+            return x.astype("float64")
+    """)
+    assert rules.count("GL004") == 2
+
+
+def test_gl004_negative():
+    rules, _ = _rules("""
+        import numpy as np
+
+        def f(x):
+            return x.astype(np.float32)
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 undated-perf
+# ---------------------------------------------------------------------------
+
+
+def test_gl005_undated_comment_positive():
+    rules, _ = _rules("""
+        # the fused path is ~3x faster than the scattered one
+        X = 1
+    """)
+    assert rules == ["GL005"]
+
+
+def test_gl005_undated_docstring_qps_positive():
+    rules, _ = _rules('''
+        def search():
+            """Runs at 195 QPS on SIFT-1M."""
+    ''')
+    assert rules == ["GL005"]
+
+
+def test_gl005_dated_negatives():
+    rules, _ = _rules('''
+        # the fused path is ~3x faster (r3, v5e) than the scattered one
+        def search():
+            """14.7k QPS on SIFT-1M (BENCH_r02.json)."""
+
+        def qualitative():
+            """dramatically faster than a full sort for k << c"""
+    ''')
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL006 blockspec
+# ---------------------------------------------------------------------------
+
+
+def test_gl006_off_tile_positive():
+    rules, _ = _rules("""
+        from jax.experimental import pallas as pl
+
+        def kernel_specs():
+            return [pl.BlockSpec((16, 100), lambda i: (i, 0)),
+                    pl.BlockSpec((12, 256), lambda i: (i, 0))]
+    """)
+    assert rules.count("GL006") == 2   # 100 % 128, 12 % 8
+
+
+def test_gl006_vmem_budget_positive():
+    rules, _ = _rules("""
+        from jax.experimental import pallas as pl
+
+        def huge():
+            return pl.BlockSpec((8192, 1024), lambda i: (i, 0))
+    """)
+    assert "GL006" in rules            # 32 MiB > 16 MiB budget
+
+
+def test_gl006_negatives():
+    rules, _ = _rules("""
+        from jax.experimental import pallas as pl
+
+        def ok(cap, g):
+            return [pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                    pl.BlockSpec((1, 1, cap), lambda i: (i, 0, 0)),
+                    pl.BlockSpec((g, 256), lambda i: (i, 0))]
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line_and_line_above():
+    src = """
+        import jax.numpy as jnp
+
+        def a(x):
+            return float(jnp.max(x))  # graft-lint: allow-host-sync scalar epsilon
+
+        def b(x):
+            # graft-lint: allow-host-sync certification loop by design
+            return float(jnp.max(x))
+    """
+    findings = lint_source(textwrap.dedent(src), "fixture.py")
+    assert all(f.suppressed for f in findings if f.rule == "GL001")
+    assert sum(f.rule == "GL001" for f in findings) == 2
+
+
+def test_bare_suppression_reported():
+    rules, fs = _rules("""
+        import jax.numpy as jnp
+
+        def a(x):
+            return float(jnp.max(x))  # graft-lint: allow-host-sync
+    """)
+    assert "GL000" in rules            # reason missing
+    assert "GL001" not in rules        # ...but the suppression still applies
+
+
+def test_unknown_slug_reported():
+    rules, _ = _rules("""
+        X = 1  # graft-lint: allow-no-such-rule because reasons
+    """)
+    assert rules == ["GL000"]
+
+
+def test_suppression_inside_string_literal_is_inert():
+    """Documentation quoting the syntax must not register a live
+    suppression for the next line."""
+    rules, _ = _rules('''
+        import jax.numpy as jnp
+
+        DOC = """example: x = 1  # graft-lint: allow-host-sync build"""
+        Y = float(jnp.asarray(2.0))
+    ''')
+    assert rules == ["GL001"]          # NOT suppressed by the docstring
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_exit_nonzero_on_seeded_bug(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def hot(x):
+            return x + x.max().item()
+    """))
+    rc = cli_main(["--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "GL001" for f in out["findings"])
+
+
+def test_cli_exit_zero_on_clean_file(tmp_path, capsys):
+    good = tmp_path / "good.py"
+    good.write_text("import jax.numpy as jnp\n\n\ndef f(x):\n    return x\n")
+    assert cli_main(["--format=json", str(tmp_path)]) == 0
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+@pytest.mark.parametrize("seed, rule", [
+    ("import jax\n\n@jax.jit\ndef hot(x):\n    return x.sum().item()\n",
+     "GL001"),
+    ('def search():\n    """Serves 12.5k QPS on SIFT-1M."""\n', "GL005"),
+    ("import jax, jax.numpy as jnp\n\ndef f(ids, k):\n"
+     "    return jax.lax.top_k(ids.astype(jnp.float32), k)\n", "GL003"),
+])
+def test_cli_acceptance_seeds(tmp_path, capsys, seed, rule):
+    """ISSUE acceptance: each seeded hazard class exits nonzero naming
+    its rule."""
+    (tmp_path / "seeded.py").write_text(seed)
+    rc = cli_main(["--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == rule for f in out["findings"]), out
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate (AST half; jaxpr half in test_jaxpr_audit.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.static_analysis
+def test_gate_tree_is_lint_clean():
+    findings = lint_paths([PKG])
+    open_f = [f for f in findings if not f.suppressed]
+    assert not open_f, "unsuppressed graft-lint findings:\n" + "\n".join(
+        f.render() for f in open_f)
+
+
+@pytest.mark.static_analysis
+def test_gate_suppressions_all_have_reasons():
+    findings = lint_paths([PKG])
+    for f in findings:
+        if f.suppressed:
+            assert f.reason and f.reason != "(no reason given)", f.render()

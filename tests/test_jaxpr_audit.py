@@ -1,0 +1,151 @@
+"""graft-lint engine 2 (jaxpr) tests: the entry-point registry traces on
+CPU with zero findings (the tier-1 gate's second half), the auditor
+catches a planted int->f32 ordering bug (regression for the ADVICE-r5
+>2^24 class), f64 leaks, host callbacks, and the select_k recompile
+audit passes its shape sweep."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.analysis.jaxpr_audit import (
+    ENTRY_POINTS,
+    _Auditor,
+    audit_select_k_recompiles,
+    run_audit,
+)
+
+
+@pytest.fixture(scope="module")
+def audit():
+    findings, report = run_audit()
+    return findings, report
+
+
+def test_registry_covers_the_public_surface():
+    assert {"brute_force", "ivf_flat", "ivf_pq", "cagra", "select_k",
+            "pairwise"} <= set(ENTRY_POINTS)
+
+
+@pytest.mark.static_analysis
+def test_gate_all_entry_points_trace_clean_on_cpu(audit):
+    findings, report = audit
+    open_f = [f for f in findings if not f.suppressed]
+    assert not open_f, "unsuppressed jaxpr-audit findings:\n" + "\n".join(
+        f.render() for f in open_f)
+    assert set(report["entry_points"]) == set(ENTRY_POINTS)
+
+
+@pytest.mark.static_analysis
+def test_gate_recompile_audit_passes(audit):
+    _, report = audit
+    rec = report["recompile"]
+    assert rec["status"] == "ok", rec
+    assert rec["compiles_first_sweep"] >= len(rec["shapes"]) > 0
+    assert rec["retraces_second_sweep"] == 0
+
+
+# ---------------------------------------------------------------------------
+# planted hazards (regression tests for the classes the rules encode)
+# ---------------------------------------------------------------------------
+
+
+def test_planted_int_to_f32_ordering_bug_is_caught():
+    """The exact ADVICE-r5 class: ids above 2^24 collapse when selected
+    through an f32 cast. The auditor must flag it without running any
+    hot-path code."""
+
+    def bad(x):
+        ids = jnp.arange(x.shape[1], dtype=jnp.int32)
+        keys = x + ids.astype(jnp.float32)
+        return jax.lax.top_k(keys, 8)
+
+    a = _Auditor("planted")
+    a.walk(jax.make_jaxpr(bad)(jnp.ones((4, 64))))
+    assert any(f.rule == "GL003" for f in a.findings)
+
+
+def test_planted_bug_is_caught_through_jit_boundary():
+    def bad(x):
+        ids = jnp.arange(x.shape[1], dtype=jnp.int32)
+        return jnp.argsort(ids.astype(jnp.float32) - x[0])
+
+    a = _Auditor("planted-jit")
+    a.walk(jax.make_jaxpr(jax.jit(bad))(jnp.ones((4, 64))))
+    assert any(f.rule == "GL003" for f in a.findings)
+
+
+def test_planted_bug_is_caught_inside_scan_body():
+    def bad(xs):
+        def step(carry, x):
+            ids = jnp.arange(64, dtype=jnp.int32)
+            _, sel = jax.lax.top_k(ids.astype(jnp.float32), 8)
+            return carry, sel
+        return jax.lax.scan(step, 0.0, xs)
+
+    a = _Auditor("planted-scan")
+    a.walk(jax.make_jaxpr(bad)(jnp.ones((3, 64))))
+    assert any(f.rule == "GL003" for f in a.findings)
+
+
+def test_clean_float_ordering_not_flagged():
+    def fine(x):
+        return jax.lax.top_k(-x, 8)          # float keys: the normal case
+
+    a = _Auditor("clean")
+    a.walk(jax.make_jaxpr(fine)(jnp.ones((4, 64))))
+    assert not a.findings
+
+
+def test_int8_decode_not_flagged():
+    """int8 code decode to f32 is exact (8 bits << 24-bit mantissa) —
+    the auditor must not cry wolf on the quantized scoring paths."""
+
+    def fine(codes, k):
+        return jax.lax.top_k(codes.astype(jnp.float32), k)
+
+    a = _Auditor("int8")
+    a.walk(jax.make_jaxpr(lambda c: fine(c, 4))(
+        jnp.zeros((4, 64), jnp.int8)))
+    assert not a.findings
+
+
+def test_f64_leak_is_caught():
+    x64_was = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        def leak(x):
+            return x.astype(jnp.float64) * 2.0
+
+        a = _Auditor("f64")
+        a.walk(jax.make_jaxpr(leak)(jnp.ones((4,), jnp.float32)))
+        assert a.f64_count > 0
+    finally:
+        jax.config.update("jax_enable_x64", x64_was)
+
+
+def test_host_callback_is_caught():
+    def cb(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+    a = _Auditor("callback")
+    a.walk(jax.make_jaxpr(cb)(jnp.ones((4,), jnp.float32)))
+    assert any(f.rule == "GL001" for f in a.findings)
+
+
+# ---------------------------------------------------------------------------
+# recompile audit mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_audit_counts_each_shape_once():
+    findings, report = audit_select_k_recompiles(
+        shapes=((2, 256), (2, 512)), k=8)
+    if report["status"] == "skipped":
+        pytest.skip(report["detail"])
+    assert not findings
+    assert report["compiles_first_sweep"] >= 2
+    assert report["retraces_second_sweep"] == 0
