@@ -368,6 +368,8 @@ class FileLinter:
             self._check_tracer_branch(node.test, kind="branch")
         elif isinstance(node, ast.For):
             self._check_tracer_branch(node.iter, kind="iteration")
+        elif isinstance(node, ast.Try):
+            self._check_unclassified_swallow(node)
         elif isinstance(node, ast.FunctionDef):
             self._check_vmem_budget(node)
 
@@ -573,6 +575,43 @@ class FileLinter:
                                ">=32-bit integer value cast to narrow float "
                                f"at line {tainted[nm]}: keys above 2^24 "
                                "collapse; select in integer domain")
+
+    # -- GL008 unclassified swallow ---------------------------------------
+
+    _BROAD_EXC = {"Exception", "BaseException"}
+
+    def _check_unclassified_swallow(self, node: ast.Try) -> None:
+        """``except Exception`` (or bare/``BaseException``) whose try body
+        touches device compute and whose handler neither re-raises nor
+        routes through ``resilience.classify()`` swallows the transient /
+        OOM / dead-backend distinction the resilience layer exists for."""
+        if not any(_contains_device_expr(s) for s in node.body):
+            return
+        for handler in node.handlers:
+            if handler.type is None:
+                names = set(self._BROAD_EXC)
+            elif isinstance(handler.type, ast.Tuple):
+                # `except (ValueError, Exception):` is just as broad
+                names = {_dotted(el) or "" for el in handler.type.elts}
+            else:
+                names = {_dotted(handler.type) or ""}
+            if not (names & self._BROAD_EXC):
+                continue
+            body_nodes = [x for s in handler.body for x in ast.walk(s)]
+            if any(isinstance(x, ast.Raise) for x in body_nodes):
+                continue                 # re-raised (possibly converted)
+            calls_classify = any(
+                isinstance(x, ast.Call)
+                and (_dotted(x.func) or "").rsplit(".", 1)[-1] == "classify"
+                for x in body_nodes
+            )
+            if calls_classify:
+                continue
+            self._emit("GL008", handler,
+                       "bare `except Exception` swallows device-compute "
+                       "failure without resilience.classify(): transient/"
+                       "OOM/dead-backend collapse into one silent fallback; "
+                       "classify, re-raise, or suppress with a reason")
 
     # -- GL004 f64 ---------------------------------------------------------
 

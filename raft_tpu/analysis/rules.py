@@ -100,6 +100,16 @@ register_rule(
     "traces",
 )
 register_rule(
+    "GL008", "unclassified-swallow",
+    "bare `except Exception` around device compute that neither calls "
+    "resilience.classify() nor re-raises",
+    "XLA serves transient, OOM, and dead-backend failures through ONE "
+    "exception type; a blanket swallow turns a retryable fault into silent "
+    "data loss and an OOM into a wrong-answer fallback. Route device-compute "
+    "failures through raft_tpu.resilience (classify/run) or re-raise; "
+    "genuinely fallback-only sites suppress with a reason",
+)
+register_rule(
     "GL005", "undated-perf",
     "quantified performance claim without a date/round/artifact citation",
     "undated claims outlive the code they measured (VERDICT weak #7); every "

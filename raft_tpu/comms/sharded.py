@@ -5,6 +5,20 @@ shard; queries are replicated; per-shard top-k results are merged. Consumers
 wire it with raft-dask + NCCL. Here the whole pattern is one ``shard_map``:
 the dataset is sharded over the mesh axis, each device runs the local
 search, and the shard top-ks are all-gathered and merged on-device over ICI.
+
+Graceful shard degradation (docs/resilience.md): the searches accept
+``partial_ok=True`` — a shard whose local result is invalid (NaN, or a
+rank named by an injected ``shard@rank:R`` fault) is masked to the
+worst-possible sentinel before ``merge_topk``, and the call returns the
+merged results plus a replicated coverage fraction instead of raising
+(the reference's ``knn_merge_parts`` multi-rank model tolerates exactly
+this per-rank variation). Detection runs when ``partial_ok=True`` OR a
+shard fault is injected; in the latter case ``partial_ok=False`` raises
+:class:`raft_tpu.resilience.ShardDropoutError` on any dropout. Without
+either, the plain path is compiled unchanged (no validity scan, no
+coverage collective) — a real NaN shard then propagates exactly as it
+did pre-resilience; callers that want NaN *detection* opt in with
+``partial_ok=True`` and check ``coverage < 1``.
 """
 
 from __future__ import annotations
@@ -14,12 +28,64 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.comms.compat import shard_map
 
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors.common import merge_topk
+from raft_tpu.resilience import ShardDropoutError, faultinject
+
+
+def _dead_rank_array() -> jax.Array:
+    """Injected-dead ranks as a replicated input array (NOT baked into
+    the trace, so jit caches stay valid across changing fault plans)."""
+    bad = sorted(faultinject.dead_ranks())
+    return jnp.asarray(bad if bad else [-1], jnp.int32)
+
+
+def _mask_invalid(d, i, rank, bad_ranks, select_min):
+    """Shard-local validity, PER QUERY ROW: a row is dropped when its
+    shard's rank is fault-injected dead (all rows) or its local top-k
+    carries NaN (the real-fault signature: a wedged collective / corrupt
+    block scores NaN). Row-granular on purpose — queries are replicated,
+    so one NaN *query* poisons the same row on every shard, and a
+    whole-shard verdict would sentinel all S shards over one bad input
+    row. Invalid rows score the worst-possible sentinel with ids -1, so
+    the cross-shard merge ranks every surviving candidate ahead of
+    them."""
+    dead = jnp.any(rank == bad_ranks)
+    row_ok = jnp.logical_not(dead | jnp.any(jnp.isnan(d), axis=1))  # [m]
+    sent = jnp.asarray(jnp.inf if select_min else -jnp.inf, d.dtype)
+    d = jnp.where(row_ok[:, None], d, sent)
+    i = jnp.where(row_ok[:, None], i, jnp.asarray(-1, i.dtype))
+    return d, i, row_ok
+
+
+def _coverage(valid, axis_name) -> jax.Array:
+    """Replicated surviving fraction over shards x query rows: a fully
+    dead shard of S costs 1/S; a single poisoned query row (invalid on
+    every shard, since queries are replicated) costs 1/m."""
+    flags = jax.lax.all_gather(valid.astype(jnp.float32), axis_name)
+    return jnp.mean(flags)
+
+
+def _finish_partial(out, partial_ok: bool, what: str):
+    """Host-side tail of a partial-capable search: hand back (d, i,
+    coverage) under ``partial_ok``, else raise on any dropout."""
+    d, i, cov = out
+    if partial_ok:
+        return d, i, cov
+    # fault-detection path without the partial opt-in: refuse to return
+    # silently-degraded results
+    if float(np.asarray(cov)) < 1.0:
+        raise ShardDropoutError(
+            f"{what}: shard coverage {float(np.asarray(cov)):.3f} < 1 "
+            "(a shard's local result was invalid); pass partial_ok=True "
+            "to accept partial results plus a coverage fraction"
+        )
+    return d, i
 
 
 def sharded_knn(
@@ -30,14 +96,23 @@ def sharded_knn(
     axis_name: str = "shard",
     metric="sqeuclidean",
     metric_arg: float = 2.0,
-) -> Tuple[jax.Array, jax.Array]:
+    partial_ok: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Exact KNN with the dataset row-sharded over ``mesh[axis_name]``.
 
-    Dataset rows must be divisible by the axis size (pad upstream). Queries
-    are replicated; each shard computes a local top-k with *global* ids
-    (rank offset added), then shard results are all-gathered and merged —
-    the knn_merge_parts-over-NCCL pattern
+    Dataset rows need NOT divide the axis size: non-divisible ``n`` is
+    auto-padded with sentinel rows whose distances mask to
+    worst-possible and whose ids mask to -1 inside the local search, so
+    they can only surface when ``k`` exceeds the real row count
+    ("pad upstream" was a robustness foot-gun). Queries are replicated;
+    each shard computes a local top-k with *global* ids (rank offset
+    added), then shard results are all-gathered and merged — the
+    knn_merge_parts-over-NCCL pattern
     (detail/knn_merge_parts.cuh + raft-dask) as a single XLA program.
+
+    ``partial_ok=True`` returns ``(dists, ids, coverage)`` with invalid
+    shards (NaN local results, injected dead ranks) masked out of the
+    merge — see the module docstring.
     """
     metric = resolve_metric(metric)
     queries = jnp.asarray(queries)
@@ -45,30 +120,56 @@ def sharded_knn(
     n = dataset.shape[0]
     nshards = mesh.shape[axis_name]
     if n % nshards != 0:
-        raise ValueError(f"dataset rows {n} not divisible by mesh axis {nshards}")
-    shard_rows = n // nshards
+        padded = -(-n // nshards) * nshards
+        dataset = jnp.concatenate(
+            [dataset,
+             jnp.zeros((padded - n,) + dataset.shape[1:], dataset.dtype)],
+            axis=0,
+        )
+    n_pad = dataset.shape[0] - n
+    shard_rows = dataset.shape[0] // nshards
     select_min = is_min_close(metric)
+    partial = partial_ok or faultinject.has_shard_faults()
+    # zero-filled pad rows DO score (a query near the origin ranks them
+    # well under L2), so the local top-k is widened by the pad count —
+    # at most n_pad real candidates can be displaced before the mask
+    # turns every pad row into the worst-possible sentinel
+    k_local = int(min(k + n_pad, shard_rows)) if n_pad else int(k)
 
-    def local(q, db_shard):
+    def local(q, db_shard, *rest):
         rank = jax.lax.axis_index(axis_name)
         d, i = brute_force._search(
-            q, db_shard, None, None, None, int(k), int(metric), float(metric_arg),
-            int(min(shard_rows, 8192)),
+            q, db_shard, None, None, None, k_local, int(metric),
+            float(metric_arg), int(min(shard_rows, 8192)),
         )
         i = i + (rank * shard_rows).astype(i.dtype)
+        if n_pad:
+            pad = i >= n
+            d = jnp.where(pad, jnp.asarray(
+                jnp.inf if select_min else -jnp.inf, d.dtype), d)
+            i = jnp.where(pad, jnp.asarray(-1, i.dtype), i)
+        if partial:
+            d, i, valid = _mask_invalid(d, i, rank, rest[0], select_min)
         # gather all shards' candidates onto every device, merge locally
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)  # [m, S*k]
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
-        return merge_topk(gd, gi, k, select_min)
+        md, mi = merge_topk(gd, gi, k, select_min)
+        if partial:
+            return md, mi, _coverage(valid, axis_name)
+        return md, mi
 
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), P(axis_name, None)),
-        out_specs=(P(), P()),
+        in_specs=(P(), P(axis_name, None)) + ((P(),) if partial else ()),
+        out_specs=(P(), P()) + ((P(),) if partial else ()),
         check_vma=False,
     )
-    return jax.jit(fn)(queries, dataset)
+    args = (queries, dataset) + ((_dead_rank_array(),) if partial else ())
+    out = jax.jit(fn)(*args)
+    if partial:
+        return _finish_partial(out, partial_ok, "sharded_knn")
+    return out
 
 
 def sharded_ivf_search(
@@ -78,7 +179,8 @@ def sharded_ivf_search(
     k: int,
     mesh: Mesh,
     axis_name: str = "shard",
-) -> Tuple[jax.Array, jax.Array]:
+    partial_ok: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Approximate KNN with the IVF index's *lists* sharded over the mesh.
 
     The reference's large-index multi-GPU model: each rank owns an index
@@ -89,6 +191,9 @@ def sharded_ivf_search(
     the per-shard top-ks are all-gathered + merged over ICI.
 
     Stored ids are global dataset row ids, so no rank offset is needed.
+
+    ``partial_ok=True`` returns ``(dists, ids, coverage)`` with invalid
+    shards masked out of the merge (module docstring).
     """
     from raft_tpu.neighbors import ivf_flat
 
@@ -115,9 +220,13 @@ def sharded_ivf_search(
     bucket_batch = int(search_params.bucket_batch)
 
     has_norms = index.data_norms is not None
+    partial = partial_ok or faultinject.has_shard_faults()
 
     def local(q, centers, storage, indices, list_sizes, *rest):
-        norms = rest[0] if has_norms else None
+        rest = list(rest)
+        norms = rest.pop(0) if has_norms else None
+        bad = rest.pop(0) if partial else None
+        rank = jax.lax.axis_index(axis_name)
         d, i = ivf_flat._ivf_search(
             q, centers, storage, indices, list_sizes,
             int(k), n_probes, metric, group, bucket_batch, 0,
@@ -126,9 +235,14 @@ def sharded_ivf_search(
             float(search_params.merge_recall_target),
             norms, None,
         )
+        if partial:
+            d, i, valid = _mask_invalid(d, i, rank, bad, select_min)
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)  # [m, S*k]
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
-        return merge_topk(gd, gi, k, select_min)
+        md, mi = merge_topk(gd, gi, k, select_min)
+        if partial:
+            return md, mi, _coverage(valid, axis_name)
+        return md, mi
 
     args = [queries, index.centers, index.storage, index.indices, index.list_sizes]
     in_specs = [P(), P(axis_name, None), P(axis_name, None, None),
@@ -136,15 +250,21 @@ def sharded_ivf_search(
     if has_norms:
         args.append(index.data_norms)
         in_specs.append(P(axis_name, None))
+    if partial:
+        args.append(_dead_rank_array())
+        in_specs.append(P())
 
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(), P()),
+        out_specs=(P(), P()) + ((P(),) if partial else ()),
         check_vma=False,
     )
-    return jax.jit(fn)(*args)
+    out = jax.jit(fn)(*args)
+    if partial:
+        return _finish_partial(out, partial_ok, "sharded_ivf_search")
+    return out
 
 
 def sharded_ivf_pq_search(
@@ -155,7 +275,8 @@ def sharded_ivf_pq_search(
     mesh: Mesh,
     axis_name: str = "shard",
     refine_ratio: int = 1,
-) -> Tuple[jax.Array, jax.Array]:
+    partial_ok: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Approximate KNN with the IVF-PQ index's *lists* sharded over the
     mesh — the DEEP-1B-scale model (the reference fits DEEP-1B in 24 GiB
     per GPU via PQ and shards across GPUs via comms,
@@ -177,6 +298,9 @@ def sharded_ivf_pq_search(
     indices, decodes those slots from ITS OWN cache shard at f32, ranks
     exactly, and only the refined top-k rides the all-gather. Requires
     the index to carry a residual cache.
+
+    ``partial_ok=True`` returns ``(dists, ids, coverage)`` with invalid
+    shards masked out of the merge (module docstring).
     """
     from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.ivf_flat import adaptive_query_group
@@ -232,6 +356,7 @@ def sharded_ivf_pq_search(
         )
 
     has_scales = has_cache and index.cache_scales is not None
+    partial = partial_ok or faultinject.has_shard_faults()
 
     def local(q, centers, centers_rot, rotation, pq_centers, codes,
               indices, list_sizes, rec_norms, *rest):
@@ -239,6 +364,8 @@ def sharded_ivf_pq_search(
         cache = rest.pop(0) if has_cache else None
         scales = rest.pop(0) if has_scales else None
         qnorms = rest.pop(0) if has_scales else None
+        bad = rest.pop(0) if partial else None
+        rank = jax.lax.axis_index(axis_name)
         search_ids = (ivf_pq._slot_indices(indices) if refine_ratio > 1
                       else indices)
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
@@ -261,9 +388,14 @@ def sharded_ivf_pq_search(
             i = jnp.where(
                 s >= 0, indices.reshape(-1)[jnp.maximum(s, 0)], -1
             )
+        if partial:
+            d, i, valid = _mask_invalid(d, i, rank, bad, select_min)
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
-        return merge_topk(gd, gi, k, select_min)
+        md, mi = merge_topk(gd, gi, k, select_min)
+        if partial:
+            return md, mi, _coverage(valid, axis_name)
+        return md, mi
 
     args = [queries, index.centers, index.centers_rot, index.rotation,
             index.pq_centers, index.codes, index.indices, index.list_sizes,
@@ -289,15 +421,21 @@ def sharded_ivf_pq_search(
               else index.rec_norms)
         args.append(qn)
         in_specs.append(P(axis_name, None))
+    if partial:
+        args.append(_dead_rank_array())
+        in_specs.append(P())
 
     fn = shard_map(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(), P()),
+        out_specs=(P(), P()) + ((P(),) if partial else ()),
         check_vma=False,
     )
-    return jax.jit(fn)(*args)
+    out = jax.jit(fn)(*args)
+    if partial:
+        return _finish_partial(out, partial_ok, "sharded_ivf_pq_search")
+    return out
 
 
 def sharded_ivf_pq_build(

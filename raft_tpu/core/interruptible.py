@@ -56,7 +56,7 @@ class Interruptible:
         def _wait():
             try:
                 jax.block_until_ready(arr)
-            except BaseException as e:  # propagate device errors
+            except BaseException as e:  # graft-lint: allow-unclassified-swallow captured and re-raised on the waiting thread after the poll loop
                 err.append(e)
             finally:
                 done.set()

@@ -428,18 +428,29 @@ def _detour_counts(graph, chunk: int, nodes_per_call: int = 1 << 16):
     ``nodes_per_call`` node range. A single program covering a large graph
     runs minutes on-device, which trips the remote platform's execution
     watchdog (observed: programs > ~2 min kill the TPU worker) — and
-    bounded dispatches also keep the scan transients small."""
+    bounded dispatches also keep the scan transients small.
+
+    The per-block dispatch is an OOM degradation-ladder boundary
+    (docs/resilience.md): the block [chunk, D, D] membership transients
+    are the build's peak, and a RESOURCE_EXHAUSTED here used to kill an
+    n=300k build outright. Each block is synced before the next dispatch
+    (recovery needs the failure AT its block, and the blocks were
+    device-serialized anyway); on OOM the node range halves, sticks for
+    the remaining blocks, and is recorded as the ``cagra_detour_rows``
+    runtime budget so later builds in the process start safe."""
+    from raft_tpu import tuning
+    from raft_tpu.resilience import degrade
+
     graph = jnp.asarray(graph)
     n, _ = graph.shape
-    if n <= nodes_per_call:
-        return _detour_counts_block(graph, jnp.int32(0), n, chunk)
-    parts = [
-        _detour_counts_block(
-            graph, jnp.int32(s), min(nodes_per_call, n - s), chunk
-        )
-        for s in range(0, n, nodes_per_call)
-    ]
-    return jnp.concatenate(parts, axis=0)
+    block = max(1, int(tuning.budget("cagra_detour_rows",
+                                     int(nodes_per_call))))
+    parts = list(degrade.run_shrinking_blocks(
+        lambda s, rows: _detour_counts_block(graph, jnp.int32(s), rows,
+                                             chunk),
+        n, block, budget_name="cagra_detour_rows", stage="cagra.detour",
+    ))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))

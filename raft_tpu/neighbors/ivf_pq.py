@@ -535,6 +535,46 @@ def _stream_encode(params: IndexParams, index: Index, dataset, n: int,
     ))
 
 
+def _restore_quantizer(params: IndexParams, arrays, dim: int) -> Index:
+    """Rebuild the empty quantizer Index from checkpointed arrays — the
+    resume path must NOT retrain kmeans (bitwise identity of the resumed
+    build is anchored on the exact quantizers the killed run used)."""
+    n_lists = int(params.n_lists)
+    pq_dim = int(params.pq_dim) or _auto_pq_dim(dim)
+    return Index(
+        centers=jnp.asarray(arrays["centers"]),
+        centers_rot=jnp.asarray(arrays["centers_rot"]),
+        rotation=jnp.asarray(arrays["rotation"]),
+        pq_centers=jnp.asarray(arrays["pq_centers"]),
+        codes=jnp.zeros(
+            (n_lists, 0, packed_words(pq_dim, int(params.pq_bits))),
+            jnp.uint32,
+        ),
+        indices=jnp.full((n_lists, 0), -1, jnp.int32),
+        list_sizes=jnp.zeros((n_lists,), jnp.int32),
+        rec_norms=jnp.zeros((n_lists, 0), jnp.float32),
+        metric=params.metric,
+        pq_dim_=pq_dim,
+        metric_arg=params.metric_arg,
+        codebook_kind=int(params.codebook_kind),
+        pq_bits=int(params.pq_bits),
+        cache_decoded=bool(params.cache_decoded),
+        cache_dtype=str(params.cache_dtype),
+    )
+
+
+def _quant_arrays(index: Index, ts_scales) -> dict:
+    out = {
+        "centers": index.centers,
+        "centers_rot": index.centers_rot,
+        "rotation": index.rotation,
+        "pq_centers": index.pq_centers,
+    }
+    if ts_scales is not None:
+        out["ts_scales"] = ts_scales
+    return out
+
+
 def build_streamed(
     params: IndexParams,
     make_batches,
@@ -544,6 +584,10 @@ def build_streamed(
     keep_codes: bool = True,
     cap_rows: Optional[int] = None,
     verbose: bool = False,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 8,
+    resume: bool = False,
+    token=None,
 ) -> Index:
     """Build from a RE-ITERABLE stream of fixed-shape device batches —
     the path for datasets too large for HBM *or host RAM* (DEEP-100M at
@@ -565,14 +609,54 @@ def build_streamed(
     packed-int4 RAW-residual cache at 0.5 B/component (the DEEP-100M
     configuration: codes and any cache together exceed HBM at that
     scale); such an index searches via the fused cache path only.
+
+    Resilience (docs/resilience.md): ``checkpoint_dir`` persists a
+    per-chunk manifest + state blob (quantizers after training, labels
+    through pass 1, the donated accumulators every ``checkpoint_every``
+    batches of pass 2); ``resume=True`` restores the latest state —
+    quantizers are NOT retrained, so the resumed build's output is
+    bitwise identical to the uninterrupted one (resume with the same
+    ``make_batches`` shape). Each blob is SELF-CONTAINED (quantizers +
+    labels-so-far + accumulators) so a single file always suffices to
+    resume — the cost is rewriting that state every save, so size
+    ``checkpoint_every`` to the scale: at 100M rows each pass-2 save
+    moves the full accumulator set; larger ``checkpoint_every`` trades
+    replayed batches for checkpoint I/O. ``token`` (default: the calling
+    thread's :class:`~raft_tpu.core.interruptible.Interruptible`) is
+    checked at every batch so ``cancel()`` from another thread stops the
+    hours-long job at the next chunk boundary.
     """
     from raft_tpu.neighbors.ivf_flat import _aligned_cap
+    from raft_tpu import resilience
+    from raft_tpu.core.interruptible import Interruptible
+    from raft_tpu.resilience import faultinject
 
     import time as _time
 
     _t0 = _time.time()
-    index = _quantizer_index(params, jnp.asarray(trainset), int(dim))
-    jax.block_until_ready(index.pq_centers)
+    if token is None:
+        token = Interruptible.get_token()
+    ck = (resilience.StreamCheckpoint(checkpoint_dir)
+          if checkpoint_dir else None)
+    _every = max(int(checkpoint_every), 1)
+    _fp = {
+        "n": int(n), "dim": int(dim), "n_lists": int(params.n_lists),
+        "pq_dim": int(params.pq_dim), "pq_bits": int(params.pq_bits),
+        "codebook_kind": int(params.codebook_kind),
+        "metric": int(params.metric), "keep_codes": bool(keep_codes),
+        "cap_rows": cap_rows, "cache_dtype": str(params.cache_dtype),
+    }
+    _state = (ck.load(fingerprint=_fp)
+              if (ck is not None and resume) else None)
+    _phase = _state[0] if _state is not None else None
+    _restored_scales = None
+    if _state is not None:
+        index = _restore_quantizer(params, _state[3], dim)
+        if "ts_scales" in _state[3]:
+            _restored_scales = jnp.asarray(_state[3]["ts_scales"])
+    else:
+        index = _quantizer_index(params, jnp.asarray(trainset), int(dim))
+        jax.block_until_ready(index.pq_centers)
     kb_scales = KMeansBalancedParams(
         n_clusters=index.n_lists,
         metric=(
@@ -581,7 +665,7 @@ def build_streamed(
             else DistanceType.L2Expanded
         ),
     )
-    ts_scales = None
+    ts_scales = _restored_scales
     # The padded i8 footprint is C*cap*rot with cap unknown until pass 1,
     # but it is bounded below by n*rot (C*cap >= n) and, when the caller
     # bounds list capacity, above by C*aligned_cap(cap_rows)*rot — enough
@@ -672,13 +756,16 @@ def build_streamed(
             # only i4 can fit: make sure its scales actually get computed
             # (the auto heuristic above may not have triggered)
             i4_possible = True
-    if i4_possible:
+    if i4_possible and ts_scales is None:
         # per-list int4 scales need the trainset — computed before it is
         # freed, used only if the budget later picks the i4 cache
         ts_scales = _trainset_i4_scales(jnp.asarray(trainset), index,
                                         kb_scales)
         jax.block_until_ready(ts_scales)
     trainset = None   # free before the accumulators go up (HBM headroom)
+    if ck is not None and _state is None:
+        ck.save("quant", 0, {}, _quant_arrays(index, ts_scales),
+                fingerprint=_fp)
     if verbose:
         print(f"[build_streamed] quantizers: {_time.time()-_t0:.0f} s",
               flush=True)
@@ -701,17 +788,60 @@ def build_streamed(
     # batch ahead of execution (batches alive until consumed -> tens of
     # GB of queued inputs); a tiny host fetch forces real completion
     # (block_until_ready does not reliably sync on the tunnel platform)
-    parts = []
-    for bi, batch in enumerate(make_batches()):
-        parts.append(kmeans_balanced.predict(kb, index.centers, batch))
-        if bi % 8 == 7:
-            np.asarray(parts[-1][0])
-    labels_all = jnp.concatenate(parts)
-    del parts
-    total = labels_all.shape[0]
-    labels_all = jnp.where(
-        jnp.arange(total) < n, labels_all, C   # padding rows -> dropped
-    ).astype(jnp.int32)
+    if _phase == "pass2":
+        # labels are in the pass-2 checkpoint (post padding-transform)
+        labels_all = jnp.asarray(_state[3]["labels_all"])
+    else:
+        parts = []
+        _p1_done = 0
+        _p1_restored_rows = 0
+        _p1_skipped = 0
+        if _phase == "pass1":
+            parts = [jnp.asarray(_state[3]["labels_parts"])]
+            _p1_done = int(_state[2]["batches_done"])
+            _p1_restored_rows = int(parts[0].shape[0])
+        for bi, batch in enumerate(make_batches()):
+            if bi < _p1_done:
+                _p1_skipped += int(batch.shape[0])
+                continue                 # resumed past this chunk
+            if _p1_done and _p1_skipped != _p1_restored_rows:
+                # the new make_batches yields different shapes than the
+                # killed run's — skipping by batch INDEX would silently
+                # drop or duplicate rows
+                raise ValueError(
+                    f"build_streamed resume misalignment: checkpoint "
+                    f"covers {_p1_restored_rows} pass-1 rows in "
+                    f"{_p1_done} batches but the first {_p1_done} "
+                    f"batches of this run hold {_p1_skipped} rows; "
+                    "resume with the make_batches shape the checkpoint "
+                    "was written at"
+                )
+            token.check()
+            faultinject.check(stage="build.pass1", chunk=bi)
+            parts.append(kmeans_balanced.predict(kb, index.centers, batch))
+            if bi % 8 == 7:
+                np.asarray(parts[-1][0])
+            if ck is not None and (bi + 1) % _every == 0 \
+                    and bi + 1 > _p1_done:
+                ck.save(
+                    "pass1", bi, {"batches_done": bi + 1},
+                    dict(_quant_arrays(index, ts_scales),
+                         labels_parts=jnp.concatenate(parts)),
+                    fingerprint=_fp,
+                )
+        if _p1_done and _p1_skipped != _p1_restored_rows:
+            raise ValueError(
+                "build_streamed resume misalignment: the stream ended "
+                f"inside the resumed prefix ({_p1_skipped} rows skipped "
+                f"vs {_p1_restored_rows} checkpointed); resume with the "
+                "make_batches shape the checkpoint was written at"
+            )
+        labels_all = jnp.concatenate(parts)
+        del parts
+        total = labels_all.shape[0]
+        labels_all = jnp.where(
+            jnp.arange(total) < n, labels_all, C   # padding rows -> dropped
+        ).astype(jnp.int32)
     counts = jnp.zeros((C + 1,), jnp.int32).at[labels_all].add(1)[:C]
     cap = _aligned_cap(int(counts.max()))
     if cap_rows is not None and cap > cap_rows:
@@ -727,7 +857,7 @@ def build_streamed(
         try:
             st = jax.devices()[0].memory_stats()
             mem = f" hbm_in_use={st.get('bytes_in_use', 0)/2**30:.2f}G"
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow verbose-only memory_stats probe; absence of stats is not a fault
             mem = ""
         print(f"[build_streamed] pass1 labels: {_time.time()-_t0:.0f} s "
               f"cap={cap} dropped={dropped}{mem}", flush=True)
@@ -771,21 +901,54 @@ def build_streamed(
     # per-element (nw4 words per row) with 2-D (row, col) indices, which
     # keep every coordinate under int32 where a flat element index
     # overflows at 100M scale.
-    acc_codes = jnp.zeros((C * cap, nw if keep_codes else 0), jnp.uint32)
-    if cache_kind == "i4":
-        acc_cache = jnp.zeros((C * nw4, cap), jnp.uint32)
-    else:
-        acc_cache = jnp.zeros(
-            (C * cap, rot if cache_kind == "i8" else 0), jnp.int8
-        )
     want_qnorms = cache_kind == "i4" and keep_codes
-    acc_qnorms = jnp.zeros((C * cap if want_qnorms else 0,), jnp.float32)
-    acc_norms = jnp.zeros((C * cap,), jnp.float32)
-    acc_ids = jnp.full((C * cap,), -1, jnp.int32)
-    fill = jnp.zeros((C,), jnp.int32)
-    off = 0
-    nbatch = 0
-    for batch in make_batches():
+    if _phase == "pass2":
+        # restored accumulators ONLY — allocating the zero set first
+        # would double peak HBM exactly when a resume is memory-tight
+        _a = _state[3]
+        acc_codes = jnp.asarray(_a["acc_codes"])
+        acc_cache = jnp.asarray(_a["acc_cache"])
+        acc_norms = jnp.asarray(_a["acc_norms"])
+        acc_qnorms = jnp.asarray(_a["acc_qnorms"])
+        acc_ids = jnp.asarray(_a["acc_ids"])
+        fill = jnp.asarray(_a["fill"])
+        off = int(_state[2]["off"])
+        nbatch = int(_state[2]["nbatch"])
+    else:
+        acc_codes = jnp.zeros((C * cap, nw if keep_codes else 0),
+                              jnp.uint32)
+        if cache_kind == "i4":
+            acc_cache = jnp.zeros((C * nw4, cap), jnp.uint32)
+        else:
+            acc_cache = jnp.zeros(
+                (C * cap, rot if cache_kind == "i8" else 0), jnp.int8
+            )
+        acc_qnorms = jnp.zeros((C * cap if want_qnorms else 0,),
+                               jnp.float32)
+        acc_norms = jnp.zeros((C * cap,), jnp.float32)
+        acc_ids = jnp.full((C * cap,), -1, jnp.int32)
+        fill = jnp.zeros((C,), jnp.int32)
+        off = 0
+        nbatch = 0
+    _p2_done = nbatch
+    _p2_skipped = 0
+    for bi, batch in enumerate(make_batches()):
+        if bi < _p2_done:
+            _p2_skipped += int(batch.shape[0])
+            continue                     # resumed past this chunk
+        if bi == _p2_done and _p2_done and _p2_skipped != off:
+            # index-based skipping only works when the new stream's
+            # batch shapes match the killed run's (off is the row-exact
+            # encode position the checkpoint restored)
+            raise ValueError(
+                f"build_streamed resume misalignment: checkpoint encoded "
+                f"{off} rows in {_p2_done} batches but the first "
+                f"{_p2_done} batches of this run hold {_p2_skipped} "
+                "rows; resume with the make_batches shape the "
+                "checkpoint was written at"
+            )
+        token.check()
+        faultinject.check(stage="build.pass2", chunk=bi)
         bs = batch.shape[0]
         lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
         acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill = (
@@ -804,7 +967,23 @@ def build_streamed(
             np.asarray(fill[0])
             print("[build_streamed] first scatter ok", flush=True)
         off += bs
+        if ck is not None and nbatch % _every == 0 and nbatch > _p2_done:
+            ck.save(
+                "pass2", nbatch, {"off": off, "nbatch": nbatch},
+                dict(_quant_arrays(index, ts_scales),
+                     labels_all=labels_all, acc_codes=acc_codes,
+                     acc_cache=acc_cache, acc_norms=acc_norms,
+                     acc_qnorms=acc_qnorms, acc_ids=acc_ids, fill=fill),
+                fingerprint=_fp,
+            )
 
+    if _p2_done and nbatch == _p2_done and _p2_skipped != off:
+        raise ValueError(
+            "build_streamed resume misalignment: the stream ended inside "
+            f"the resumed prefix ({_p2_skipped} rows skipped vs {off} "
+            "checkpointed); resume with the make_batches shape the "
+            "checkpoint was written at"
+        )
     # the [C, cap, nw] native TPU layout is transposed relative to the
     # flat bytes (small minor dims get split/packed), so materializing it
     # costs a full-array relayout copy — fine at GB scale, impossible at

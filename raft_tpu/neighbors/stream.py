@@ -7,15 +7,36 @@ queries stream host→device in double-buffered batches (the native
 prefetcher keeps disk IO ahead of the transfers for file sources), each
 batch runs the regular device search, and results land in preallocated
 host arrays. The device only ever holds one query batch + the index.
+
+Resilience (docs/resilience.md): every batch dispatch is a fault
+boundary. Transient / dead-backend failures are retried with backoff
+(:func:`raft_tpu.resilience.run`); a RESOURCE_EXHAUSTED walks the OOM
+degradation ladder (:func:`raft_tpu.resilience.degrade.run_halving` —
+halve, re-dispatch, record the surviving size so the remaining batches
+and later calls start safe); ``checkpoint_dir=`` persists completed
+rows per chunk so ``resume=True`` continues a killed job with
+bitwise-identical output; and the caller's
+:class:`~raft_tpu.core.interruptible.Interruptible` token is checked
+between batches so ``cancel()`` from another thread actually stops an
+out-of-core job.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Tuple
+from typing import Callable, Iterable, Optional, Tuple
 
+import jax
 import numpy as np
 
+from raft_tpu import resilience, tuning
+from raft_tpu.core.interruptible import Interruptible
+from raft_tpu.resilience import degrade, faultinject
 from raft_tpu.utils.batch import BatchLoadIterator, FileBatchLoadIterator
+
+# the runtime-budget key the OOM ladder records surviving batch rows
+# under; search_file/search_host_array clamp their requested batch_rows
+# to it so a process that OOMed once starts safe thereafter
+STREAM_BATCH_BUDGET = "stream_batch_rows"
 
 
 def search_stream(
@@ -23,6 +44,15 @@ def search_stream(
     batches: Iterable[Tuple[int, "object"]],
     n_queries: int,
     k: int,
+    *,
+    stage: str = "search",
+    retries: int = 2,
+    backoff_s: float = 0.5,
+    deadline_s: Optional[float] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 8,
+    resume: bool = False,
+    token: Optional[Interruptible] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run ``search_fn(query_batch) -> (dists, ids)`` over an iterator of
     ``(offset, device_batch)`` pairs (``BatchLoadIterator`` /
@@ -31,15 +61,84 @@ def search_stream(
     Batches may be zero-padded to a fixed shape (``pad_to_full=True`` —
     one compiled program for every batch); rows beyond ``n_queries`` are
     dropped.
+
+    Fault tolerance per batch: transient/dead-backend failures retry
+    (``retries``/``backoff_s``/``deadline_s`` feed
+    :func:`raft_tpu.resilience.run`), OOM walks the halving ladder and
+    shrinks the iterator's remaining batches to the surviving size, and
+    ``checkpoint_dir``/``resume`` give killed jobs bitwise-identical
+    restarts. Each save rewrites the FULL completed-result prefix (the
+    blob is self-contained, one file always resumes), so
+    ``checkpoint_every`` trades replayed batches against checkpoint I/O
+    — at big-ann result sizes keep it well above 1 (default 8).
+    ``token`` (default: the calling thread's token) is checked between
+    batches — ``cancel()`` from another thread raises
+    ``InterruptedException`` at the next boundary.
     """
     out_d = np.empty((n_queries, k), np.float32)
     out_i = np.empty((n_queries, k), np.int32)
-    for offset, batch in batches:
-        d, i = search_fn(batch)
+    ck = (resilience.StreamCheckpoint(checkpoint_dir)
+          if checkpoint_dir else None)
+    fingerprint = {"n_queries": int(n_queries), "k": int(k), "stage": stage}
+    rows_done = 0
+    if ck is not None and resume:
+        state = ck.load(fingerprint=fingerprint)
+        if state is not None:
+            _, _, meta, arrays = state
+            rows_done = int(meta["rows_done"])
+            out_d[:rows_done] = arrays["dists"]
+            out_i[:rows_done] = arrays["ids"]
+    if token is None:
+        token = Interruptible.get_token()
+
+    for ci, (offset, batch) in enumerate(batches):
         rows = min(batch.shape[0], n_queries - offset)
+        if offset + rows <= rows_done:
+            continue                      # resumed past this chunk
+        if offset < rows_done:
+            raise ValueError(
+                f"resume misalignment: checkpoint covers {rows_done} rows "
+                f"but the iterator produced a batch at offset {offset}; "
+                "resume with the batch size the checkpoint was written at"
+            )
+        token.check()
+
+        def dispatch(b, _ci=ci):
+            faultinject.check(stage=stage, chunk=_ci)
+            out = search_fn(b)
+            # sync INSIDE the retry-wrapped callable: XLA dispatch is
+            # async, so a real transient/dead-backend error surfaces at
+            # the wait — it must strike where resilience.run can retry
+            # it, not at the ladder's (OOM-only) outer sync
+            jax.block_until_ready(out)
+            return out
+
+        (d, i), survived = degrade.run_halving(
+            lambda b: resilience.run(
+                dispatch, b, retries=retries, backoff_s=backoff_s,
+                deadline_s=deadline_s, token=token,
+            ),
+            batch,
+            budget_name=STREAM_BATCH_BUDGET,
+        )
+        if survived < batch.shape[0] and hasattr(batches, "set_batch_rows"):
+            batches.set_batch_rows(survived)
         out_d[offset:offset + rows] = np.asarray(d[:rows], np.float32)
         out_i[offset:offset + rows] = np.asarray(i[:rows])
+        rows_done = offset + rows
+        if ck is not None and (ci + 1) % max(int(checkpoint_every), 1) == 0:
+            ck.save(
+                "search", ci, {"rows_done": rows_done},
+                {"dists": out_d[:rows_done], "ids": out_i[:rows_done]},
+                fingerprint=fingerprint,
+            )
     return out_d, out_i
+
+
+def _clamped_batch_rows(batch_rows: int) -> int:
+    """Requested rows clamped to the ladder's recorded OOM-survivor size
+    (no-op until an OOM has actually struck in this process)."""
+    return max(int(tuning.budget(STREAM_BATCH_BUDGET, int(batch_rows))), 1)
 
 
 def search_file(
@@ -49,18 +148,37 @@ def search_file(
     queries_path: str,
     k: int,
     batch_rows: int = 8192,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 8,
+    resume: bool = False,
+    token: Optional[Interruptible] = None,
+    retries: int = 2,
+    backoff_s: float = 0.5,
+    deadline_s: Optional[float] = None,
     **search_kwargs,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Stream a ``.fbin``-family query file through ``module.search``
     (ivf_flat / ivf_pq / cagra / brute_force-style modules) in fixed-size
-    device batches. The file never materializes on the host in full."""
-    it = FileBatchLoadIterator(queries_path, batch_rows, pad_to_full=True)
+    device batches. The file never materializes on the host in full.
+
+    ``checkpoint_dir``/``resume`` checkpoint completed rows per chunk
+    (resume at the SAME ``batch_rows``); see :func:`search_stream` for
+    the retry/ladder/cancellation semantics.
+    """
+    it = FileBatchLoadIterator(
+        queries_path, _clamped_batch_rows(batch_rows), pad_to_full=True
+    )
 
     def fn(batch):
         return module.search(search_params, index, batch, k,
                              **search_kwargs)
 
-    return search_stream(fn, it, it.shape[0], k)
+    return search_stream(
+        fn, it, it.shape[0], k,
+        retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume, token=token,
+    )
 
 
 def search_host_array(
@@ -70,15 +188,48 @@ def search_host_array(
     queries: np.ndarray,
     k: int,
     batch_rows: int = 8192,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 8,
+    resume: bool = False,
+    token: Optional[Interruptible] = None,
+    retries: int = 2,
+    backoff_s: float = 0.5,
+    deadline_s: Optional[float] = None,
     **search_kwargs,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Same streaming pattern over a host-resident array (numpy or
     ``np.memmap``) — the double-buffered ``BatchLoadIterator`` overlaps
-    host→device copies with the previous batch's search."""
-    it = BatchLoadIterator(queries, batch_rows, pad_to_full=True)
+    host→device copies with the previous batch's search.
+
+    On ``resume`` the iterator starts AT the checkpoint's completed-row
+    mark (``start_row``), so already-searched rows are never re-uploaded
+    — and because the restart is row-exact, resuming may use a different
+    ``batch_rows`` than the killed run (per-query searches are
+    row-independent, so the output stays bitwise identical)."""
+    start_row = 0
+    if resume and checkpoint_dir:
+        # manifest-only peek (the blob is re-read once, fingerprinted,
+        # inside search_stream); validating the fingerprint HERE keeps a
+        # stale checkpoint from steering start_row before the mismatch
+        # would surface downstream
+        state = resilience.StreamCheckpoint(checkpoint_dir).peek(
+            fingerprint={"n_queries": int(queries.shape[0]), "k": int(k),
+                         "stage": "search"},
+        )
+        if state is not None:
+            start_row = int(state[2]["rows_done"])
+    it = BatchLoadIterator(
+        queries, _clamped_batch_rows(batch_rows), pad_to_full=True,
+        start_row=start_row,
+    )
 
     def fn(batch):
         return module.search(search_params, index, batch, k,
                              **search_kwargs)
 
-    return search_stream(fn, it, queries.shape[0], k)
+    return search_stream(
+        fn, it, queries.shape[0], k,
+        retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume, token=token,
+    )

@@ -1,0 +1,53 @@
+"""Fault-tolerant execution layer (docs/resilience.md).
+
+Four pillars, threaded through the batch, streaming, sharded, and bench
+paths:
+
+1. **Classified retry** — :func:`classify` maps any JAX/XLA exception
+   to transient / oom / dead_backend / interrupted / fatal; :func:`run`
+   retries the retryable kinds with exponential backoff under a
+   wall-clock deadline, probing :func:`backend_alive` before trusting a
+   dead backend again.
+2. **OOM degradation ladder** (:mod:`raft_tpu.resilience.degrade`) —
+   RESOURCE_EXHAUSTED halves the chunk and re-dispatches; the surviving
+   size is recorded via :func:`raft_tpu.tuning.record_budget` so later
+   calls start safe.
+3. **Checkpointed streaming**
+   (:mod:`raft_tpu.resilience.checkpoint`) — ``build_streamed`` /
+   ``search_file`` persist a per-chunk manifest + state blob and resume
+   bitwise-identically.
+4. **Fault injection** (:mod:`raft_tpu.resilience.faultinject`) — a
+   deterministic harness (env ``RAFT_TPU_FAULTS``) that drives all of
+   the above on CPU in tier-1.
+"""
+
+from raft_tpu.resilience.errors import (
+    DEAD_BACKEND,
+    FATAL,
+    INTERRUPTED,
+    KINDS,
+    OOM,
+    TRANSIENT,
+    DeadBackendError,
+    DeadlineExceededError,
+    ResilienceError,
+    ShardDropoutError,
+    TransientError,
+    backend_alive,
+    classify,
+    classify_text,
+    run,
+)
+from raft_tpu.resilience.checkpoint import (
+    CheckpointMismatchError,
+    StreamCheckpoint,
+)
+from raft_tpu.resilience import degrade, faultinject
+
+__all__ = [
+    "DEAD_BACKEND", "FATAL", "INTERRUPTED", "KINDS", "OOM", "TRANSIENT",
+    "CheckpointMismatchError", "DeadBackendError", "DeadlineExceededError",
+    "ResilienceError", "ShardDropoutError", "StreamCheckpoint",
+    "TransientError", "backend_alive", "classify", "classify_text",
+    "degrade", "faultinject", "run",
+]

@@ -1,0 +1,167 @@
+"""Checkpointed streaming: per-chunk manifest + partial-state blob.
+
+Long streamed jobs (``ivf_pq.build_streamed`` at DEEP-100M scale runs
+hours; ``search_file`` over a big-ann query file) lose everything to a
+mid-stream interruption today. A :class:`StreamCheckpoint` directory
+makes them resumable:
+
+* ``manifest.json`` — the per-chunk JSON manifest: phase, chunk/step
+  counter, rows done, optional rng state, a config fingerprint, and the
+  name of the state blob.
+* ``state.bin`` — the partial-state arrays in the repo's versioned
+  index-file container (:func:`raft_tpu.core.serialize.write_index_file`
+  — length-prefixed ``.npy`` blocks, so a checkpoint round-trip is
+  bitwise exact and ``resume=`` reproduces the uninterrupted output
+  bit-for-bit).
+
+Writes are atomic (temp file + ``os.replace``), blob first and manifest
+last, so a crash mid-save leaves the previous checkpoint intact: the
+manifest never names a blob that was not fully written.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core import serialize
+
+_MANIFEST = "manifest.json"
+_KIND = "resilience_checkpoint"
+_VERSION = 1
+
+
+class CheckpointMismatchError(RuntimeError):
+    """The checkpoint's config fingerprint does not match the resuming
+    job — resuming would silently corrupt the output."""
+
+
+class StreamCheckpoint:
+    """One resumable streamed job == one checkpoint directory."""
+
+    def __init__(self, directory: str):
+        self.dir = str(directory)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.dir, _MANIFEST)
+
+    def _blob_name(self, step: int) -> str:
+        return f"state-{int(step)}.bin"
+
+    def exists(self) -> bool:
+        return os.path.exists(self.manifest_path)
+
+    # -- save / load -------------------------------------------------------
+
+    def save(
+        self,
+        phase: str,
+        step: int,
+        meta: Dict[str, Any],
+        arrays: Dict[str, Any],
+        fingerprint: Optional[Dict[str, Any]] = None,
+        rng_state: Any = None,
+    ) -> None:
+        """Atomically persist one chunk boundary's full state.
+
+        ``meta`` is JSON-scalar progress state (offsets, counters,
+        picked cache kind ...); ``arrays`` is the partial-state tensors
+        (host or device — moved to host here); ``fingerprint`` is the
+        immutable job config a resume must match exactly.
+        """
+        host_arrays = {k: np.asarray(v) for k, v in arrays.items()
+                       if v is not None}
+        blob = self._blob_name(step)
+        tmp_blob = os.path.join(self.dir, blob + ".tmp")
+        serialize.write_index_file(
+            tmp_blob, _KIND, _VERSION,
+            {"phase": phase, "step": int(step)}, host_arrays,
+        )
+        os.replace(tmp_blob, os.path.join(self.dir, blob))
+        manifest = {
+            "version": _VERSION,
+            "phase": phase,
+            "step": int(step),
+            "meta": meta,
+            "fingerprint": fingerprint or {},
+            "rng_state": rng_state,
+            "blob": blob,
+            "arrays": sorted(host_arrays),
+        }
+        tmp_man = self.manifest_path + ".tmp"
+        with open(tmp_man, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp_man, self.manifest_path)
+        # older blobs are garbage once the manifest points past them
+        for name in os.listdir(self.dir):
+            if name.startswith("state-") and name.endswith(".bin") \
+                    and name != blob:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass  # a stale blob is harmless, never fail a save
+
+    def peek(
+        self, fingerprint: Optional[Dict[str, Any]] = None
+    ) -> Optional[Tuple[str, int, Dict[str, Any]]]:
+        """Read progress state — ``(phase, step, meta)`` — from the
+        manifest alone, without deserializing the (possibly multi-GB)
+        state blob. Same fingerprint validation as :meth:`load`; returns
+        ``None`` for a missing or torn checkpoint."""
+        if not self.exists():
+            return None
+        with open(self.manifest_path) as f:
+            manifest = json.load(f)
+        if fingerprint is not None and manifest.get("fingerprint") and \
+                manifest["fingerprint"] != _jsonify(fingerprint):
+            raise CheckpointMismatchError(
+                f"checkpoint at {self.dir} was written by a different job "
+                f"config: {manifest['fingerprint']} != {_jsonify(fingerprint)}"
+            )
+        if not os.path.exists(os.path.join(self.dir, manifest["blob"])):
+            return None     # torn save
+        return manifest["phase"], int(manifest["step"]), manifest["meta"]
+
+    def load(
+        self, fingerprint: Optional[Dict[str, Any]] = None
+    ) -> Optional[Tuple[str, int, Dict[str, Any], Dict[str, np.ndarray]]]:
+        """Load the latest checkpoint: ``(phase, step, meta, arrays)``,
+        or ``None`` when the directory holds no (complete) checkpoint.
+        When ``fingerprint`` is given it must equal the saved one."""
+        if not self.exists():
+            return None
+        with open(self.manifest_path) as f:
+            manifest = json.load(f)
+        if fingerprint is not None and manifest.get("fingerprint") and \
+                manifest["fingerprint"] != _jsonify(fingerprint):
+            raise CheckpointMismatchError(
+                f"checkpoint at {self.dir} was written by a different job "
+                f"config: {manifest['fingerprint']} != {_jsonify(fingerprint)}"
+            )
+        blob = os.path.join(self.dir, manifest["blob"])
+        if not os.path.exists(blob):
+            return None     # torn save; the job restarts from scratch
+        _, blob_meta, arrays = serialize.read_index_file(blob, _KIND)
+        if blob_meta.get("step") != manifest["step"]:
+            return None     # blob/manifest disagree; treat as absent
+        return (manifest["phase"], int(manifest["step"]),
+                manifest["meta"], arrays)
+
+    def clear(self) -> None:
+        for name in os.listdir(self.dir):
+            if name == _MANIFEST or (name.startswith("state-")
+                                     and name.endswith(".bin")):
+                os.remove(os.path.join(self.dir, name))
+
+
+def _jsonify(d: Dict[str, Any]) -> Dict[str, Any]:
+    """Round-trip through JSON so fingerprint comparison sees the same
+    scalar types the manifest stored (tuples -> lists, ints -> ints)."""
+    return json.loads(json.dumps(d))

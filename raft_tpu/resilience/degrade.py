@@ -1,0 +1,118 @@
+"""The OOM degradation ladder: halve-and-redispatch instead of dying.
+
+A ``RESOURCE_EXHAUSTED`` during a chunked dispatch does not invalidate
+the work — it only proves the chunk was too big for the HBM headroom
+left by the resident index. The ladder catches OOM-classified failures,
+halves the chunk, and re-dispatches the halves; every surviving size is
+recorded as an in-process :func:`raft_tpu.tuning.record_budget` entry
+so *later* calls in the same process start at the size that survived
+instead of re-climbing the ladder (the measured-dispatch analog of the
+reference's memory-pool fallback allocators).
+
+Row-independent dispatches only: a search over rows ``[a:b]`` must equal
+the concatenation of searches over ``[a:m]`` and ``[m:b]`` (true for
+every per-query search path here; NOT true for the donated build
+scatters, which therefore checkpoint instead of degrading —
+docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+
+from raft_tpu.resilience import errors
+
+
+def run_halving(
+    fn: Callable,
+    batch,
+    *,
+    min_rows: int = 1,
+    budget_name: Optional[str] = None,
+) -> Tuple[object, int]:
+    """Run ``fn(batch)`` with OOM-halving over ``batch``'s leading axis.
+
+    Returns ``(result, surviving_rows)`` where ``surviving_rows`` is the
+    largest row count that dispatched successfully (== ``len(batch)``
+    when no fault struck). Results of split dispatches are concatenated
+    leaf-wise along axis 0, so they are bitwise what the unsplit
+    dispatch would have produced for any row-independent ``fn``. Non-OOM
+    failures and OOMs at ``min_rows`` propagate.
+    """
+    rows = int(batch.shape[0])
+    try:
+        out = fn(batch)
+        # force completion INSIDE the ladder: XLA dispatch is async, so
+        # without a sync the OOM surfaces later at some consumer outside
+        # any recovery scope
+        jax.block_until_ready(out)
+        return out, rows
+    except Exception as e:  # noqa: BLE001 — classified below, not swallowed
+        if errors.classify(e) != errors.OOM or rows <= min_rows:
+            raise
+    half = rows // 2
+    r1, s1 = run_halving(fn, batch[:half], min_rows=min_rows,
+                         budget_name=None)
+    r2, s2 = run_halving(fn, batch[half:], min_rows=min_rows,
+                         budget_name=None)
+    survived = min(s1, s2)
+    if budget_name is not None:
+        from raft_tpu import tuning
+
+        tuning.record_budget(budget_name, survived)
+    out = jax.tree_util.tree_map(
+        lambda a, b: jax.numpy.concatenate([a, b], axis=0), r1, r2
+    )
+    return out, survived
+
+
+def run_shrinking_blocks(
+    fn: Callable,
+    total_rows: int,
+    block_rows: int,
+    *,
+    min_rows: int = 1,
+    budget_name: Optional[str] = None,
+    stage: str = "block",
+):
+    """Cover ``[0, total_rows)`` with ``fn(start, rows)`` dispatches,
+    halving the block size on OOM (the surviving size sticks for the
+    remaining blocks). Yields the per-block results in order.
+
+    The host-blocked-loop shape of CAGRA's transient-buffer chunking
+    (``_detour_counts``): each block is synced before the next dispatch
+    so an OOM is caught at ITS block, not at some later consumer.
+    """
+    start = 0
+    block = max(int(block_rows), min_rows)
+    limit = block                 # transient per-position cap (tail OOMs)
+    bi = 0
+    while start < total_rows:
+        rows = min(limit, block, total_rows - start)
+        from raft_tpu.resilience import faultinject
+
+        try:
+            faultinject.check(stage=stage, chunk=bi)
+            out = fn(start, rows)
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 — classified, not swallowed
+            if errors.classify(e) != errors.OOM or rows <= min_rows:
+                raise
+            half = max(rows // 2, min_rows)
+            limit = half
+            if rows >= block:
+                # a FULL block failed: the learned size shrinks for good
+                # (a short tail failing must not poison the process-wide
+                # budget with its half-of-a-few-rows size)
+                block = half
+                if budget_name is not None:
+                    from raft_tpu import tuning
+
+                    tuning.record_budget(budget_name, half)
+            continue
+        yield out
+        start += rows
+        bi += 1
+        limit = block             # reset the transient cap after success

@@ -1,0 +1,240 @@
+"""Error taxonomy, classification, and the retry/backoff executor.
+
+A production jax_graft deployment dies today on the first transient
+fault: XLA surfaces everything as one exception type whose *message*
+carries the gRPC-style status (``RESOURCE_EXHAUSTED``, ``UNAVAILABLE``,
+``DEADLINE_EXCEEDED`` ...), so callers either swallow everything (the
+GL008 anti-pattern) or die on everything. This module is the single
+place that reads those messages: :func:`classify` maps any exception to
+one of five kinds, and :func:`run` retries the retryable ones with
+exponential backoff under a wall-clock deadline — the cooperative analog
+of the reference's ``interruptible.hpp`` + the retry loops every
+long-running RAFT consumer (raft-dask, the ANN bench harness) writes by
+hand.
+
+Kinds:
+
+* ``transient``    — UNAVAILABLE / ABORTED / connection resets; retry.
+* ``oom``          — RESOURCE_EXHAUSTED / allocator failures; do NOT
+                     retry at the same size — the degradation ladder
+                     (:mod:`raft_tpu.resilience.degrade`) halves the
+                     chunk and re-dispatches.
+* ``dead_backend`` — the hung-backend class ``core/exit_guard.py`` only
+                     papers over at process exit (rc=124 dead-axon);
+                     retryable once :func:`backend_alive` confirms the
+                     device answers again.
+* ``interrupted``  — cooperative cancellation
+                     (:class:`raft_tpu.core.interruptible.Interruptible`);
+                     never retried, always propagated.
+* ``fatal``        — everything else (shape errors, ValueError, bugs);
+                     never retried.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import threading
+import time
+from typing import Callable, Iterable, Optional, Tuple
+
+# classification kinds ------------------------------------------------------
+
+TRANSIENT = "transient"
+OOM = "oom"
+DEAD_BACKEND = "dead_backend"
+INTERRUPTED = "interrupted"
+FATAL = "fatal"
+
+KINDS = (TRANSIENT, OOM, DEAD_BACKEND, INTERRUPTED, FATAL)
+
+
+class ResilienceError(RuntimeError):
+    """Base for errors raised by the resilience layer itself."""
+
+
+class TransientError(ResilienceError):
+    """A failure the caller knows to be transient (e.g. a measurement
+    stage whose tail says UNAVAILABLE); :func:`classify` maps it to
+    ``transient`` without message sniffing."""
+
+
+class DeadBackendError(ResilienceError):
+    """The backend stopped answering and did not come back within the
+    retry budget (the rc=124 dead-axon class, surfaced as an exception
+    instead of a hang)."""
+
+
+class DeadlineExceededError(ResilienceError):
+    """:func:`run`'s wall-clock deadline expired before an attempt
+    succeeded. Carries the last underlying failure as ``__cause__``."""
+
+
+class ShardDropoutError(ResilienceError):
+    """A sharded search lost one or more shards and the caller did not
+    opt into partial results (``partial_ok=False``)."""
+
+
+# message patterns ----------------------------------------------------------
+# XLA/PJRT surface status codes inside the exception text; these are the
+# spellings observed from jaxlib's XlaRuntimeError and the axon tunnel.
+
+_OOM_RE = re.compile(
+    r"RESOURCE[ _]?EXHAUSTED|out of memory|OOM|allocat\w* .*fail|"
+    r"exceeds the memory", re.IGNORECASE,
+)
+_TRANSIENT_RE = re.compile(
+    r"UNAVAILABLE|ABORTED|CANCELLED|DEADLINE[ _]?EXCEEDED|UNKNOWN: |"
+    r"connection (reset|refused|closed)|socket closed|broken pipe|"
+    r"temporarily unavailable|try again", re.IGNORECASE,
+)
+_DEAD_RE = re.compile(
+    r"dead[ -]?backend|backend .*(unreachable|died|lost)|"
+    r"device or resource busy|heartbeat|FAILED[ _]?PRECONDITION: .*donat",
+    re.IGNORECASE,
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to one of :data:`KINDS`.
+
+    Injected faults (:mod:`raft_tpu.resilience.faultinject`) carry their
+    kind explicitly; cooperative interruption and the resilience layer's
+    own typed errors short-circuit; anything else is classified from its
+    message text, defaulting to ``fatal`` (never silently retry an
+    unknown failure).
+    """
+    kind = getattr(exc, "fault_kind", None)
+    if kind in KINDS:
+        return kind
+    from raft_tpu.core.interruptible import InterruptedException
+
+    if isinstance(exc, InterruptedException):
+        return INTERRUPTED
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return INTERRUPTED
+    if isinstance(exc, TransientError):
+        return TRANSIENT
+    if isinstance(exc, DeadBackendError):
+        return DEAD_BACKEND
+    if isinstance(exc, MemoryError):
+        return OOM
+    if isinstance(exc, subprocess.TimeoutExpired):
+        # the wedged-stage class: the child never answered
+        return DEAD_BACKEND
+    return classify_text(str(exc))
+
+
+def classify_text(text: str) -> str:
+    """Classify raw failure text (a subprocess tail, a log line) with the
+    same message patterns :func:`classify` applies to exceptions — the
+    measurement scripts use this on stage output to decide whether a
+    non-zero rc is worth one retry."""
+    if _OOM_RE.search(text):
+        return OOM
+    if _DEAD_RE.search(text):
+        return DEAD_BACKEND
+    if _TRANSIENT_RE.search(text):
+        return TRANSIENT
+    return FATAL
+
+
+# liveness ------------------------------------------------------------------
+
+
+def backend_alive(timeout_s: float = 30.0) -> bool:
+    """In-process device liveness check — the reusable promotion of the
+    dead-axon probe that ``core/exit_guard.py`` / ``bench/harness.py``
+    only apply at process boundaries.
+
+    Dispatches a trivial device op on a daemon worker thread and waits
+    up to ``timeout_s``: the known outage mode *hangs* inside the
+    runtime holding the GIL-released device lock, so a plain call could
+    never return False. A hung probe leaks its daemon thread — by
+    construction there is no way to preempt the runtime call.
+    """
+    done = threading.Event()
+    ok: list = []
+
+    def _probe():
+        try:
+            import jax
+
+            x = jax.device_put(1)
+            jax.block_until_ready(x)
+            ok.append(True)
+        except Exception:  # graft-lint: allow-unclassified-swallow liveness probe: ANY failure means not-alive, classification is the caller's job  # noqa: BLE001
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_probe, daemon=True, name="raft-tpu-liveness")
+    t.start()
+    done.wait(timeout_s)
+    return bool(ok)
+
+
+# the retry executor --------------------------------------------------------
+
+_DEFAULT_RETRY: Tuple[str, ...] = (TRANSIENT, DEAD_BACKEND)
+
+
+def run(
+    fn: Callable,
+    *args,
+    deadline_s: Optional[float] = None,
+    retries: int = 3,
+    backoff_s: float = 0.5,
+    backoff_mult: float = 2.0,
+    retry_on: Iterable[str] = _DEFAULT_RETRY,
+    probe_timeout_s: float = 30.0,
+    on_retry: Optional[Callable[[int, str, BaseException], None]] = None,
+    token=None,
+    **kwargs,
+):
+    """Run ``fn(*args, **kwargs)`` with classified retry under a deadline.
+
+    * Exceptions are :func:`classify`\\ d; only kinds in ``retry_on``
+      (default transient + dead_backend) are retried, up to ``retries``
+      times with exponential backoff (``backoff_s * backoff_mult**i``).
+    * ``deadline_s`` is a wall-clock budget over ALL attempts: when a
+      retry (including its backoff sleep) cannot start inside it,
+      :class:`DeadlineExceededError` is raised with the last failure as
+      ``__cause__``. The deadline cannot preempt a *running* attempt —
+      pair it with a subprocess/thread timeout for hard preemption (the
+      measurement scripts use subprocess timeouts as the hard bound).
+    * A ``dead_backend`` failure is only retried after
+      :func:`backend_alive` confirms the device answers again; a probe
+      failure converts the retry into :class:`DeadBackendError`.
+    * ``token`` (an :class:`~raft_tpu.core.interruptible.Interruptible`)
+      is checked before every attempt so ``cancel()`` from another
+      thread stops the retry loop too.
+    """
+    retry_on = tuple(retry_on)
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        if token is not None:
+            token.check()
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — classified, not swallowed
+            kind = classify(e)
+            if kind not in retry_on or attempt >= retries:
+                raise
+            sleep = backoff_s * (backoff_mult ** attempt)
+            if deadline_s is not None and \
+                    time.monotonic() - start + sleep >= deadline_s:
+                raise DeadlineExceededError(
+                    f"deadline {deadline_s}s exhausted after "
+                    f"{attempt + 1} attempt(s); last failure: {kind}"
+                ) from e
+            if kind == DEAD_BACKEND and not backend_alive(probe_timeout_s):
+                raise DeadBackendError(
+                    f"backend did not come back within {probe_timeout_s}s "
+                    f"after: {e}"
+                ) from e
+            if on_retry is not None:
+                on_retry(attempt, kind, e)
+            time.sleep(sleep)
+            attempt += 1

@@ -1,0 +1,218 @@
+"""Deterministic fault injection for the resilience layer.
+
+Grammar (env ``RAFT_TPU_FAULTS``, comma-separated)::
+
+    oom@chunk:3            synthetic RESOURCE_EXHAUSTED at chunk index 3
+                           (of ANY stage — the first loop to reach it)
+    oom@chunk:3*2          ... firing twice (two ladder rungs)
+    transient@chunk:0      synthetic UNAVAILABLE at chunk 0
+    dead@stage:search      hung-backend failure anywhere in stage "search"
+    oom@stage:build.pass2  OOM at the first check inside that stage
+    dead@stage:build.pass2#3   ... at that stage's chunk 3 specifically
+    shard@rank:2           shard 2's local result is invalidated (queried
+                           by the sharded searches, never raised)
+
+Instrumented loops call :func:`check` at every chunk boundary (the
+point where a real device failure would surface); matching specs raise
+synthetic exceptions whose *messages* carry the same status text the
+real failures do, so :func:`raft_tpu.resilience.errors.classify` treats
+injected and real faults identically — the whole ladder/retry/resume
+machinery is exercised on CPU in tier-1. Each spec fires ``count``
+times (default once) then stays quiet, which is exactly how a transient
+fault behaves under retry and how an OOM behaves after the ladder
+halves the chunk.
+
+Programmatic use (tests)::
+
+    with faultinject.inject("oom@chunk:2"):
+        search_stream(...)
+
+The env var is read once per :func:`plan` call when no programmatic
+plan is installed; :func:`clear` resets everything.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import re
+import threading
+from typing import FrozenSet, List, Optional
+
+from raft_tpu.resilience import errors
+
+ENV_VAR = "RAFT_TPU_FAULTS"
+
+_KINDS = ("oom", "dead", "transient", "shard")
+_SCOPES = ("chunk", "stage", "rank")
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z]+)@(?P<scope>[a-z]+):(?P<arg>[^*]+?)(?:\*(?P<count>\d+))?$"
+)
+
+
+class InjectedFault(RuntimeError):
+    """Base class for synthetic faults; ``fault_kind`` short-circuits
+    :func:`raft_tpu.resilience.errors.classify`."""
+
+    fault_kind = errors.FATAL
+
+
+class InjectedOOM(InjectedFault):
+    fault_kind = errors.OOM
+
+
+class InjectedDeadBackend(InjectedFault):
+    fault_kind = errors.DEAD_BACKEND
+
+
+class InjectedTransient(InjectedFault):
+    fault_kind = errors.TRANSIENT
+
+
+_EXC = {
+    "oom": (InjectedOOM, "RESOURCE_EXHAUSTED: injected fault"),
+    "dead": (InjectedDeadBackend, "injected dead-backend fault"),
+    "transient": (InjectedTransient, "UNAVAILABLE: injected fault"),
+}
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str        # oom | dead | transient | shard
+    scope: str       # chunk | stage | rank
+    arg: str         # chunk index / stage name / rank
+    remaining: int = 1
+
+    def render(self) -> str:
+        return f"{self.kind}@{self.scope}:{self.arg}*{self.remaining}"
+
+
+def parse(spec: str) -> List[FaultSpec]:
+    """Parse a comma-separated fault spec string (see module docstring)."""
+    out: List[FaultSpec] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = _SPEC_RE.match(part)
+        if not m:
+            raise ValueError(
+                f"bad fault spec {part!r}: want kind@scope:arg[*count], "
+                f"e.g. oom@chunk:3 or dead@stage:search"
+            )
+        kind, scope = m.group("kind"), m.group("scope")
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (want {_KINDS})")
+        if scope not in _SCOPES:
+            raise ValueError(f"unknown fault scope {scope!r} (want {_SCOPES})")
+        if scope in ("chunk", "rank"):
+            int(m.group("arg"))          # validate now, fail loudly
+        if scope == "stage" and "#" in m.group("arg"):
+            int(m.group("arg").rpartition("#")[2])   # stage#chunk form
+        out.append(FaultSpec(
+            kind, scope, m.group("arg").strip(),
+            int(m.group("count") or 1),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the installed plan
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plan: Optional[List[FaultSpec]] = None      # programmatic plan
+_env_cache: Optional[tuple] = None           # (env string, parsed plan)
+
+
+def install(spec: Optional[str]) -> None:
+    """Install a programmatic plan (overrides the env var); ``None``
+    restores env control."""
+    global _plan
+    with _lock:
+        _plan = parse(spec) if spec is not None else None
+
+
+def clear() -> None:
+    """Drop the programmatic plan AND the env cache (tests)."""
+    global _plan, _env_cache
+    with _lock:
+        _plan = None
+        _env_cache = None
+
+
+@contextlib.contextmanager
+def inject(spec: str):
+    """Scoped programmatic injection: ``with inject("oom@chunk:2"): ...``"""
+    install(spec)
+    try:
+        yield
+    finally:
+        install(None)
+
+
+def plan() -> List[FaultSpec]:
+    """The live plan: the programmatic one if installed, else the parsed
+    env var (cached against the env string so spec state persists across
+    calls within one process)."""
+    global _env_cache
+    with _lock:
+        if _plan is not None:
+            return _plan
+        env = os.environ.get(ENV_VAR, "")
+        if _env_cache is None or _env_cache[0] != env:
+            _env_cache = (env, parse(env) if env else [])
+        return _env_cache[1]
+
+
+def active() -> bool:
+    return bool(plan())
+
+
+# ---------------------------------------------------------------------------
+# injection points
+# ---------------------------------------------------------------------------
+
+
+def check(stage: str, chunk: Optional[int] = None) -> None:
+    """A fault point: raise the first matching live spec's synthetic
+    error. Call this where a real device failure would surface (chunk
+    boundaries of the streaming/build loops, stage entries of the
+    measurement battery)."""
+    specs = plan()
+    if not specs:
+        return
+    with _lock:
+        for s in specs:
+            if s.kind == "shard" or s.remaining <= 0:
+                continue
+            if s.scope == "chunk":
+                hit = chunk is not None and int(s.arg) == chunk
+            elif "#" in s.arg:           # stage-scoped ordinal
+                name, _, idx = s.arg.rpartition("#")
+                hit = stage == name and chunk is not None \
+                    and chunk == int(idx)
+            else:
+                hit = s.arg == stage
+            if not hit:
+                continue
+            s.remaining -= 1
+            cls, msg = _EXC[s.kind]
+            raise cls(f"{msg} ({s.kind}@{s.scope}:{s.arg} at "
+                      f"stage={stage!r} chunk={chunk})")
+
+
+def dead_ranks() -> FrozenSet[int]:
+    """Ranks whose shard-local result should be invalidated
+    (``shard@rank:R`` specs). Queried — never consumed — by the sharded
+    searches, which mask the shard out of the merge when
+    ``partial_ok=True``."""
+    return frozenset(
+        int(s.arg) for s in plan() if s.kind == "shard" and s.scope == "rank"
+    )
+
+
+def has_shard_faults() -> bool:
+    return bool(dead_ranks())

@@ -49,6 +49,10 @@ _mode_override: Optional[str] = None
 _table_path_override: Optional[str] = None
 _table_cache: Dict[str, Optional[DispatchTable]] = {}
 _measured: Dict = {}
+# in-process budget ceilings learned the hard way (the resilience OOM
+# ladder records the chunk size that survived a RESOURCE_EXHAUSTED here
+# so later calls in the same process start safe instead of re-OOMing)
+_runtime_budgets: Dict[str, int] = {}
 
 
 def mode() -> str:
@@ -75,7 +79,7 @@ def backend_name() -> str:
         import jax
 
         p = jax.devices()[0].platform.lower()
-    except Exception:  # noqa: BLE001 - dispatch must never fail a search
+    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow dispatch must never fail a search; cpu fallback is the safe answer
         return "cpu"
     return "tpu" if p in ("tpu", "axon") else p
 
@@ -107,11 +111,12 @@ def set_table_path(path: Optional[str]) -> None:
 
 
 def reload() -> None:
-    """Drop the cached table and in-process measurements (tests, or
-    after re-capturing a table)."""
+    """Drop the cached table, in-process measurements, and runtime
+    budgets (tests, or after re-capturing a table)."""
     with _lock:
         _table_cache.clear()
         _measured.clear()
+        _runtime_budgets.clear()
 
 
 def get_table() -> Optional[DispatchTable]:
@@ -136,7 +141,7 @@ def _tracing() -> bool:
         import jax
 
         return not jax.core.trace_state_clean()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow trace-state probe only gates measure mode; not-tracing is the safe fallback
         return False
 
 
@@ -191,21 +196,46 @@ def choose(op: str, key: Dict, candidates: List[str],
     return fallback
 
 
+def record_budget(name: str, value: int) -> None:
+    """Record a runtime budget CEILING for ``name`` (in-process only).
+
+    The resilience OOM ladder calls this with the chunk/batch size that
+    survived a RESOURCE_EXHAUSTED; :func:`budget` then clamps every
+    later lookup of ``name`` to the recorded minimum so subsequent
+    dispatches in this process start at a size known to fit. Repeated
+    records keep the minimum. Cleared by :func:`reload`.
+    """
+    v = int(value)
+    with _lock:
+        prior = _runtime_budgets.get(name)
+        _runtime_budgets[name] = v if prior is None else min(prior, v)
+
+
+def runtime_budget(name: str) -> Optional[int]:
+    """The recorded runtime ceiling for ``name``, if any."""
+    with _lock:
+        return _runtime_budgets.get(name)
+
+
 def budget(name: str, default: int) -> int:
     """A tuned byte budget (e.g. ``cagra_inline_bytes``), or ``default``
-    when tuning is off or the table has no entry."""
-    if mode() == "off":
-        return int(default)
-    t = get_table()
-    if t is not None:
-        v = t.budget(name)
-        if v is not None:
-            return v
-    return int(default)
+    when tuning is off or the table has no entry. A runtime ceiling
+    recorded by :func:`record_budget` (an OOM survivor size) clamps the
+    answer in every mode — a learned hard limit outranks projections."""
+    out = int(default)
+    if mode() != "off":
+        t = get_table()
+        if t is not None:
+            v = t.budget(name)
+            if v is not None:
+                out = int(v)
+    ceil = runtime_budget(name)
+    return out if ceil is None else min(out, ceil)
 
 
 __all__ = [
     "DispatchTable", "MEASURABLE_INLINE", "backend_name", "budget",
-    "choose", "get_table", "mode", "reload", "set_mode",
-    "set_table_path", "table_path", "tables_dir",
+    "choose", "get_table", "mode", "record_budget", "reload",
+    "runtime_budget", "set_mode", "set_table_path", "table_path",
+    "tables_dir",
 ]

@@ -36,27 +36,42 @@ class BatchLoadIterator:
         batch_size: int,
         device: Optional[jax.Device] = None,
         pad_to_full: bool = False,
+        start_row: int = 0,
     ):
         self.host = host_array
         self.batch_size = int(batch_size)
         self.device = device
         self.pad_to_full = pad_to_full
+        self.start_row = int(start_row)
 
     def __len__(self) -> int:
-        return -(-self.host.shape[0] // self.batch_size)
+        return -(-max(self.host.shape[0] - self.start_row, 0)
+                 // self.batch_size)
+
+    def set_batch_rows(self, rows: int) -> None:
+        """Shrink (or grow) the batch size for the REMAINING batches —
+        the resilience OOM ladder's iterator hook: after a batch had to
+        be split to survive, later batches start at the surviving size
+        instead of re-OOMing. Takes effect at the next ``__iter__``
+        step (the size is re-read per batch)."""
+        self.batch_size = max(int(rows), 1)
 
     def __iter__(self) -> Iterator[Tuple[int, jax.Array]]:
         n = self.host.shape[0]
         pending: Optional[Tuple[int, jax.Array]] = None
-        for start, stop in batch_ranges(n, self.batch_size):
+        start = self.start_row
+        while start < n:
+            bs = self.batch_size          # re-read: see set_batch_rows
+            stop = min(start + bs, n)
             chunk = self.host[start:stop]
-            if self.pad_to_full and chunk.shape[0] < self.batch_size:
-                pad = np.zeros((self.batch_size - chunk.shape[0],) + chunk.shape[1:], chunk.dtype)
+            if self.pad_to_full and chunk.shape[0] < bs:
+                pad = np.zeros((bs - chunk.shape[0],) + chunk.shape[1:], chunk.dtype)
                 chunk = np.concatenate([chunk, pad], axis=0)
             dev = jax.device_put(chunk, self.device)
             if pending is not None:
                 yield pending
             pending = (start, dev)
+            start = stop
         if pending is not None:
             yield pending
 

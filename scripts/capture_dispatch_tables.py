@@ -46,22 +46,33 @@ def main(argv=None):
     ap.add_argument("--interpret", action="store_true",
                     help="on CPU, also time the Pallas kernels in "
                          "interpret mode (debug-only numbers)")
+    ap.add_argument("--deadline", type=float, default=1500.0,
+                    help="wall-clock budget (s) for the capture incl. "
+                         "one transient retry (resilience.run)")
     args = ap.parse_args(argv)
 
     import jax
 
-    from raft_tpu import tuning
+    from raft_tpu import resilience, tuning
     from raft_tpu.tuning import microbench
 
     backend = args.backend or tuning.backend_name()
     print(f"devices: {jax.devices()}  backend table: {backend}",
           flush=True)
-    table = microbench.capture(
+    # resilience wrap: a transient blip (tunnel reset mid-grid) costs one
+    # classified retry inside --deadline instead of the whole capture;
+    # OOM/fatal failures still propagate straight to the exit guard
+    table = resilience.run(
+        microbench.capture,
         backend=backend,
         quick=not args.full,
         include_interpret=args.interpret,
         reps=args.reps,
         ops=args.ops.split(",") if args.ops else None,
+        retries=1,
+        backoff_s=15,
+        deadline_s=args.deadline,
+        retry_on=(resilience.TRANSIENT,),
     )
     out = args.out or os.path.join(tuning.tables_dir(), backend + ".json")
     table.save(out)

@@ -51,6 +51,9 @@ STAGES = [
 
 
 def main():
+    sys.path.insert(0, ROOT)
+    from raft_tpu import resilience
+
     only = skip = None
     if "--only" in sys.argv:
         only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
@@ -78,9 +81,26 @@ def main():
             continue
         t0 = time.time()
         print(f"=== {name}: {' '.join(argv)} (timeout {tmo}s)", flush=True)
-        try:
+
+        # resilience wrap: the subprocess timeout is the HARD per-stage
+        # bound (a wedged stage cannot eat the battery); resilience.run
+        # adds ONE classified retry for transient-looking failures under
+        # a per-stage wall-clock deadline, so a blip (UNAVAILABLE,
+        # connection reset) costs one rerun instead of the stage
+        def _attempt():
             r = subprocess.run(argv, timeout=tmo, cwd=ROOT,
                                capture_output=True)
+            if r.returncode != 0:
+                tail = (r.stdout + r.stderr).decode(errors="replace")[-4000:]
+                if resilience.classify_text(tail) == resilience.TRANSIENT:
+                    raise resilience.TransientError(
+                        f"{name}: rc={r.returncode}, transient tail")
+            return r
+
+        try:
+            r = resilience.run(_attempt, retries=1, backoff_s=30,
+                               deadline_s=tmo * 1.5,
+                               retry_on=(resilience.TRANSIENT,))
             out = r.stdout.decode(errors="replace")
             err = r.stderr.decode(errors="replace")
             status["stages"][name] = {
@@ -100,6 +120,14 @@ def main():
         except subprocess.TimeoutExpired:
             status["stages"][name] = {"rc": "timeout", "s": tmo}
             print(f"--- {name}: TIMEOUT after {tmo}s", flush=True)
+        except resilience.ResilienceError as e:
+            # retry budget/deadline exhausted: record and move on — one
+            # flaky stage must not abort the rest of the battery
+            status["stages"][name] = {
+                "rc": f"resilience:{type(e).__name__}",
+                "s": round(time.time() - t0, 1), "tail": str(e)[-2000:],
+            }
+            print(f"--- {name}: {type(e).__name__}: {e}", flush=True)
         flush()
         # between stages, re-probe: if the TPU died mid-battery, stop
         # burning stage timeouts on a dead backend

@@ -285,6 +285,116 @@ def test_gl006_negatives():
 
 
 # ---------------------------------------------------------------------------
+# GL008 unclassified swallow
+# ---------------------------------------------------------------------------
+
+
+def test_gl008_swallowed_device_failure_positive():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except Exception:
+                return 0.0
+    """)
+    assert "GL008" in rules
+
+
+def test_gl008_bare_except_positive():
+    rules, _ = _rules("""
+        import jax
+
+        def f(x):
+            try:
+                jax.block_until_ready(x)
+            except:
+                pass
+    """)
+    assert "GL008" in rules
+
+
+def test_gl008_tuple_except_positive():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except (ValueError, Exception):
+                return 0.0
+    """)
+    assert "GL008" in rules
+
+
+def test_gl008_classify_negative():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+        from raft_tpu import resilience
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except Exception as e:
+                if resilience.classify(e) == "oom":
+                    return None
+                return 0.0
+    """, only="GL008")
+    assert rules == []
+
+
+def test_gl008_reraise_negative():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+    """, only="GL008")
+    assert rules == []
+
+
+def test_gl008_no_device_compute_negative():
+    rules, _ = _rules("""
+        def f(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+    """, only="GL008")
+    assert rules == []
+
+
+def test_gl008_narrow_except_negative():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except ValueError:
+                return 0.0
+    """, only="GL008")
+    assert rules == []
+
+
+def test_gl008_suppressed_with_reason():
+    rules, _ = _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            try:
+                return jnp.sum(x * x)
+            except Exception:  # graft-lint: allow-unclassified-swallow fallback-only probe
+                return 0.0
+    """)
+    assert "GL008" not in rules
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
