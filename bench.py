@@ -24,6 +24,7 @@ explicitly labeled as estimates.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -270,6 +271,21 @@ def bench_ivfpq_deep10m(results):
 
 
 def main():
+    # --obs-snapshot [PATH]: run instrumented (graft-scope, RAFT_TPU_OBS
+    # at least "on") and write the metrics-snapshot sidecar next to the
+    # headline JSON line — dispatch winners, per-algo latency histograms,
+    # OOM-ladder/retry counts, device memory gauges (docs/observability.md)
+    obs_path = None
+    if "--obs-snapshot" in sys.argv:
+        i = sys.argv.index("--obs-snapshot")
+        obs_path = (sys.argv[i + 1] if i + 1 < len(sys.argv)
+                    and not sys.argv[i + 1].startswith("-")
+                    else "BENCH_obs.json")
+        from raft_tpu import obs
+
+        if not obs.enabled():
+            obs.set_mode("on")
+
     # Fail fast and parseably when the TPU backend is unreachable (the
     # round-4 outage left BENCH_r04.json holding a 40-line traceback;
     # the driver's record should stay one JSON line either way).
@@ -327,6 +343,10 @@ def main():
             for kk, vv in results.items()
         },
     }
+    if obs_path is not None:
+        from raft_tpu.bench.harness import write_obs_snapshot
+
+        write_obs_snapshot(obs_path)
     print(json.dumps(out))
 
 

@@ -22,6 +22,7 @@ Layer map (mirrors the reference's cpp/include/raft/<layer> — SURVEY.md §1):
     comms      collectives facade over jax.lax/shard_map (NCCL/UCX analog)
     ops        Pallas TPU kernels for the hot paths
     bench      ANN benchmark harness (raft-ann-bench analog)
+    obs        graft-scope: spans, metrics registry, flight recorder
 """
 
 __version__ = "0.1.0"

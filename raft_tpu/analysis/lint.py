@@ -211,6 +211,7 @@ class FileLinter:
         self._mark_traced_functions()
         self._lint_tree()
         self._lint_comments_and_docstrings()
+        self._check_unspanned_entries()
         # nested defs are revisited by the per-function GL003 pass; dedupe
         seen: Set[Tuple[str, int, str]] = set()
         unique: List[Finding] = []
@@ -612,6 +613,37 @@ class FileLinter:
                        "failure without resilience.classify(): transient/"
                        "OOM/dead-backend collapse into one silent fallback; "
                        "classify, re-raise, or suppress with a reason")
+
+    # -- GL009 unspanned entry points --------------------------------------
+
+    def _check_unspanned_entries(self) -> None:
+        """Public module-level ``search*``/``build*`` functions in
+        ``neighbors/`` modules must open a graft-scope span
+        (``obs.span`` / ``obs.entry_span`` — any call whose final dotted
+        component ends in ``span`` counts): an unobserved entry point is
+        a hole in the latency/count coverage docs/observability.md
+        documents. Param-computation helpers suppress with a reason."""
+        if "neighbors" not in Path(self.path).parts:
+            return
+        for node in self.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            name = node.name
+            if name.startswith("_") or not name.startswith(("search",
+                                                            "build")):
+                continue
+            has_span = any(
+                isinstance(sub, ast.Call)
+                and (_dotted(sub.func) or "").rsplit(".", 1)[-1]
+                    .endswith("span")
+                for sub in ast.walk(node)
+            )
+            if not has_span:
+                self._emit("GL009", node,
+                           f"public entry point {name}() opens no obs.span: "
+                           "its latency and query counts are attributed to "
+                           "nobody; wrap the body in obs.entry_span/obs.span "
+                           "or suppress with a reason")
 
     # -- GL004 f64 ---------------------------------------------------------
 

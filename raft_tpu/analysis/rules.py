@@ -110,6 +110,15 @@ register_rule(
     "genuinely fallback-only sites suppress with a reason",
 )
 register_rule(
+    "GL009", "unspanned-entry",
+    "public neighbors search/build entry point without an obs.span",
+    "graft-scope (docs/observability.md) is only as complete as its "
+    "coverage: a public search/build path that opens no span produces "
+    "latency and query counts attributed to nobody, which is exactly the "
+    "blind spot the reference's NVTX-everywhere convention prevents; open "
+    "an obs.span/obs.entry_span or suppress with a reason",
+)
+register_rule(
     "GL005", "undated-perf",
     "quantified performance claim without a date/round/artifact citation",
     "undated claims outlive the code they measured (VERDICT weak #7); every "

@@ -252,6 +252,17 @@ def run_case(
     )
 
 
+def write_obs_snapshot(path: str) -> str:
+    """Write the graft-scope metrics snapshot (docs/observability.md) as
+    a JSON sidecar next to a bench artifact — every ``BENCH_*.json`` run
+    with ``--obs-snapshot`` gains the dispatch-winner counts, per-algo
+    latency histograms, ladder/retry counters, and device memory gauges
+    that explain its headline numbers. Returns ``path``."""
+    from raft_tpu import obs
+
+    return obs.write_snapshot(path)
+
+
 def export_csv(results: List[BenchResult], path: str) -> None:
     """gbench-JSON→CSV analog (raft-ann-bench data_export)."""
     import csv

@@ -465,7 +465,17 @@ def main(argv=None) -> None:
     ap.add_argument("--plot", action="store_true")
     ap.add_argument("--mode", choices=("throughput", "latency"),
                     default="throughput")
+    ap.add_argument("--obs-snapshot", action="store_true",
+                    help="run instrumented (graft-scope; forces "
+                         "RAFT_TPU_OBS=on if off) and write a "
+                         "<stem>.obs.json metrics sidecar next to the "
+                         "results (docs/observability.md)")
     args = ap.parse_args(argv)
+    if args.obs_snapshot:
+        from raft_tpu import obs
+
+        if not obs.enabled():
+            obs.set_mode("on")
     cfg = json.load(open(args.config))
     os.makedirs(args.output, exist_ok=True)
     results = run_config(cfg, iters=args.iters, mode=args.mode)
@@ -473,6 +483,10 @@ def main(argv=None) -> None:
     export_csv(results, os.path.join(args.output, f"{stem}.csv"))
     with open(os.path.join(args.output, f"{stem}.json"), "w") as fp:
         json.dump([r.row() for r in results], fp, indent=2)
+    if args.obs_snapshot:
+        from raft_tpu.bench.harness import write_obs_snapshot
+
+        write_obs_snapshot(os.path.join(args.output, f"{stem}.obs.json"))
     if args.plot:
         plot_results(results, os.path.join(args.output, f"{stem}.png"))
 

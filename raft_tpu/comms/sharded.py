@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.comms.compat import shard_map
 
+from raft_tpu import obs
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors.common import merge_topk
@@ -73,8 +74,20 @@ def _coverage(valid, axis_name) -> jax.Array:
 
 def _finish_partial(out, partial_ok: bool, what: str):
     """Host-side tail of a partial-capable search: hand back (d, i,
-    coverage) under ``partial_ok``, else raise on any dropout."""
+    coverage) under ``partial_ok``, else raise on any dropout.
+
+    With obs enabled the replicated coverage fraction is recorded as the
+    ``shard_coverage{what}`` gauge (plus ``shard_dropouts_total`` when it
+    dips below 1) — note the gauge read forces a host sync of the
+    coverage scalar, which the bare ``partial_ok=True`` path otherwise
+    defers to the caller."""
     d, i, cov = out
+    if obs.enabled():
+        c = float(np.asarray(cov))
+        obs.gauge("shard_coverage", c, what=what)
+        if c < 1.0:
+            obs.counter("shard_dropouts_total", what=what)
+            obs.event("shard_dropout", what=what, coverage=c)
     if partial_ok:
         return d, i, cov
     # fault-detection path without the partial opt-in: refuse to return
@@ -166,7 +179,10 @@ def sharded_knn(
         check_vma=False,
     )
     args = (queries, dataset) + ((_dead_rank_array(),) if partial else ())
-    out = jax.jit(fn)(*args)
+    with obs.entry_span("search", "sharded_knn",
+                        queries=int(queries.shape[0]), k=int(k),
+                        shards=int(nshards)):
+        out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_knn")
     return out
@@ -261,7 +277,10 @@ def sharded_ivf_search(
         out_specs=(P(), P()) + ((P(),) if partial else ()),
         check_vma=False,
     )
-    out = jax.jit(fn)(*args)
+    with obs.entry_span("search", "sharded_ivf",
+                        queries=int(queries.shape[0]), k=int(k),
+                        shards=int(nshards)):
+        out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_search")
     return out
@@ -432,7 +451,10 @@ def sharded_ivf_pq_search(
         out_specs=(P(), P()) + ((P(),) if partial else ()),
         check_vma=False,
     )
-    out = jax.jit(fn)(*args)
+    with obs.entry_span("search", "sharded_ivf_pq",
+                        queries=int(queries.shape[0]), k=int(k),
+                        shards=int(nshards), refine_ratio=refine_ratio):
+        out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_pq_search")
     return out
@@ -665,7 +687,10 @@ def sharded_cagra_search(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)(*args)
+    with obs.entry_span("search", "sharded_cagra",
+                        queries=int(queries.shape[0]), k=int(k),
+                        shards=int(nshards)):
+        return jax.jit(fn)(*args)
 
 
 def sharded_ivf_build(
@@ -788,7 +813,10 @@ def sharded_ivf_row_search(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)(*args)
+    with obs.entry_span("search", "sharded_ivf_row",
+                        queries=int(queries.shape[0]), k=int(k),
+                        shards=int(nshards)):
+        return jax.jit(fn)(*args)
 
 
 def sharded_pairwise_distance(

@@ -4,35 +4,66 @@ Analog of the reference's NVTX ranges (cpp/include/raft/core/nvtx.hpp:48-96:
 RAII ``range`` + ``push_range``/``pop_range``), mapped onto
 ``jax.profiler.TraceAnnotation`` so ranges show up in XLA/TPU profiler
 traces. Disabled cheaply when profiling is off.
+
+Absorbed by graft-scope (:mod:`raft_tpu.obs`): when ``RAFT_TPU_OBS`` is
+on, :func:`annotate`/:func:`annotated` delegate to
+:func:`raft_tpu.obs.span` — the same call then lands in the structured
+span tree AND the XLA trace (obs spans emit the TraceAnnotation
+themselves, forwarding scalar attrs as annotation metadata, so profiler
+output matches the direct path for scalar kwargs; non-scalar metadata
+survives only in the span tree). With obs off, the plain
+TraceAnnotation path below is unchanged.
+
+The ``push_range``/``pop_range`` stack is per-thread
+(``threading.local``): the reference's nvtx ranges are thread-scoped
+too, and a module-global list would let concurrent streaming threads
+pop each other's ranges.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
-from typing import Any, Iterator
+import threading
+from typing import Iterator
 
 import jax
 
-_range_stack: list[Any] = []
+from raft_tpu.obs import config as _obs_config
+
+_tls = threading.local()
+
+
+def _range_stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
 
 
 @contextlib.contextmanager
 def annotate(name: str, **kwargs) -> Iterator[None]:
     """RAII-style range (reference nvtx.hpp ``common::nvtx::range``)."""
-    with jax.profiler.TraceAnnotation(name, **kwargs):
-        yield
+    if _obs_config.ENABLED:
+        from raft_tpu import obs
+
+        with obs.span(name, **kwargs):
+            yield
+    else:
+        with jax.profiler.TraceAnnotation(name, **kwargs):
+            yield
 
 
 def push_range(name: str) -> None:
     t = jax.profiler.TraceAnnotation(name)
     t.__enter__()
-    _range_stack.append(t)
+    _range_stack().append(t)
 
 
 def pop_range() -> None:
-    if _range_stack:
-        _range_stack.pop().__exit__(None, None, None)
+    stack = _range_stack()
+    if stack:
+        stack.pop().__exit__(None, None, None)
 
 
 def annotated(name: str | None = None):
@@ -43,7 +74,7 @@ def annotated(name: str | None = None):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with jax.profiler.TraceAnnotation(label):
+            with annotate(label):
                 return fn(*args, **kwargs)
 
         return wrapper

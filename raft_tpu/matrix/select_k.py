@@ -66,10 +66,15 @@ def select_k(
             f"impl='tournament' is float-only, got {in_val.dtype}")
     if impl == "auto":
         impl = dispatch_select_impl(batch, n, int(k), in_val.dtype)
-    if impl == "tournament":
-        vals, idxs = _tournament_topk(in_val, int(k), bool(select_min))
-    else:
-        vals, idxs = _select_k(in_val, int(k), bool(select_min))
+    from raft_tpu import obs
+
+    # trace-time span: select_k usually runs under an outer jit, so this
+    # attributes COMPILE time per impl; steady-state dispatch is silent
+    with obs.span("select_k", impl=impl, n=n, k=int(k), batch=batch):
+        if impl == "tournament":
+            vals, idxs = _tournament_topk(in_val, int(k), bool(select_min))
+        else:
+            vals, idxs = _select_k(in_val, int(k), bool(select_min))
     if in_idx is not None:
         in_idx = jnp.asarray(in_idx)
         if squeeze and in_idx.ndim == 1:

@@ -94,6 +94,7 @@ def build(
 ) -> BallCoverIndex:
     """Build the ball cover (reference ball_cover.cuh:56 build_index;
     landmark count defaults to √n as in ball_cover_types.hpp)."""
+    from raft_tpu import obs
     from raft_tpu.cluster import kmeans_balanced
 
     metric = _true_metric(metric)
@@ -101,28 +102,26 @@ def build(
     n, d = dataset.shape
     C = int(n_landmarks or max(1, int(math.sqrt(n))))
 
-    if metric == DistanceType.Haversine:
-        # kmeans in lat/lon radians approximates well for local extents;
-        # landmark geometry only affects pruning efficiency, not exactness
+    with obs.entry_span("build", "ball_cover", rows=n, landmarks=C):
+        # L2 kmeans for every metric — for Haversine, kmeans in lat/lon
+        # radians approximates well for local extents, and landmark
+        # geometry only affects pruning efficiency, not exactness
         landmarks = kmeans_balanced.build_hierarchical(
             dataset, C, metric=DistanceType.L2Expanded, seed=seed
         )
-    else:
-        landmarks = kmeans_balanced.build_hierarchical(
-            dataset, C, metric=DistanceType.L2Expanded, seed=seed
-        )
-    d_pl = pairwise_distance(dataset, landmarks, metric)  # [n, C] true metric
-    labels = jnp.argmin(d_pl, axis=1).astype(jnp.int32)
-    dist_to_lm = jnp.min(d_pl, axis=1)
+        d_pl = pairwise_distance(dataset, landmarks, metric)  # [n, C] true
+        labels = jnp.argmin(d_pl, axis=1).astype(jnp.int32)
+        dist_to_lm = jnp.min(d_pl, axis=1)
 
-    # graft-lint: allow-host-sync build list capacity must be concrete to allocate
-    counts = np.asarray(jnp.bincount(labels, length=C))
-    cap = _aligned_cap(int(counts.max()) if n else 1)
-    storage, indices, list_sizes = _pack_lists(
-        dataset, labels, jnp.arange(n, dtype=jnp.int32), C, cap
-    )
-    radii = jnp.zeros((C,), jnp.float32).at[labels].max(dist_to_lm)
-    return BallCoverIndex(landmarks, storage, indices, list_sizes, radii, metric)
+        # graft-lint: allow-host-sync build list capacity must be concrete to allocate
+        counts = np.asarray(jnp.bincount(labels, length=C))
+        cap = _aligned_cap(int(counts.max()) if n else 1)
+        storage, indices, list_sizes = _pack_lists(
+            dataset, labels, jnp.arange(n, dtype=jnp.int32), C, cap
+        )
+        radii = jnp.zeros((C,), jnp.float32).at[labels].max(dist_to_lm)
+        return BallCoverIndex(landmarks, storage, indices, list_sizes,
+                              radii, metric)
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6))

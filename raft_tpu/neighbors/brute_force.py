@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.pairwise import _block_distance, _EXPANDED, _expanded_path
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
@@ -63,11 +64,12 @@ def build(dataset, metric="sqeuclidean", metric_arg: float = 2.0) -> Index:
     """Build a brute-force index (reference brute_force-inl.cuh:345)."""
     metric = resolve_metric(metric)
     dataset = jnp.asarray(dataset)
-    norms = None
-    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
-        ds32 = dataset.astype(jnp.float32)
-        norms = jnp.sum(ds32 * ds32, axis=1)
-    return Index(dataset=dataset, metric=metric, metric_arg=metric_arg, norms=norms)
+    with obs.entry_span("build", "brute_force", rows=int(dataset.shape[0])):
+        norms = None
+        if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded, DistanceType.CosineExpanded):
+            ds32 = dataset.astype(jnp.float32)
+            norms = jnp.sum(ds32 * ds32, axis=1)
+        return Index(dataset=dataset, metric=metric, metric_arg=metric_arg, norms=norms)
 
 
 def search(
@@ -93,52 +95,54 @@ def search(
     n = index.size
     if not 0 < k <= n:
         raise ValueError(f"k={k} out of range for dataset size {n}")
-    filt = as_filter(prefilter)
-    filter_bits = getattr(filt, "bitset", None)
-    if tile_n is None:
-        budget = (128 * 1024 * 1024) // 4
-        tile_n = min(n, max(1024, budget // max(queries.shape[0], 1)))
-        tile_n = min(tile_n, 65536)
+    with obs.entry_span("search", "brute_force",
+                        queries=int(queries.shape[0]), k=int(k), fast=fast):
+        filt = as_filter(prefilter)
+        filter_bits = getattr(filt, "bitset", None)
+        if tile_n is None:
+            budget = (128 * 1024 * 1024) // 4
+            tile_n = min(n, max(1024, budget // max(queries.shape[0], 1)))
+            tile_n = min(tile_n, 65536)
 
-    fast_ok = fast and index.metric in (
-        DistanceType.L2Expanded,
-        DistanceType.L2SqrtExpanded,
-        DistanceType.CosineExpanded,
-        DistanceType.InnerProduct,
-    )
-    if fast_ok:
-        from raft_tpu.neighbors.refine import refine as _refine
+        fast_ok = fast and index.metric in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.CosineExpanded,
+            DistanceType.InnerProduct,
+        )
+        if fast_ok:
+            from raft_tpu.neighbors.refine import refine as _refine
 
-        k_cand = min(n, max(4 * k, k + 32))
-        cand_d, cand = _search(
-            queries.astype(jnp.bfloat16),
-            index.dataset.astype(jnp.bfloat16),
+            k_cand = min(n, max(4 * k, k + 32))
+            cand_d, cand = _search(
+                queries.astype(jnp.bfloat16),
+                index.dataset.astype(jnp.bfloat16),
+                index.norms,
+                None if filter_bits is None else filter_bits.bits,
+                None if filter_bits is None else filter_bits.n_bits,
+                int(k_cand),
+                int(index.metric),
+                float(index.metric_arg),
+                int(min(tile_n, n)),
+            )
+            # candidates at the sentinel distance are padding or
+            # prefiltered-out rows; mark them invalid so refine (which runs
+            # unfiltered) cannot resurrect them into the final top-k
+            sentinel = sentinel_for(index.metric, cand_d.dtype)
+            cand = jnp.where(cand_d == sentinel, -1, cand)
+            return _refine(index.dataset, queries, cand, k, index.metric)
+
+        return _search(
+            queries,
+            index.dataset,
             index.norms,
             None if filter_bits is None else filter_bits.bits,
             None if filter_bits is None else filter_bits.n_bits,
-            int(k_cand),
+            int(k),
             int(index.metric),
             float(index.metric_arg),
             int(min(tile_n, n)),
         )
-        # candidates at the sentinel distance are padding or prefiltered-out
-        # rows; mark them invalid so refine (which runs unfiltered) cannot
-        # resurrect them into the final top-k
-        sentinel = sentinel_for(index.metric, cand_d.dtype)
-        cand = jnp.where(cand_d == sentinel, -1, cand)
-        return _refine(index.dataset, queries, cand, k, index.metric)
-
-    return _search(
-        queries,
-        index.dataset,
-        index.norms,
-        None if filter_bits is None else filter_bits.bits,
-        None if filter_bits is None else filter_bits.n_bits,
-        int(k),
-        int(index.metric),
-        float(index.metric_arg),
-        int(min(tile_n, n)),
-    )
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))

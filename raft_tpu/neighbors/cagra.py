@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.matrix.bitonic import sort_by_key
 from raft_tpu.neighbors.common import merge_topk
@@ -375,13 +376,15 @@ def build_knn_graph(
     )
 
     rows = []
-    for start in range(0, n, query_batch):
-        q = dataset[start:start + query_batch]
-        _, cand = ivf_pq.search(sp, index, q, gpu_top_k)
-        # always exact-rerank: optimize consumes RANK order, and PQ ranks
-        # are approximate even when gpu_top_k == k (0.13 s per 16k batch)
-        _, cand = refine(dataset, q, cand, k, metric)
-        rows.append(cand)
+    with obs.span("cagra.build.self_search", batches=-(-n // query_batch)):
+        for start in range(0, n, query_batch):
+            q = dataset[start:start + query_batch]
+            _, cand = ivf_pq.search(sp, index, q, gpu_top_k)
+            # always exact-rerank: optimize consumes RANK order, and PQ
+            # ranks are approximate even when gpu_top_k == k (0.13 s per
+            # 16k batch)
+            _, cand = refine(dataset, q, cand, k, metric)
+            rows.append(cand)
     graph = jnp.concatenate(rows, axis=0)     # [n, k]
 
     # drop self-edges: usually in slot 0; fall back to dropping the last
@@ -527,26 +530,30 @@ def build(params: IndexParams, dataset) -> Index:
     """Build the index (reference cagra.cuh:274 build)."""
     dataset = jnp.asarray(dataset)
     metric = params.metric
-    if params.graph_build_algo == build_algo.NN_DESCENT:
-        from raft_tpu.neighbors import nn_descent
+    with obs.entry_span("build", "cagra", rows=int(dataset.shape[0]),
+                        graph_degree=int(params.graph_degree)):
+        if params.graph_build_algo == build_algo.NN_DESCENT:
+            from raft_tpu.neighbors import nn_descent
 
-        nd_params = nn_descent.IndexParams(
-            graph_degree=int(params.intermediate_graph_degree), metric=metric
-        )
-        knn = nn_descent.build(nd_params, dataset).graph
-    else:
-        knn = build_knn_graph(
-            dataset, int(params.intermediate_graph_degree), metric,
-            min_degree=int(params.graph_degree),
-        )
-    graph = optimize(knn, int(params.graph_degree))
-    norms = None
-    if metric != DistanceType.InnerProduct:
-        d32 = dataset.astype(jnp.float32)
-        norms = jnp.sum(d32 * d32, axis=1)
-    index = Index(dataset=dataset, graph=graph, metric=metric,
-                  data_norms=norms)
-    return _attach_inline(index, params.inline_codes)
+            nd_params = nn_descent.IndexParams(
+                graph_degree=int(params.intermediate_graph_degree),
+                metric=metric,
+            )
+            knn = nn_descent.build(nd_params, dataset).graph
+        else:
+            knn = build_knn_graph(
+                dataset, int(params.intermediate_graph_degree), metric,
+                min_degree=int(params.graph_degree),
+            )
+        with obs.span("cagra.build.optimize"):
+            graph = optimize(knn, int(params.graph_degree))
+        norms = None
+        if metric != DistanceType.InnerProduct:
+            d32 = dataset.astype(jnp.float32)
+            norms = jnp.sum(d32 * d32, axis=1)
+        index = Index(dataset=dataset, graph=graph, metric=metric,
+                      data_norms=norms)
+        return _attach_inline(index, params.inline_codes)
 
 
 def from_graph(dataset, graph, metric=DistanceType.L2Expanded,
@@ -1043,6 +1050,7 @@ def _resolve_beam_impl(requested: str, index: Index,
     return "pallas" if tuning.backend_name() == "tpu" else "xla"
 
 
+# graft-lint: allow-unspanned-entry pure parameter arithmetic — no device dispatch to observe
 def search_plan(search_params: SearchParams, k: int):
     """Derive (itopk, width, iters, n_seeds) from params + k (the
     reference's search_plan, detail/cagra/search_plan.cuh:70). Shared
@@ -1088,57 +1096,60 @@ def search(
     from raft_tpu.neighbors.common import as_filter
 
     queries = jnp.asarray(queries)
-    filt = as_filter(prefilter)
-    bits = getattr(filt, "bitset", None)
-    fbits = None if bits is None else bits.bits
-    fnbits = 0 if bits is None else int(bits.n_bits)
-    itopk, width, iters, n_seeds = search_plan(search_params, k)
-    dtype = str(search_params.compute_dtype)
-    impl = _resolve_beam_impl(str(search_params.scan_impl), index, dtype)
-    if impl.startswith("pallas"):
-        if index.nbr_pack is None:
-            raise ValueError(
-                "scan_impl=%r needs the packed inline layout (build with "
-                "inline_codes=True; requires dim %% 4 == 0)" % impl
+    with obs.entry_span("search", "cagra", queries=int(queries.shape[0]),
+                        k=int(k)) as _sp:
+        filt = as_filter(prefilter)
+        bits = getattr(filt, "bitset", None)
+        fbits = None if bits is None else bits.bits
+        fnbits = 0 if bits is None else int(bits.n_bits)
+        itopk, width, iters, n_seeds = search_plan(search_params, k)
+        dtype = str(search_params.compute_dtype)
+        impl = _resolve_beam_impl(str(search_params.scan_impl), index, dtype)
+        _sp.set(scan_impl=impl, itopk=itopk, iters=iters)
+        if impl.startswith("pallas"):
+            if index.nbr_pack is None:
+                raise ValueError(
+                    "scan_impl=%r needs the packed inline layout (build with "
+                    "inline_codes=True; requires dim %% 4 == 0)" % impl
+                )
+            if dtype != "auto":
+                raise ValueError(
+                    "scan_impl=%r scores int8 traversal distances; "
+                    "compute_dtype must stay 'auto' (got %r)" % (impl, dtype)
+                )
+            return _beam_search_pallas(
+                queries,
+                index.dataset,
+                index.graph,
+                index.data_norms,
+                index.nbr_pack,
+                index.flat_codes,
+                jnp.float32(index.code_scale),
+                int(k),
+                itopk,
+                width,
+                iters,
+                int(index.metric),
+                n_seeds,
+                impl == "pallas_interpret",
+                fbits,
+                fnbits,
             )
-        if dtype != "auto":
-            raise ValueError(
-                "scan_impl=%r scores int8 traversal distances; "
-                "compute_dtype must stay 'auto' (got %r)" % (impl, dtype)
-            )
-        return _beam_search_pallas(
+        return _beam_search(
             queries,
             index.dataset,
             index.graph,
             index.data_norms,
-            index.nbr_pack,
-            index.flat_codes,
-            jnp.float32(index.code_scale),
             int(k),
             itopk,
             width,
             iters,
             int(index.metric),
+            "f32" if dtype == "auto" else dtype,
             n_seeds,
-            impl == "pallas_interpret",
             fbits,
             fnbits,
         )
-    return _beam_search(
-        queries,
-        index.dataset,
-        index.graph,
-        index.data_norms,
-        int(k),
-        itopk,
-        width,
-        iters,
-        int(index.metric),
-        "f32" if dtype == "auto" else dtype,
-        n_seeds,
-        fbits,
-        fnbits,
-    )
 
 
 # ---------------------------------------------------------------------------

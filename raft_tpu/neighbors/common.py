@@ -127,6 +127,7 @@ def merge_topk(
         fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
         vals, sel = fn(dists, k, recall_target=recall_target)
         return vals, jnp.take_along_axis(idxs, sel, axis=-1)
+    from raft_tpu import obs
     from raft_tpu.matrix.select_k import dispatch_select_impl, select_k
 
     shape = dists.shape
@@ -139,8 +140,12 @@ def merge_topk(
         op="merge_topk",
         fallback="auto",  # miss -> select_k's own (table-driven) dispatch
     )
-    vals, out_i = select_k(dists, k, in_idx=idxs, select_min=select_min,
-                           impl=impl)
+    # trace-time span (merge_topk runs under the callers' jits): compile
+    # attribution per chosen arm, silent on cached steady-state dispatch
+    with obs.span("merge_topk", impl=impl, c=int(dists.shape[-1]),
+                  k=int(k)):
+        vals, out_i = select_k(dists, k, in_idx=idxs,
+                               select_min=select_min, impl=impl)
     if reshaped:
         vals = vals.reshape(*shape[:-1], k)
         out_i = out_i.reshape(*shape[:-1], k)

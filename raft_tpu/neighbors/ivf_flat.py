@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core.serialize import read_index_file, write_index_file
@@ -250,52 +251,55 @@ def build(params: IndexParams, dataset, row_ids=None) -> Index:
     n, d = dataset.shape
     n_lists = int(params.n_lists)
 
-    # 1. trainset subsample + balanced kmeans (ivf_flat_build.cuh:384)
-    frac = float(params.kmeans_trainset_fraction)
-    if 0 < frac < 1.0 and int(n * frac) >= n_lists:
-        step = max(int(1.0 / frac), 1)
-        trainset = dataset[::step]
-    else:
-        trainset = dataset
-    kb = KMeansBalancedParams(
-        n_clusters=n_lists,
-        n_iters=int(params.kmeans_n_iters),
-        metric=_coarse_metric(params.metric),
-        compute_dtype=str(params.kmeans_compute_dtype),
-    )
-    centers = kmeans_balanced.fit(kb, trainset)
+    with obs.entry_span("build", "ivf_flat", rows=n, n_lists=n_lists):
+        with obs.span("ivf_flat.build.train"):
+            # 1. trainset subsample + balanced kmeans (ivf_flat_build.cuh:384)
+            frac = float(params.kmeans_trainset_fraction)
+            if 0 < frac < 1.0 and int(n * frac) >= n_lists:
+                step = max(int(1.0 / frac), 1)
+                trainset = dataset[::step]
+            else:
+                trainset = dataset
+            kb = KMeansBalancedParams(
+                n_clusters=n_lists,
+                n_iters=int(params.kmeans_n_iters),
+                metric=_coarse_metric(params.metric),
+                compute_dtype=str(params.kmeans_compute_dtype),
+            )
+            centers = kmeans_balanced.fit(kb, trainset)
 
-    st_dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}.get(
-        str(params.storage_dtype))
-    if st_dtype is None:
-        raise ValueError(
-            f"storage_dtype must be f32|bf16, got {params.storage_dtype!r}")
-    if st_dtype == jnp.bfloat16 and dataset.dtype not in (jnp.float32,
-                                                          jnp.bfloat16):
-        # The halved-bandwidth path narrows f32 storage; for any other
-        # dataset dtype (f16, int8, ...) narrowing semantics are
-        # undefined-to-lossy, and silently keeping dataset.dtype (the
-        # pre-r5 behavior) gave the caller no signal (ADVICE r4).
-        raise ValueError(
-            f"storage_dtype='bf16' requires a float32 dataset, got "
-            f"{dataset.dtype}; pass the dataset as f32 or leave "
-            "storage_dtype='f32' to store in the dataset dtype")
-    index = Index(
-        centers=centers,
-        storage=jnp.zeros((n_lists, 0, d),
-                          st_dtype if dataset.dtype == jnp.float32
-                          else dataset.dtype),
-        indices=jnp.full((n_lists, 0), -1, jnp.int32),
-        list_sizes=jnp.zeros((n_lists,), jnp.int32),
-        metric=params.metric,
-        metric_arg=params.metric_arg,
-        adaptive_centers=bool(params.adaptive_centers),
-    )
-    if not params.add_data_on_build:
-        return index
-    if row_ids is None:
-        row_ids = jnp.arange(n, dtype=jnp.int32)
-    return extend(index, dataset, jnp.asarray(row_ids))
+        st_dtype = {"f32": jnp.float32, "bf16": jnp.bfloat16}.get(
+            str(params.storage_dtype))
+        if st_dtype is None:
+            raise ValueError(
+                f"storage_dtype must be f32|bf16, got {params.storage_dtype!r}")
+        if st_dtype == jnp.bfloat16 and dataset.dtype not in (jnp.float32,
+                                                              jnp.bfloat16):
+            # The halved-bandwidth path narrows f32 storage; for any other
+            # dataset dtype (f16, int8, ...) narrowing semantics are
+            # undefined-to-lossy, and silently keeping dataset.dtype (the
+            # pre-r5 behavior) gave the caller no signal (ADVICE r4).
+            raise ValueError(
+                f"storage_dtype='bf16' requires a float32 dataset, got "
+                f"{dataset.dtype}; pass the dataset as f32 or leave "
+                "storage_dtype='f32' to store in the dataset dtype")
+        index = Index(
+            centers=centers,
+            storage=jnp.zeros((n_lists, 0, d),
+                              st_dtype if dataset.dtype == jnp.float32
+                              else dataset.dtype),
+            indices=jnp.full((n_lists, 0), -1, jnp.int32),
+            list_sizes=jnp.zeros((n_lists,), jnp.int32),
+            metric=params.metric,
+            metric_arg=params.metric_arg,
+            adaptive_centers=bool(params.adaptive_centers),
+        )
+        if not params.add_data_on_build:
+            return index
+        if row_ids is None:
+            row_ids = jnp.arange(n, dtype=jnp.int32)
+        with obs.span("ivf_flat.build.pack"):
+            return extend(index, dataset, jnp.asarray(row_ids))
 
 
 def extend(index: Index, new_vectors, new_ids=None) -> Index:
@@ -666,41 +670,45 @@ def search(
         raise ValueError(
             f"k={k} exceeds n_probes*list_capacity={n_probes * cap}"
         )
-    filt = as_filter(prefilter)
-    bits = getattr(filt, "bitset", None)
-    scan_impl = _resolve_scan_impl(
-        str(search_params.scan_impl), cap, min(int(k), cap),
-        approx=float(search_params.local_recall_target) < 1.0,
-    )
-    if scan_impl.startswith("pallas") and k > n_probes * min(cap, 256):
-        raise ValueError(
-            f"k={k} exceeds the fused kernel's candidate pool "
-            f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
-            "n_probes or use scan_impl='xla'"
+    with obs.entry_span("search", "ivf_flat",
+                        queries=int(queries.shape[0]), k=int(k),
+                        n_probes=n_probes) as _sp:
+        filt = as_filter(prefilter)
+        bits = getattr(filt, "bitset", None)
+        scan_impl = _resolve_scan_impl(
+            str(search_params.scan_impl), cap, min(int(k), cap),
+            approx=float(search_params.local_recall_target) < 1.0,
         )
-    group = adaptive_query_group(
-        int(queries.shape[0]), n_probes, index.n_lists,
-        int(search_params.query_group),
-    )
-    return _ivf_search(
-        queries,
-        index.centers,
-        index.storage,
-        index.indices,
-        index.list_sizes,
-        int(k),
-        n_probes,
-        int(index.metric),
-        group,
-        int(search_params.bucket_batch),
-        0 if bits is None else int(bits.n_bits),
-        str(search_params.compute_dtype),
-        float(search_params.local_recall_target),
-        float(search_params.merge_recall_target),
-        index.data_norms,
-        None if bits is None else bits.bits,
-        scan_impl=scan_impl,
-    )
+        _sp.set(scan_impl=scan_impl)
+        if scan_impl.startswith("pallas") and k > n_probes * min(cap, 256):
+            raise ValueError(
+                f"k={k} exceeds the fused kernel's candidate pool "
+                f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
+                "n_probes or use scan_impl='xla'"
+            )
+        group = adaptive_query_group(
+            int(queries.shape[0]), n_probes, index.n_lists,
+            int(search_params.query_group),
+        )
+        return _ivf_search(
+            queries,
+            index.centers,
+            index.storage,
+            index.indices,
+            index.list_sizes,
+            int(k),
+            n_probes,
+            int(index.metric),
+            group,
+            int(search_params.bucket_batch),
+            0 if bits is None else int(bits.n_bits),
+            str(search_params.compute_dtype),
+            float(search_params.local_recall_target),
+            float(search_params.merge_recall_target),
+            index.data_norms,
+            None if bits is None else bits.bits,
+            scan_impl=scan_impl,
+        )
 
 
 def _resolve_scan_impl(requested: str, cap: int, kl: int,

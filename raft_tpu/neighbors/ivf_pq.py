@@ -37,6 +37,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu import obs
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
@@ -371,18 +372,22 @@ def build(params: IndexParams, dataset, batch_size: Optional[int] = None) -> Ind
         dataset = jnp.asarray(dataset)
     n, dim = dataset.shape
 
-    # coarse centers train on a subsample (build.cuh: build_clusters)
-    frac = float(params.kmeans_trainset_fraction)
-    if 0 < frac < 1.0 and int(n * frac) >= int(params.n_lists):
-        trainset = jnp.asarray(dataset[:: max(int(1.0 / frac), 1)])
-    else:
-        trainset = jnp.asarray(dataset)
-    index = _quantizer_index(params, trainset, dim)
-    if not params.add_data_on_build:
-        return index
-    if not stream:
-        return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
-    return _stream_encode(params, index, dataset, n, int(batch_size))
+    with obs.entry_span("build", "ivf_pq", rows=int(n),
+                        n_lists=int(params.n_lists), streamed=stream):
+        # coarse centers train on a subsample (build.cuh: build_clusters)
+        frac = float(params.kmeans_trainset_fraction)
+        if 0 < frac < 1.0 and int(n * frac) >= int(params.n_lists):
+            trainset = jnp.asarray(dataset[:: max(int(1.0 / frac), 1)])
+        else:
+            trainset = jnp.asarray(dataset)
+        with obs.span("ivf_pq.build.train"):
+            index = _quantizer_index(params, trainset, dim)
+        if not params.add_data_on_build:
+            return index
+        with obs.span("ivf_pq.build.encode"):
+            if not stream:
+                return extend(index, dataset, jnp.arange(n, dtype=jnp.int32))
+            return _stream_encode(params, index, dataset, n, int(batch_size))
 
 
 def _quantizer_index(params: IndexParams, trainset, dim: int) -> Index:
@@ -576,6 +581,37 @@ def _quant_arrays(index: Index, ts_scales) -> dict:
 
 
 def build_streamed(
+    params: IndexParams,
+    make_batches,
+    n: int,
+    dim: int,
+    trainset,
+    keep_codes: bool = True,
+    cap_rows: Optional[int] = None,
+    verbose: bool = False,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 8,
+    resume: bool = False,
+    token=None,
+) -> Index:
+    """Build from a re-iterable stream of fixed-shape device batches —
+    the out-of-core path for datasets too large for HBM or host RAM.
+    Thin observed entry: opens the ``ivf_pq_streamed.build`` span and
+    counts per-phase progress (``stream_chunks_total{stage=build.pass1|
+    build.pass2}``) around :func:`_build_streamed_impl`, which carries
+    the full memory-model / resilience contract docs."""
+    with obs.entry_span("build", "ivf_pq_streamed", rows=int(n),
+                        n_lists=int(params.n_lists), resume=bool(resume),
+                        keep_codes=bool(keep_codes)):
+        return _build_streamed_impl(
+            params, make_batches, n, dim, trainset, keep_codes=keep_codes,
+            cap_rows=cap_rows, verbose=verbose,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            resume=resume, token=token,
+        )
+
+
+def _build_streamed_impl(
     params: IndexParams,
     make_batches,
     n: int,
@@ -818,6 +854,7 @@ def build_streamed(
                 )
             token.check()
             faultinject.check(stage="build.pass1", chunk=bi)
+            obs.counter("stream_chunks_total", stage="build.pass1")
             parts.append(kmeans_balanced.predict(kb, index.centers, batch))
             if bi % 8 == 7:
                 np.asarray(parts[-1][0])
@@ -949,6 +986,7 @@ def build_streamed(
             )
         token.check()
         faultinject.check(stage="build.pass2", chunk=bi)
+        obs.counter("stream_chunks_total", stage="build.pass2")
         bs = batch.shape[0]
         lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
         acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill = (
@@ -1936,76 +1974,79 @@ def search(
         raise ValueError("index is empty — build with add_data_on_build or extend")
     if k > n_probes * cap:
         raise ValueError(f"k={k} exceeds n_probes*list_capacity={n_probes * cap}")
-    filt = as_filter(prefilter)
-    bits = getattr(filt, "bitset", None)
-    arrays = (
-        queries, index.centers, index.centers_rot, index.rotation,
-        index.pq_centers, index.codes, index.indices, index.list_sizes,
-        index.rec_norms, None if bits is None else bits.bits,
-        index.recon_cache, jnp.float32(index.recon_scale),
-        index.cache_scales, index.cache_qnorms,
-    )  # recon_cache rides along; the body gates its use on lut_dtype
-    from raft_tpu.neighbors.ivf_flat import (
-        adaptive_query_group, _resolve_scan_impl,
-    )
+    with obs.entry_span("search", "ivf_pq", queries=int(queries.shape[0]),
+                        k=int(k), n_probes=n_probes) as _sp:
+        filt = as_filter(prefilter)
+        bits = getattr(filt, "bitset", None)
+        arrays = (
+            queries, index.centers, index.centers_rot, index.rotation,
+            index.pq_centers, index.codes, index.indices, index.list_sizes,
+            index.rec_norms, None if bits is None else bits.bits,
+            index.recon_cache, jnp.float32(index.recon_scale),
+            index.cache_scales, index.cache_qnorms,
+        )  # recon_cache rides along; the body gates its use on lut_dtype
+        from raft_tpu.neighbors.ivf_flat import (
+            adaptive_query_group, _resolve_scan_impl,
+        )
 
-    group = adaptive_query_group(
-        int(queries.shape[0]), n_probes, index.n_lists,
-        int(search_params.query_group),
-    )
-    requested = str(search_params.scan_impl)
-    lut = _norm_dtype_knob(search_params.lut_dtype)
-    use_cache = index.recon_cache is not None and lut in ("auto", "i8")
-    if lut == "i8" and index.cache_kind not in ("i8", "i4"):
-        raise ValueError(
-            "lut_dtype='i8' needs the decoded-residual cache; build with "
-            "cache_decoded=True (and within _CACHE_BUDGET)"
+        group = adaptive_query_group(
+            int(queries.shape[0]), n_probes, index.n_lists,
+            int(search_params.query_group),
         )
-    if not use_cache:
-        if requested.startswith("pallas"):
+        requested = str(search_params.scan_impl)
+        lut = _norm_dtype_knob(search_params.lut_dtype)
+        use_cache = index.recon_cache is not None and lut in ("auto", "i8")
+        if lut == "i8" and index.cache_kind not in ("i8", "i4"):
             raise ValueError(
-                "scan_impl=%r needs the decoded-residual cache (build with "
-                "cache_decoded=True and keep lut_dtype='auto'/'i8')"
-                % requested
+                "lut_dtype='i8' needs the decoded-residual cache; build with "
+                "cache_decoded=True (and within _CACHE_BUDGET)"
             )
-        if index.codes.shape[-1] == 0:
-            raise ValueError(
-                "this index was built with keep_codes=False (cache-only); "
-                "decode-path scoring needs the packed codes — search with "
-                "lut_dtype='auto' and the cache scan instead"
+        if not use_cache:
+            if requested.startswith("pallas"):
+                raise ValueError(
+                    "scan_impl=%r needs the decoded-residual cache (build "
+                    "with cache_decoded=True and keep lut_dtype='auto'/'i8')"
+                    % requested
+                )
+            if index.codes.shape[-1] == 0:
+                raise ValueError(
+                    "this index was built with keep_codes=False (cache-only); "
+                    "decode-path scoring needs the packed codes — search with "
+                    "lut_dtype='auto' and the cache scan instead"
+                )
+            impl = "xla"
+        else:
+            # cache-only indexes are fine on BOTH impls here: the XLA body
+            # also scores from recon_cache when lut_dtype is auto/i8
+            impl = _resolve_scan_impl(
+                requested, cap, min(k, cap),
+                approx=float(search_params.local_recall_target) < 1.0,
             )
-        impl = "xla"
-    else:
-        # cache-only indexes are fine on BOTH impls here: the XLA body
-        # also scores from recon_cache when lut_dtype is auto/i8
-        impl = _resolve_scan_impl(
-            requested, cap, min(k, cap),
-            approx=float(search_params.local_recall_target) < 1.0,
+            if impl.startswith("pallas") and k > n_probes * min(cap, 256):
+                raise ValueError(
+                    f"k={k} exceeds the fused kernel's candidate pool "
+                    f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
+                    "n_probes or use scan_impl='xla'"
+                )
+        _sp.set(scan_impl=impl, lut=lut)
+        return _pq_search(
+            arrays,
+            int(k),
+            n_probes,
+            int(index.metric),
+            group,
+            int(search_params.bucket_batch),
+            int(index.codebook_kind),
+            0 if bits is None else int(bits.n_bits),
+            str(search_params.compute_dtype),
+            float(search_params.local_recall_target),
+            float(search_params.merge_recall_target),
+            lut,
+            _norm_dtype_knob(search_params.internal_distance_dtype),
+            int(index.pq_dim),
+            int(index.pq_bits),
+            impl,
         )
-        if impl.startswith("pallas") and k > n_probes * min(cap, 256):
-            raise ValueError(
-                f"k={k} exceeds the fused kernel's candidate pool "
-                f"n_probes*min(cap,256)={n_probes * min(cap, 256)}; raise "
-                "n_probes or use scan_impl='xla'"
-            )
-    return _pq_search(
-        arrays,
-        int(k),
-        n_probes,
-        int(index.metric),
-        group,
-        int(search_params.bucket_batch),
-        int(index.codebook_kind),
-        0 if bits is None else int(bits.n_bits),
-        str(search_params.compute_dtype),
-        float(search_params.local_recall_target),
-        float(search_params.merge_recall_target),
-        lut,
-        _norm_dtype_knob(search_params.internal_distance_dtype),
-        int(index.pq_dim),
-        int(index.pq_bits),
-        impl,
-    )
 
 
 def _decode_slots(slots, recon_cache, cache_scales, centers_rot,
@@ -2108,15 +2149,21 @@ def search_refined(
         )
     if refine_ratio < 1:
         raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
-    slot_index = dataclasses.replace(index, indices=_slot_indices(index.indices))
-    _, slots = search(search_params, slot_index, queries, int(k * refine_ratio))
-    d, s = _refine_slots(
-        jnp.asarray(queries), slots, int(k), int(index.metric),
-        index.recon_cache, index.cache_scales, index.centers_rot,
-        index.rotation, jnp.float32(index.recon_scale),
-    )
-    ids = jnp.where(s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)], -1)
-    return d, ids
+    with obs.span("ivf_pq.search_refined", refine_ratio=int(refine_ratio),
+                  k=int(k)):
+        slot_index = dataclasses.replace(
+            index, indices=_slot_indices(index.indices))
+        _, slots = search(search_params, slot_index, queries,
+                          int(k * refine_ratio))
+        with obs.span("ivf_pq.refine"):
+            d, s = _refine_slots(
+                jnp.asarray(queries), slots, int(k), int(index.metric),
+                index.recon_cache, index.cache_scales, index.centers_rot,
+                index.rotation, jnp.float32(index.recon_scale),
+            )
+            ids = jnp.where(
+                s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)], -1)
+            return d, ids
 
 
 def _norm_dtype_knob(v) -> str:

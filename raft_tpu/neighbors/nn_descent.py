@@ -149,8 +149,15 @@ def _nnd_iter(state, data, norms, K: int, S: int, ip: bool, key=None):
 
 def build(params: IndexParams, dataset) -> Index:
     """Build the all-KNN graph (reference nn_descent.cuh build)."""
+    from raft_tpu import obs
+
     data = jnp.asarray(dataset).astype(jnp.float32)
     n, d = data.shape
+    with obs.entry_span("build", "nn_descent", rows=n):
+        return _build(params, data, n)
+
+
+def _build(params: IndexParams, data, n: int) -> Index:
     K = int(params.intermediate_graph_degree) or max(
         int(params.graph_degree * 3 // 2), int(params.graph_degree)
     )

@@ -23,12 +23,13 @@ out-of-core job.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
 
-from raft_tpu import resilience, tuning
+from raft_tpu import obs, resilience, tuning
 from raft_tpu.core.interruptible import Interruptible
 from raft_tpu.resilience import degrade, faultinject
 from raft_tpu.utils.batch import BatchLoadIterator, FileBatchLoadIterator
@@ -91,47 +92,59 @@ def search_stream(
     if token is None:
         token = Interruptible.get_token()
 
-    for ci, (offset, batch) in enumerate(batches):
-        rows = min(batch.shape[0], n_queries - offset)
-        if offset + rows <= rows_done:
-            continue                      # resumed past this chunk
-        if offset < rows_done:
-            raise ValueError(
-                f"resume misalignment: checkpoint covers {rows_done} rows "
-                f"but the iterator produced a batch at offset {offset}; "
-                "resume with the batch size the checkpoint was written at"
-            )
-        token.check()
+    with obs.span("stream.search_stream", stage=stage,
+                  n_queries=int(n_queries), k=int(k), resumed=rows_done):
+        for ci, (offset, batch) in enumerate(batches):
+            rows = min(batch.shape[0], n_queries - offset)
+            if offset + rows <= rows_done:
+                continue                  # resumed past this chunk
+            if offset < rows_done:
+                raise ValueError(
+                    f"resume misalignment: checkpoint covers {rows_done} "
+                    f"rows but the iterator produced a batch at offset "
+                    f"{offset}; resume with the batch size the checkpoint "
+                    "was written at"
+                )
+            token.check()
 
-        def dispatch(b, _ci=ci):
-            faultinject.check(stage=stage, chunk=_ci)
-            out = search_fn(b)
-            # sync INSIDE the retry-wrapped callable: XLA dispatch is
-            # async, so a real transient/dead-backend error surfaces at
-            # the wait — it must strike where resilience.run can retry
-            # it, not at the ladder's (OOM-only) outer sync
-            jax.block_until_ready(out)
-            return out
+            def dispatch(b, _ci=ci):
+                faultinject.check(stage=stage, chunk=_ci)
+                out = search_fn(b)
+                # sync INSIDE the retry-wrapped callable: XLA dispatch is
+                # async, so a real transient/dead-backend error surfaces at
+                # the wait — it must strike where resilience.run can retry
+                # it, not at the ladder's (OOM-only) outer sync
+                jax.block_until_ready(out)
+                return out
 
-        (d, i), survived = degrade.run_halving(
-            lambda b: resilience.run(
-                dispatch, b, retries=retries, backoff_s=backoff_s,
-                deadline_s=deadline_s, token=token,
-            ),
-            batch,
-            budget_name=STREAM_BATCH_BUDGET,
-        )
-        if survived < batch.shape[0] and hasattr(batches, "set_batch_rows"):
-            batches.set_batch_rows(survived)
-        out_d[offset:offset + rows] = np.asarray(d[:rows], np.float32)
-        out_i[offset:offset + rows] = np.asarray(i[:rows])
-        rows_done = offset + rows
-        if ck is not None and (ci + 1) % max(int(checkpoint_every), 1) == 0:
-            ck.save(
-                "search", ci, {"rows_done": rows_done},
-                {"dists": out_d[:rows_done], "ids": out_i[:rows_done]},
-                fingerprint=fingerprint,
-            )
+            t0 = time.perf_counter()
+            with obs.span("stream.chunk", chunk=ci, offset=int(offset)):
+                (d, i), survived = degrade.run_halving(
+                    lambda b: resilience.run(
+                        dispatch, b, retries=retries, backoff_s=backoff_s,
+                        deadline_s=deadline_s, token=token,
+                    ),
+                    batch,
+                    budget_name=STREAM_BATCH_BUDGET,
+                )
+            # chunk latency is DEVICE-COMPLETE (the dispatch syncs), so
+            # this histogram is the per-batch serving latency — unlike the
+            # entry-point search_latency_ms, which times async dispatch
+            obs.observe("search_latency_ms", (time.perf_counter() - t0) * 1e3,
+                        algo="stream", stage=stage)
+            obs.counter("stream_rows_total", rows, stage=stage)
+            obs.counter("stream_chunks_total", stage=stage)
+            if survived < batch.shape[0] and hasattr(batches, "set_batch_rows"):
+                batches.set_batch_rows(survived)
+            out_d[offset:offset + rows] = np.asarray(d[:rows], np.float32)
+            out_i[offset:offset + rows] = np.asarray(i[:rows])
+            rows_done = offset + rows
+            if ck is not None and (ci + 1) % max(int(checkpoint_every), 1) == 0:
+                ck.save(
+                    "search", ci, {"rows_done": rows_done},
+                    {"dists": out_d[:rows_done], "ids": out_i[:rows_done]},
+                    fingerprint=fingerprint,
+                )
     return out_d, out_i
 
 
@@ -173,12 +186,13 @@ def search_file(
         return module.search(search_params, index, batch, k,
                              **search_kwargs)
 
-    return search_stream(
-        fn, it, it.shape[0], k,
-        retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
-        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-        resume=resume, token=token,
-    )
+    with obs.span("stream.search_file", path=queries_path, k=int(k)):
+        return search_stream(
+            fn, it, it.shape[0], k,
+            retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            resume=resume, token=token,
+        )
 
 
 def search_host_array(
@@ -227,9 +241,11 @@ def search_host_array(
         return module.search(search_params, index, batch, k,
                              **search_kwargs)
 
-    return search_stream(
-        fn, it, queries.shape[0], k,
-        retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
-        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-        resume=resume, token=token,
-    )
+    with obs.span("stream.search_host_array",
+                  n_queries=int(queries.shape[0]), k=int(k)):
+        return search_stream(
+            fn, it, queries.shape[0], k,
+            retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            resume=resume, token=token,
+        )

@@ -1,0 +1,90 @@
+"""graft-scope: structured spans, metrics registry, flight recorder.
+
+The observability layer (ISSUE 4): the reference RAFT ships NVTX ranges
+and an spdlog sink; a production TPU deployment needs per-stage
+wall-clock attribution (TPU-KNN, arXiv:2206.14286 — compile vs dispatch
+vs device compute), per-stage counters (FusionANNS-style scan/rerank/
+merge breakdowns, arXiv:2409.16576), and a post-mortem trail when a job
+wedges. Three parts, all zero-dependency:
+
+* **spans** (:mod:`raft_tpu.obs.spans`) — ``obs.span(name, **attrs)``
+  context managers building a per-thread tree of host wall-clock (and
+  optional device-sync) timings, each also emitting a
+  ``jax.profiler.TraceAnnotation`` so XLA profiler captures line up;
+* **metrics** (:mod:`raft_tpu.obs.metrics`) — counters / gauges /
+  fixed-bucket histograms (``obs.counter("tuning.dispatch", op=...)``),
+  exportable as a JSON snapshot (:func:`snapshot`) or Prometheus text
+  (:func:`export_prometheus`);
+* **flight recorder** (:mod:`raft_tpu.obs.flight`) — a bounded ring of
+  recent span/metric/error events, dumped as JSONL on demand or
+  automatically on a classified fatal/dead_backend failure.
+
+Knobs: ``RAFT_TPU_OBS=off|on|flight`` (default off; the off path is a
+single module-attribute read per call site), ``RAFT_TPU_OBS_DIR`` (dump
+directory). Full metric catalog: docs/observability.md.
+"""
+
+from raft_tpu.obs.config import (
+    ENV_VAR,
+    DIR_VAR,
+    MODES,
+    mode,
+    obs_dir,
+    reload,
+    set_mode,
+)
+from raft_tpu.obs import config as _config
+from raft_tpu.obs import flight as _flight
+from raft_tpu.obs import metrics as _metrics
+from raft_tpu.obs import spans as _spans
+from raft_tpu.obs.metrics import (
+    DEFAULT_MS_BUCKETS,
+    capture_runtime_gauges,
+    counter,
+    export_prometheus,
+    gauge,
+    observe,
+    snapshot,
+)
+from raft_tpu.obs.spans import Span, current, entry_span, recent, span
+from raft_tpu.obs.flight import (
+    dump as flight_dump,
+    event,
+    events as flight_events,
+    last_dump_path,
+    on_error,
+)
+
+
+def enabled() -> bool:
+    """True when spans/metrics are live (mode ``on`` or ``flight``)."""
+    return _config.ENABLED
+
+
+def write_snapshot(path: str) -> str:
+    """Write :func:`snapshot` as JSON to ``path`` (the ``--obs-snapshot``
+    sidecar writer used by the bench harness). Returns ``path``."""
+    import json
+
+    with open(path, "w") as fp:
+        json.dump(snapshot(), fp, indent=1, default=str)
+        fp.write("\n")
+    return path
+
+
+def reset() -> None:
+    """Drop all metrics, completed span trees, and flight events
+    (tests / between bench cases). The mode is untouched."""
+    _metrics.reset()
+    _spans.reset()
+    _flight.clear()
+
+
+__all__ = [
+    "DEFAULT_MS_BUCKETS", "DIR_VAR", "ENV_VAR", "MODES", "Span",
+    "capture_runtime_gauges", "counter", "current", "enabled",
+    "entry_span", "event", "export_prometheus", "flight_dump",
+    "flight_events", "gauge", "last_dump_path", "mode", "obs_dir",
+    "observe", "on_error", "recent", "reload", "reset", "set_mode",
+    "snapshot", "span", "write_snapshot",
+]

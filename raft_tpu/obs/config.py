@@ -1,0 +1,79 @@
+"""Observability mode resolution (env ``RAFT_TPU_OBS``).
+
+Three modes:
+
+* ``off``    — (default) every obs call site returns after ONE module
+               attribute read (:data:`ENABLED`); no registry, no spans,
+               no events, no allocation that outlives the call.
+* ``on``     — spans + metrics are live; exporters
+               (:func:`raft_tpu.obs.snapshot`,
+               :func:`raft_tpu.obs.export_prometheus`) have data.
+* ``flight`` — ``on`` plus the flight recorder: span/metric/error
+               events land in a bounded ring buffer
+               (:mod:`raft_tpu.obs.flight`) and a classified
+               fatal/dead_backend failure auto-dumps it as JSONL under
+               ``RAFT_TPU_OBS_DIR`` — the post-mortem artifact.
+
+The mode is resolved ONCE at import (plus on :func:`set_mode` /
+:func:`reload`) into the module-level booleans :data:`ENABLED` and
+:data:`FLIGHT` so the disabled hot path costs a single dict lookup
+(a module attribute read), not an ``os.environ`` hit per call.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "RAFT_TPU_OBS"
+DIR_VAR = "RAFT_TPU_OBS_DIR"
+
+MODES = ("off", "on", "flight")
+
+# hot-path flags — read these, never os.environ, at call sites
+ENABLED: bool = False
+FLIGHT: bool = False
+
+_mode: str = "off"
+_override: Optional[str] = None
+
+
+def _refresh() -> None:
+    global ENABLED, FLIGHT, _mode
+    if _override is not None:
+        m = _override
+    else:
+        m = os.environ.get(ENV_VAR, "off").strip().lower()
+        if m not in MODES:
+            m = "off"
+    _mode = m
+    ENABLED = m != "off"
+    FLIGHT = m == "flight"
+
+
+def mode() -> str:
+    """The active obs mode: ``off`` | ``on`` | ``flight``."""
+    return _mode
+
+
+def set_mode(m: Optional[str]) -> None:
+    """Override the env knob in-process (``None`` restores env control)."""
+    global _override
+    if m is not None and m not in MODES:
+        raise ValueError(f"mode must be one of {MODES}, got {m!r}")
+    _override = m
+    _refresh()
+
+
+def reload() -> None:
+    """Re-read ``RAFT_TPU_OBS`` (after an env change mid-process)."""
+    _refresh()
+
+
+def obs_dir() -> str:
+    """Dump directory for flight-recorder artifacts
+    (``RAFT_TPU_OBS_DIR``, default: the working directory)."""
+    return os.environ.get(DIR_VAR, "").strip() or "."
+
+
+_refresh()

@@ -1,0 +1,311 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+The process-wide registry behind ``obs.counter/gauge/observe``. Metrics
+are named like ``queries_total`` / ``tuning.dispatch`` and carry flat
+string labels; a (name, sorted-labels) pair is one time series. Export
+as a JSON-able snapshot (:func:`snapshot`) or Prometheus text
+exposition format (:func:`export_prometheus`).
+
+Design constraints (ISSUE 4):
+
+* zero dependencies — dict + lock, no client library;
+* the ``RAFT_TPU_OBS=off`` path is a single module-attribute read per
+  call site (:data:`raft_tpu.obs.config.ENABLED`), touching neither the
+  registry nor the lock;
+* fixed buckets — histograms never rebucket, so concurrent observers
+  only ever add into preallocated slots.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from raft_tpu.obs import config
+
+# value <= edge lands in that bucket (Prometheus ``le`` semantics);
+# the implicit +Inf bucket is always last. Spans ms-scale dispatch up
+# to minute-scale builds.
+DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
+    0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 2500, 5000, 10000, 30000, 60000,
+)
+
+_COUNTER = "counter"
+_GAUGE = "gauge"
+_HISTOGRAM = "histogram"
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class _Metric:
+    __slots__ = ("name", "kind", "buckets", "points")
+
+    def __init__(self, name: str, kind: str,
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.kind = kind
+        self.buckets = buckets
+        # counter/gauge: labelkey -> float
+        # histogram:     labelkey -> [per-bucket counts (+Inf last), sum, n]
+        self.points: Dict[_LabelKey, object] = {}
+
+
+_lock = threading.RLock()
+_registry: Dict[str, _Metric] = {}
+# GL007 hook state: last-seen jit cache sizes per tracked function
+_compile_last: Dict[str, int] = {}
+
+
+def _label_key(labels: Dict[str, object]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _get_metric(name: str, kind: str,
+                buckets: Optional[Tuple[float, ...]]) -> _Metric:
+    m = _registry.get(name)
+    if m is None:
+        m = _Metric(name, kind, buckets)
+        _registry[name] = m
+    elif m.kind != kind:
+        raise ValueError(
+            f"metric {name!r} already registered as {m.kind}, not {kind}")
+    return m
+
+
+def _flight_event(name: str, value: float, labels: Dict[str, object]) -> None:
+    if not config.FLIGHT:
+        return
+    from raft_tpu.obs import flight
+
+    flight.record("metric", name=name, value=value,
+                  labels={str(k): str(v) for k, v in labels.items()})
+
+
+def counter(name: str, value: float = 1.0, /, **labels) -> None:
+    """Add ``value`` (default 1) to counter ``name`` at ``labels``."""
+    if not config.ENABLED:
+        return
+    with _lock:
+        m = _get_metric(name, _COUNTER, None)
+        key = _label_key(labels)
+        m.points[key] = float(m.points.get(key, 0.0)) + float(value)
+    _flight_event(name, float(value), labels)
+
+
+def gauge(name: str, value: float, /, **labels) -> None:
+    """Set gauge ``name`` at ``labels`` to ``value``."""
+    if not config.ENABLED:
+        return
+    with _lock:
+        m = _get_metric(name, _GAUGE, None)
+        m.points[_label_key(labels)] = float(value)
+    _flight_event(name, float(value), labels)
+
+
+def observe(name: str, value: float, /,
+            buckets: Optional[Tuple[float, ...]] = None, **labels) -> None:
+    """Record ``value`` into histogram ``name`` at ``labels``.
+
+    ``buckets`` (ascending upper edges, +Inf implicit) is fixed at the
+    histogram's FIRST observation; later calls inherit it.
+    """
+    if not config.ENABLED:
+        return
+    value = float(value)
+    with _lock:
+        m = _get_metric(name, _HISTOGRAM,
+                        tuple(buckets) if buckets else DEFAULT_MS_BUCKETS)
+        key = _label_key(labels)
+        point = m.points.get(key)
+        if point is None:
+            point = [[0] * (len(m.buckets) + 1), 0.0, 0]
+            m.points[key] = point
+        counts, _, _ = point
+        for i, edge in enumerate(m.buckets):
+            if value <= edge:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+        point[1] += value
+        point[2] += 1
+    _flight_event(name, value, labels)
+
+
+def reset() -> None:
+    """Drop every registered series (tests / between bench cases)."""
+    with _lock:
+        _registry.clear()
+        _compile_last.clear()
+
+
+# ---------------------------------------------------------------------------
+# runtime gauges: device memory + the GL007 recompile hook
+# ---------------------------------------------------------------------------
+
+# the jitted hot-path functions whose trace-cache growth means
+# steady-state recompilation (the GL007 class; the jaxpr auditor sweeps
+# the same caches) — (module, attribute) pairs resolved lazily
+_TRACKED_JITS = (
+    ("raft_tpu.matrix.select_k", "_select_k"),
+    ("raft_tpu.matrix.select_k", "_tournament_topk"),
+)
+
+
+def capture_runtime_gauges() -> None:
+    """Record point-in-time runtime gauges:
+
+    * ``device_memory_bytes{device,stat}`` from each local device's
+      ``memory_stats()`` (absent on CPU — skipped silently);
+    * ``jit_cache_entries{fn}`` for the tracked hot-path jits, plus a
+      ``recompiles{fn}`` counter incremented by any growth since the
+      previous capture (the in-process GL007 trace-counting hook:
+      steady-state serving must keep this counter flat).
+
+    Called automatically by :func:`snapshot`; safe no-op when obs is off
+    or the runtime refuses to answer.
+    """
+    if not config.ENABLED:
+        return
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if not ms:
+                continue
+            for stat, v in ms.items():
+                if isinstance(v, (int, float)):
+                    gauge("device_memory_bytes", float(v),
+                          device=d.id, stat=stat)
+    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow metrics capture must never fail the caller; a mute backend just yields no gauges
+        pass
+    import importlib
+
+    for mod_name, fn_name in _TRACKED_JITS:
+        try:
+            fn = getattr(importlib.import_module(mod_name), fn_name, None)
+        except ImportError:
+            continue
+        size_of = getattr(fn, "_cache_size", None)
+        if size_of is None:
+            continue
+        try:
+            n = int(size_of())
+        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow private jax API probe; absence of the gauge is the degraded answer
+            continue
+        label = f"{mod_name.rsplit('.', 1)[-1]}.{fn_name}"
+        gauge("jit_cache_entries", float(n), fn=label)
+        with _lock:
+            prev = _compile_last.get(label)
+            _compile_last[label] = n
+        if prev is not None and n > prev:
+            counter("recompiles", n - prev, fn=label)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def snapshot(runtime_gauges: bool = True) -> dict:
+    """A JSON-able snapshot of every registered series.
+
+    Shape::
+
+        {"mode": "on", "time_unix": ...,
+         "metrics": {name: {"kind": ..., "points": [
+             {"labels": {...}, "value": v}                    # counter/gauge
+             {"labels": {...}, "buckets": [...], "bucket_counts": [...],
+              "sum": s, "count": n}                           # histogram
+         ]}}}
+    """
+    if runtime_gauges:
+        capture_runtime_gauges()
+    out: dict = {"mode": config.mode(), "time_unix": time.time(),
+                 "metrics": {}}
+    with _lock:
+        for name in sorted(_registry):
+            m = _registry[name]
+            points: List[dict] = []
+            for key in sorted(m.points):
+                labels = dict(key)
+                if m.kind == _HISTOGRAM:
+                    counts, total, n = m.points[key]
+                    points.append({
+                        "labels": labels,
+                        "buckets": list(m.buckets),
+                        "bucket_counts": list(counts),
+                        "sum": total,
+                        "count": n,
+                    })
+                else:
+                    points.append({"labels": labels,
+                                   "value": m.points[key]})
+            out["metrics"][name] = {"kind": m.kind, "points": points}
+    return out
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, kind: str) -> str:
+    base = _NAME_RE.sub("_", name)
+    if not base.startswith("raft_tpu_"):
+        base = "raft_tpu_" + base
+    if kind == _COUNTER and not base.endswith("_total"):
+        base += "_total"
+    return base
+
+
+def _prom_labels(labels: _LabelKey, extra: str = "") -> str:
+    parts = [
+        '%s="%s"' % (_NAME_RE.sub("_", k),
+                     v.replace("\\", r"\\").replace('"', r'\"')
+                      .replace("\n", r"\n"))
+        for k, v in labels
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def export_prometheus() -> str:
+    """The registry in Prometheus text exposition format (0.0.4): one
+    ``# TYPE`` line per metric, cumulative ``le`` buckets + ``_sum`` /
+    ``_count`` for histograms. Serve it from any HTTP handler (or write
+    it to a textfile-collector drop) to scrape a long-running job."""
+    lines: List[str] = []
+    with _lock:
+        for name in sorted(_registry):
+            m = _registry[name]
+            pname = _prom_name(name, m.kind)
+            lines.append(f"# TYPE {pname} {m.kind}")
+            for key in sorted(m.points):
+                if m.kind == _HISTOGRAM:
+                    counts, total, n = m.points[key]
+                    cum = 0
+                    for edge, c in zip(m.buckets, counts):
+                        cum += c
+                        le = 'le="%s"' % _fmt(edge)
+                        lines.append(
+                            f"{pname}_bucket{_prom_labels(key, le)} {cum}")
+                    cum += counts[-1]
+                    le = 'le="+Inf"'
+                    lines.append(
+                        f"{pname}_bucket{_prom_labels(key, le)} {cum}")
+                    lines.append(f"{pname}_sum{_prom_labels(key)}"
+                                 f" {_fmt(total)}")
+                    lines.append(f"{pname}_count{_prom_labels(key)} {n}")
+                else:
+                    lines.append(f"{pname}{_prom_labels(key)}"
+                                 f" {_fmt(m.points[key])}")
+    return "\n".join(lines) + ("\n" if lines else "")

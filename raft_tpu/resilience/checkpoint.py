@@ -99,6 +99,10 @@ class StreamCheckpoint:
         with open(tmp_man, "w") as f:
             json.dump(manifest, f, indent=1)
         os.replace(tmp_man, self.manifest_path)
+        from raft_tpu import obs
+
+        obs.counter("checkpoint_saves", phase=phase)
+        obs.event("checkpoint_save", phase=phase, step=int(step))
         # older blobs are garbage once the manifest points past them
         for name in os.listdir(self.dir):
             if name.startswith("state-") and name.endswith(".bin") \
@@ -151,6 +155,11 @@ class StreamCheckpoint:
         _, blob_meta, arrays = serialize.read_index_file(blob, _KIND)
         if blob_meta.get("step") != manifest["step"]:
             return None     # blob/manifest disagree; treat as absent
+        from raft_tpu import obs
+
+        obs.counter("checkpoint_resumes", phase=manifest["phase"])
+        obs.event("checkpoint_resume", phase=manifest["phase"],
+                  step=int(manifest["step"]))
         return (manifest["phase"], int(manifest["step"]),
                 manifest["meta"], arrays)
 

@@ -53,6 +53,10 @@ def run_halving(
         if errors.classify(e) != errors.OOM or rows <= min_rows:
             raise
     half = rows // 2
+    from raft_tpu import obs
+
+    obs.counter("oom_ladder_downshifts", path="halving")
+    obs.event("oom_downshift", path="halving", rows=rows, half=half)
     r1, s1 = run_halving(fn, batch[:half], min_rows=min_rows,
                          budget_name=None)
     r2, s2 = run_halving(fn, batch[half:], min_rows=min_rows,
@@ -102,6 +106,11 @@ def run_shrinking_blocks(
                 raise
             half = max(rows // 2, min_rows)
             limit = half
+            from raft_tpu import obs
+
+            obs.counter("oom_ladder_downshifts", path="blocks", stage=stage)
+            obs.event("oom_downshift", path="blocks", stage=stage,
+                      rows=rows, half=half)
             if rows >= block:
                 # a FULL block failed: the learned size shrinks for good
                 # (a short tail failing must not poison the process-wide

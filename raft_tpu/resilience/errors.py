@@ -103,7 +103,21 @@ def classify(exc: BaseException) -> str:
     own typed errors short-circuit; anything else is classified from its
     message text, defaulting to ``fatal`` (never silently retry an
     unknown failure).
+
+    Every classification is reported to graft-scope
+    (:func:`raft_tpu.obs.on_error`): ``errors_total{kind}`` counts it,
+    the flight recorder logs it, and — in flight mode — a fatal or
+    dead_backend verdict auto-dumps the ring as the post-mortem
+    artifact. No-op with ``RAFT_TPU_OBS=off``.
     """
+    kind = _classify(exc)
+    from raft_tpu import obs
+
+    obs.on_error(kind, exc)
+    return kind
+
+
+def _classify(exc: BaseException) -> str:
     kind = getattr(exc, "fault_kind", None)
     if kind in KINDS:
         return kind
@@ -234,6 +248,11 @@ def run(
                     f"backend did not come back within {probe_timeout_s}s "
                     f"after: {e}"
                 ) from e
+            from raft_tpu import obs
+
+            obs.counter("retries", kind=kind)
+            obs.event("retry", attempt=attempt, error_kind=kind,
+                      error=str(e)[:200], backoff_s=sleep)
             if on_retry is not None:
                 on_retry(attempt, kind, e)
             time.sleep(sleep)

@@ -200,6 +200,11 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
                 continue
             s.remaining -= 1
             cls, msg = _EXC[s.kind]
+            from raft_tpu import obs
+
+            obs.counter("faults_injected", kind=s.kind, stage=stage)
+            obs.event("fault_injected", spec=f"{s.kind}@{s.scope}:{s.arg}",
+                      stage=stage, chunk=chunk)
             raise cls(f"{msg} ({s.kind}@{s.scope}:{s.arg} at "
                       f"stage={stage!r} chunk={chunk})")
 

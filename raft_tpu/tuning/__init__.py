@@ -178,13 +178,19 @@ def choose(op: str, key: Dict, candidates: List[str],
     must be static python scalars (shapes at trace time are), so a
     choice is a pure trace-time decision.
     """
+    from raft_tpu import obs
+
     m = mode()
     if m == "off" or not candidates:
+        obs.counter("tuning.dispatch", op=op, impl=str(fallback),
+                    source="off" if m == "off" else "no_candidates")
         return fallback
     t = get_table()
     if t is not None:
         w = t.lookup(op, key, candidates)
         if w in candidates:
+            obs.counter("tuning.dispatch", op=op, impl=str(w),
+                        source="table")
             return w
     # only genuinely UNCOVERED keys get measured in measure mode — a
     # persisted measurement always wins over an ad-hoc in-process one
@@ -192,7 +198,11 @@ def choose(op: str, key: Dict, candidates: List[str],
             and not _tracing()):
         w = _measure_inline(op, key, candidates)
         if w in candidates:
+            obs.counter("tuning.dispatch", op=op, impl=str(w),
+                        source="measured")
             return w
+    obs.counter("tuning.dispatch", op=op, impl=str(fallback),
+                source="fallback")
     return fallback
 
 
@@ -209,6 +219,11 @@ def record_budget(name: str, value: int) -> None:
     with _lock:
         prior = _runtime_budgets.get(name)
         _runtime_budgets[name] = v if prior is None else min(prior, v)
+        recorded = _runtime_budgets[name]
+    from raft_tpu import obs
+
+    obs.gauge("runtime_budget", recorded, budget=name)
+    obs.event("budget_record", budget=name, value=v, effective=recorded)
 
 
 def runtime_budget(name: str) -> Optional[int]:

@@ -15,7 +15,14 @@ Stages (artifact, rough budget):
   7. dispatch_tables  — raft_tpu/tuning/tables/tpu.json (~15 min)
 
 Run: python scripts/r5_measure_all.py [--only stage1,stage2] [--skip ...]
+                                      [--obs-snapshot]
 Progress + per-stage rc stream to stdout and R5_MEASURE_STATUS.json.
+
+--obs-snapshot runs every stage instrumented (RAFT_TPU_OBS=flight in the
+child env, flight dumps under OBS_r05/) and asks bench.py for its
+BENCH_r05_local.obs.json metrics sidecar — each artifact then carries
+the dispatch winners, latency histograms, and retry/ladder counters that
+explain it (docs/observability.md).
 """
 
 import json
@@ -59,8 +66,17 @@ def main():
         only = set(sys.argv[sys.argv.index("--only") + 1].split(","))
     if "--skip" in sys.argv:
         skip = set(sys.argv[sys.argv.index("--skip") + 1].split(","))
+    obs_on = "--obs-snapshot" in sys.argv
+    child_env = None
+    if obs_on:
+        # children self-instrument in flight mode: a stage that dies with
+        # a classified fatal/dead_backend leaves its flight JSONL under
+        # OBS_r05/ even when its artifact never materialized
+        child_env = dict(os.environ,
+                         RAFT_TPU_OBS="flight",
+                         RAFT_TPU_OBS_DIR=os.path.join(ROOT, "OBS_r05"))
     status = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-              "stages": {}}
+              "stages": {}, "obs": bool(obs_on)}
 
     def flush():
         with open(os.path.join(ROOT, "R5_MEASURE_STATUS.json"), "w") as f:
@@ -80,7 +96,11 @@ def main():
         if skip is not None and name in skip:
             continue
         t0 = time.time()
-        print(f"=== {name}: {' '.join(argv)} (timeout {tmo}s)", flush=True)
+        stage_argv = list(argv)
+        if obs_on and argv[1] == "bench.py":
+            stage_argv += ["--obs-snapshot", "BENCH_r05_local.obs.json"]
+        print(f"=== {name}: {' '.join(stage_argv)} (timeout {tmo}s)",
+              flush=True)
 
         # resilience wrap: the subprocess timeout is the HARD per-stage
         # bound (a wedged stage cannot eat the battery); resilience.run
@@ -88,8 +108,8 @@ def main():
         # a per-stage wall-clock deadline, so a blip (UNAVAILABLE,
         # connection reset) costs one rerun instead of the stage
         def _attempt():
-            r = subprocess.run(argv, timeout=tmo, cwd=ROOT,
-                               capture_output=True)
+            r = subprocess.run(stage_argv, timeout=tmo, cwd=ROOT,
+                               capture_output=True, env=child_env)
             if r.returncode != 0:
                 tail = (r.stdout + r.stderr).decode(errors="replace")[-4000:]
                 if resilience.classify_text(tail) == resilience.TRANSIENT:

@@ -395,6 +395,76 @@ def test_gl008_suppressed_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# GL009 unspanned-entry (path-scoped: neighbors/ modules only)
+# ---------------------------------------------------------------------------
+
+
+def _neighbors_rules(src):
+    findings = lint_source(textwrap.dedent(src),
+                           "raft_tpu/neighbors/fixture.py")
+    return [f.rule for f in findings if not f.suppressed]
+
+
+def test_gl009_unspanned_search_positive():
+    rules = _neighbors_rules("""
+        def search(params, index, queries, k):
+            return index.scan(queries, k)
+    """)
+    assert "GL009" in rules
+
+
+def test_gl009_unspanned_build_positive():
+    rules = _neighbors_rules("""
+        def build_streamed(params, batches):
+            return encode(params, batches)
+    """)
+    assert "GL009" in rules
+
+
+def test_gl009_entry_span_negative():
+    rules = _neighbors_rules("""
+        from raft_tpu import obs
+
+        def search(params, index, queries, k):
+            with obs.entry_span("search", "demo", queries=len(queries)):
+                return index.scan(queries, k)
+
+        def build(params, dataset):
+            with obs.span("demo.build"):
+                return pack(dataset)
+    """)
+    assert "GL009" not in rules
+
+
+def test_gl009_private_and_other_names_exempt():
+    rules = _neighbors_rules("""
+        def _search_impl(q):
+            return q
+
+        def refine(dataset, queries):
+            return dataset
+    """)
+    assert "GL009" not in rules
+
+
+def test_gl009_outside_neighbors_exempt():
+    findings = lint_source(textwrap.dedent("""
+        def search(q):
+            return q
+    """), "raft_tpu/matrix/fixture.py")
+    assert "GL009" not in [f.rule for f in findings]
+
+
+def test_gl009_suppressed_with_reason():
+    rules = _neighbors_rules("""
+        # graft-lint: allow-unspanned-entry pure parameter arithmetic
+        def search_plan(params, k):
+            return k * 2
+    """)
+    assert "GL009" not in rules
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
