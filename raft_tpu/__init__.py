@@ -23,6 +23,8 @@ Layer map (mirrors the reference's cpp/include/raft/<layer> — SURVEY.md §1):
     ops        Pallas TPU kernels for the hot paths
     bench      ANN benchmark harness (raft-ann-bench analog)
     obs        graft-scope: spans, metrics registry, flight recorder
+    serve      graft-serve: online serving engine — micro-batching,
+               versioned index hot-swap, tombstone mutation
 """
 
 __version__ = "0.1.0"

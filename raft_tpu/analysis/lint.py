@@ -616,21 +616,49 @@ class FileLinter:
 
     # -- GL009 unspanned entry points --------------------------------------
 
+    # public serving-surface method/function prefixes that count as entry
+    # points in serve/ modules (docs/serving.md): the request path, the
+    # mutation path, and the swap/warmup control plane
+    _SERVE_ENTRY_PREFIXES = (
+        "search", "build", "submit", "publish", "delete", "upsert",
+        "compact", "swap", "warmup", "create_index", "add_index",
+        "load_index",
+    )
+
     def _check_unspanned_entries(self) -> None:
         """Public module-level ``search*``/``build*`` functions in
-        ``neighbors/`` modules must open a graft-scope span
+        ``neighbors/`` modules — and, in ``serve/`` modules, public
+        functions AND class methods on the serving surface
+        (:data:`_SERVE_ENTRY_PREFIXES`) — must open a graft-scope span
         (``obs.span`` / ``obs.entry_span`` — any call whose final dotted
         component ends in ``span`` counts): an unobserved entry point is
         a hole in the latency/count coverage docs/observability.md
         documents. Param-computation helpers suppress with a reason."""
-        if "neighbors" not in Path(self.path).parts:
+        parts = Path(self.path).parts
+        in_serve = "serve" in parts
+        if "neighbors" not in parts and not in_serve:
             return
-        for node in self.tree.body:
-            if not isinstance(node, ast.FunctionDef):
-                continue
+        prefixes = self._SERVE_ENTRY_PREFIXES if in_serve \
+            else ("search", "build")
+        candidates = [n for n in self.tree.body
+                      if isinstance(n, ast.FunctionDef)]
+        if in_serve:
+            # the serving surface is method-shaped (Server.submit,
+            # Registry.publish, ...); neighbors/ stays module-function-only
+            for cls in self.tree.body:
+                if isinstance(cls, ast.ClassDef) \
+                        and not cls.name.startswith("_"):
+                    candidates.extend(
+                        n for n in cls.body
+                        if isinstance(n, ast.FunctionDef))
+        for node in candidates:
             name = node.name
-            if name.startswith("_") or not name.startswith(("search",
-                                                            "build")):
+            if name.startswith("_"):
+                continue
+            # word-boundary prefix match: "deleted_rows" is an accounting
+            # getter, not the "delete" entry point
+            if not any(name == p or name.startswith(p + "_")
+                       for p in prefixes):
                 continue
             has_span = any(
                 isinstance(sub, ast.Call)

@@ -111,12 +111,15 @@ register_rule(
 )
 register_rule(
     "GL009", "unspanned-entry",
-    "public neighbors search/build entry point without an obs.span",
+    "public neighbors search/build — or serve/ serving-surface — entry "
+    "point without an obs.span",
     "graft-scope (docs/observability.md) is only as complete as its "
-    "coverage: a public search/build path that opens no span produces "
-    "latency and query counts attributed to nobody, which is exactly the "
-    "blind spot the reference's NVTX-everywhere convention prevents; open "
-    "an obs.span/obs.entry_span or suppress with a reason",
+    "coverage: a public search/build path (or a serve/ submit/publish/"
+    "delete/upsert/compact/swap surface, where per-request latency IS the "
+    "product — docs/serving.md) that opens no span produces latency and "
+    "query counts attributed to nobody, which is exactly the blind spot "
+    "the reference's NVTX-everywhere convention prevents; open an "
+    "obs.span/obs.entry_span or suppress with a reason",
 )
 register_rule(
     "GL005", "undated-perf",

@@ -25,6 +25,9 @@ class Bitset:
 
     def __init__(self, n_bits: int, bits: jax.Array | None = None, default: bool = True):
         self.n_bits = int(n_bits)
+        # bumped by every in-place mutator (set/flip/resize) so caches
+        # keyed on wrapper identity can detect content changes
+        self._version = 0
         n_words = (self.n_bits + self.WORD_BITS - 1) // self.WORD_BITS
         if bits is not None:
             assert bits.shape == (n_words,)
@@ -72,25 +75,75 @@ class Bitset:
 
     def set(self, idx: jax.Array, value: bool = True) -> "Bitset":
         self.bits = Bitset.set_bits(self.bits, jnp.asarray(idx), value)
+        self._version += 1
         return self
 
     def flip(self) -> "Bitset":
         self.bits = ~self.bits
+        self._version += 1
         return self
 
-    def count(self) -> jax.Array:
-        """Number of set bits (masking tail bits of the last word)."""
-        valid = self.n_bits
-        word_ids = jnp.arange(self.bits.shape[0]) * self.WORD_BITS
+    @staticmethod
+    def count_bits(bits: jax.Array, n_bits: int) -> jax.Array:
+        """Functional set-bit count over raw words (tail bits of the last
+        word beyond ``n_bits`` are masked out). Jit-safe for static
+        ``n_bits`` — the helper behind :meth:`count` and the serving
+        layer's tombstone/live-row accounting."""
+        word_ids = jnp.arange(bits.shape[0]) * Bitset.WORD_BITS
         # bits valid in each word
-        nvalid = jnp.clip(valid - word_ids, 0, self.WORD_BITS)
+        nvalid = jnp.clip(n_bits - word_ids, 0, Bitset.WORD_BITS)
         tail_mask = jnp.where(
             nvalid >= 32,
             jnp.uint32(0xFFFFFFFF),
             (jnp.uint32(1) << nvalid.astype(jnp.uint32)) - jnp.uint32(1),
         )
-        masked = self.bits & tail_mask
-        return _popcount(masked).sum()
+        return _popcount(bits & tail_mask).sum()
+
+    def count(self) -> jax.Array:
+        """Number of set bits (masking tail bits of the last word)."""
+        return Bitset.count_bits(self.bits, self.n_bits)
+
+    def copy(self) -> "Bitset":
+        """An independent wrapper over the same (immutable) word array —
+        later ``set``/``resize`` on either side cannot alias."""
+        return Bitset(self.n_bits, bits=self.bits)
+
+    def resize(self, n_bits: int, default: bool = True) -> "Bitset":
+        """Grow (or shrink) to ``n_bits`` in place; new bits get ``default``.
+
+        The tombstone-growth primitive (ISSUE 5): an index ``extend``
+        appends rows whose ids exceed the filter built before it, and a
+        tombstone keep-mask must default those NEW ids to *kept* —
+        ``resize(new_n)`` does the word-array surgery (tail-bit fill of
+        the old last word + appended fill words) that callers previously
+        hand-rolled. Shrinking truncates. Returns ``self``.
+        """
+        n_bits = int(n_bits)
+        old_n = self.n_bits
+        if n_bits == old_n:
+            return self
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        n_words = (n_bits + self.WORD_BITS - 1) // self.WORD_BITS
+        bits = self.bits
+        if n_bits > old_n:
+            tail = old_n % self.WORD_BITS
+            if tail:
+                # bits [tail, 32) of the old last word are undefined
+                # (constructor fill / from_dense zero-pad): force `default`
+                li = old_n // self.WORD_BITS
+                mask = (jnp.uint32(1) << jnp.uint32(tail)) - jnp.uint32(1)
+                bits = bits.at[li].set((bits[li] & mask) | (fill & ~mask))
+            if n_words > bits.shape[0]:
+                bits = jnp.concatenate(
+                    [bits, jnp.full((n_words - bits.shape[0],), fill,
+                                    dtype=jnp.uint32)]
+                )
+        else:
+            bits = bits[:n_words]
+        self.bits = bits
+        self.n_bits = n_bits
+        self._version += 1
+        return self
 
     def to_dense(self) -> jax.Array:
         """Bool vector of length n_bits."""

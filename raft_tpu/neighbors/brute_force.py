@@ -27,7 +27,12 @@ from raft_tpu import obs
 from raft_tpu.core.serialize import read_index_file, write_index_file
 from raft_tpu.distance.pairwise import _block_distance, _EXPANDED, _expanded_path
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
-from raft_tpu.neighbors.common import as_filter, merge_topk, sentinel_for
+from raft_tpu.neighbors.common import (
+    as_filter,
+    filter_keep,
+    merge_topk,
+    sentinel_for,
+)
 from raft_tpu.utils.math import round_up_to_multiple
 from raft_tpu.utils.precision import dist_dot
 
@@ -99,6 +104,11 @@ def search(
                         queries=int(queries.shape[0]), k=int(k), fast=fast):
         filt = as_filter(prefilter)
         filter_bits = getattr(filt, "bitset", None)
+        # out-of-range semantics (docs/serving.md §5): ids >= filter_nbits
+        # are padding columns OR rows appended after the filter was built;
+        # "drop" (default) rejects them, "keep" (tombstone keep-masks)
+        # accepts them
+        oor = getattr(filt, "out_of_range", "drop")
         if tile_n is None:
             budget = (128 * 1024 * 1024) // 4
             tile_n = min(n, max(1024, budget // max(queries.shape[0], 1)))
@@ -124,6 +134,7 @@ def search(
                 int(index.metric),
                 float(index.metric_arg),
                 int(min(tile_n, n)),
+                oor,
             )
             # candidates at the sentinel distance are padding or
             # prefiltered-out rows; mark them invalid so refine (which runs
@@ -142,11 +153,13 @@ def search(
             int(index.metric),
             float(index.metric_arg),
             int(min(tile_n, n)),
+            oor,
         )
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8))
-def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p, tile_n):
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))
+def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p, tile_n,
+            out_of_range="drop"):
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
     if queries.dtype == jnp.bfloat16:
@@ -164,7 +177,8 @@ def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p
     if tile_n >= n:
         dists = _dist_block(q, dataset.astype(mm), metric, p, norms).astype(acc)
         if filter_bits is not None:
-            dists = _apply_filter(dists, jnp.arange(n)[None, :], filter_bits, filter_nbits, sentinel)
+            dists = _apply_filter(dists, jnp.arange(n)[None, :], filter_bits,
+                                  filter_nbits, sentinel, out_of_range)
         return merge_topk(dists, jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (m, n)), k, select_min)
 
     npad = round_up_to_multiple(n, tile_n)
@@ -185,7 +199,8 @@ def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p
         col = (t * tile_n + jnp.arange(tile_n, dtype=jnp.int32))[None, :]
         dists = jnp.where(col < n, dists, sentinel)
         if filter_bits is not None:
-            dists = _apply_filter(dists, col, filter_bits, filter_nbits, sentinel)
+            dists = _apply_filter(dists, col, filter_bits, filter_nbits,
+                                  sentinel, out_of_range)
         cand_d = jnp.concatenate([best_d, dists], axis=1)
         cand_i = jnp.concatenate([best_i, jnp.broadcast_to(col, (m, tile_n))], axis=1)
         return merge_topk(cand_d, cand_i, k, select_min), None
@@ -213,12 +228,20 @@ def _dist_block(q, db_tile, metric: DistanceType, p: float, db_norms) -> jax.Arr
     return _block_distance(q, db_tile, metric, p)
 
 
-def _apply_filter(dists, col, filter_bits, filter_nbits, sentinel):
-    from raft_tpu.core.bitset import Bitset
+def _apply_filter(dists, col, filter_bits, filter_nbits, sentinel,
+                  out_of_range="drop"):
+    """Mask filtered-out columns to the sentinel distance.
 
+    ``out_of_range`` (static) decides ids ``>= filter_nbits``: the old
+    behavior silently dropped them, which is wrong for tombstone
+    keep-masks over an index extended after the filter was built (new
+    rows were never deleted ⇒ must stay eligible) — those pass
+    ``"keep"``. Note the scan body masks padding columns (``col >= n``)
+    to the sentinel BEFORE this runs, so "keep" cannot resurrect pad
+    rows."""
     ids = jnp.broadcast_to(col, dists.shape)
-    safe = jnp.clip(ids, 0, filter_nbits - 1)
-    keep = Bitset.test_bits(filter_bits, safe) & (ids < filter_nbits)
+    keep = filter_keep(filter_bits, filter_nbits, ids,
+                       out_of_range=out_of_range)
     return jnp.where(keep, dists, sentinel)
 
 

@@ -1093,13 +1093,16 @@ def search(
     extremely dense filters (>99%) raise ``itopk_size`` /
     ``max_iterations`` so unfiltered traversal explores far enough to
     touch the sparse allowed set."""
-    from raft_tpu.neighbors.common import as_filter
+    from raft_tpu.neighbors.common import as_filter, resolve_filter_bits
 
     queries = jnp.asarray(queries)
     with obs.entry_span("search", "cagra", queries=int(queries.shape[0]),
                         k=int(k)) as _sp:
         filt = as_filter(prefilter)
-        bits = getattr(filt, "bitset", None)
+        # materializes "keep"-mode tombstone filters (new node ids past
+        # the filter default to kept) for the drop-semantics penalty/
+        # side-accumulation masks — docs/serving.md §5
+        bits = resolve_filter_bits(filt, int(index.dataset.shape[0]))
         fbits = None if bits is None else bits.bits
         fnbits = 0 if bits is None else int(bits.n_bits)
         itopk, width, iters, n_seeds = search_plan(search_params, k)

@@ -47,16 +47,36 @@ class NoneSampleFilter:
         return jnp.ones(sample_ids.shape, jnp.bool_)
 
 
-class BitsetFilter:
-    """Keep samples whose bit is set (reference bitset_filter)."""
+#: valid ``out_of_range`` modes for bitset filters (docs/serving.md §5):
+#: ``"drop"`` — a sample id beyond the filter's n_bits is rejected (the
+#: historical behavior; right for allow-lists, where absence means
+#: not-allowed); ``"keep"`` — an out-of-range id is accepted (right for
+#: tombstone/deny-derived keep-masks over an index that was *extended*
+#: after the filter was built: new rows were never deleted, so they
+#: must default to kept).
+OUT_OF_RANGE_MODES = ("drop", "keep")
 
-    def __init__(self, bitset: Bitset):
+
+class BitsetFilter:
+    """Keep samples whose bit is set (reference bitset_filter).
+
+    ``out_of_range`` picks the fate of sample ids ``>= bitset.n_bits``
+    (see :data:`OUT_OF_RANGE_MODES`). Negative ids (the library-wide
+    no-neighbor padding) are always rejected in either mode.
+    """
+
+    def __init__(self, bitset: Bitset, out_of_range: str = "drop"):
+        if out_of_range not in OUT_OF_RANGE_MODES:
+            raise ValueError(
+                f"out_of_range must be one of {OUT_OF_RANGE_MODES}, "
+                f"got {out_of_range!r}"
+            )
         self.bitset = bitset
+        self.out_of_range = out_of_range
 
     def mask(self, sample_ids: jax.Array) -> jax.Array:
-        safe = jnp.clip(sample_ids, 0, self.bitset.n_bits - 1)
-        ok = Bitset.test_bits(self.bitset.bits, safe)
-        return ok & (sample_ids >= 0) & (sample_ids < self.bitset.n_bits)
+        return filter_keep(self.bitset.bits, self.bitset.n_bits,
+                           sample_ids, out_of_range=self.out_of_range)
 
 
 def as_filter(f) -> NoneSampleFilter | BitsetFilter:
@@ -67,18 +87,69 @@ def as_filter(f) -> NoneSampleFilter | BitsetFilter:
     return f
 
 
-def filter_keep(filter_bits, filter_nbits: int, sample_ids):
-    """Jit-safe keep-mask for a raw bitset: True where the sample id is in
-    range and its bit is set. The single implementation behind BitsetFilter
-    and the IVF scan kernels."""
+def filter_keep(filter_bits, filter_nbits: int, sample_ids,
+                out_of_range: str = "drop"):
+    """Jit-safe keep-mask for a raw bitset: True where the sample id's bit
+    is set. The single implementation behind BitsetFilter and the IVF scan
+    kernels. ``out_of_range`` (static) decides ids ``>= filter_nbits``:
+    ``"drop"`` rejects them (allow-list semantics), ``"keep"`` accepts
+    them (tombstone semantics over an extended index — new rows were
+    never deleted). Negative ids are always rejected."""
     import jax.numpy as _jnp
 
     safe = _jnp.clip(sample_ids, 0, filter_nbits - 1)
-    return (
-        Bitset.test_bits(filter_bits, safe)
-        & (sample_ids >= 0)
-        & (sample_ids < filter_nbits)
-    )
+    tested = Bitset.test_bits(filter_bits, safe)
+    in_range = sample_ids < filter_nbits
+    if out_of_range == "keep":
+        tested = tested | ~in_range
+        return tested & (sample_ids >= 0)
+    return tested & in_range & (sample_ids >= 0)
+
+
+def resolve_filter_bits(filt, id_bound):
+    """Resolve a filter's bitset against an index whose valid ids live in
+    ``[0, id_bound)``, honoring its ``out_of_range`` mode for kernels
+    that only implement "drop".
+
+    Returns the :class:`~raft_tpu.core.bitset.Bitset` to hand to a scan
+    kernel, or ``None`` for an unfiltered search. A ``"keep"``-mode
+    filter narrower than ``id_bound`` is *materialized*: resized (on a
+    copy) with new bits set, so drop-semantics kernels behave as keep
+    without threading another static through every scan. Only meaningful
+    when ids are the default contiguous row ids (true for every build in
+    this repo unless the caller passed custom ``new_ids`` to extend).
+
+    The materialized bitset is cached on the filter object keyed by
+    ``(id_bound, Bitset._version)``, so N filtered searches with one
+    filter pay the resize's device ops (copy + pad + set) once, not N
+    times; an in-place mutation of the underlying bitset bumps
+    ``_version`` and invalidates the entry (the same keying the serve
+    engine uses for its composed tombstone filters).
+
+    ``id_bound`` may be a callable evaluated only for "keep"-mode
+    filters: ``Index.size`` is a device reduction, and forcing it to a
+    Python int on the no-filter/drop path would concretize a tracer when
+    the search entry runs under an outer ``jit`` (the GL002 hazard the
+    jaxpr audit traces for).
+    """
+    bits = getattr(filt, "bitset", None)
+    if bits is None:
+        return None
+    if getattr(filt, "out_of_range", "drop") != "keep":
+        return bits
+    bound = int(id_bound() if callable(id_bound) else id_bound)
+    if bits.n_bits >= bound:
+        return bits
+    key = (bound, getattr(bits, "_version", 0))
+    cached = getattr(filt, "_materialized_keep", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    resized = bits.copy().resize(bound, default=True)
+    try:
+        filt._materialized_keep = (key, resized)
+    except AttributeError:      # slotted/frozen filter: serve correct,
+        pass                    # just uncached
+    return resized
 
 
 # --------------------------------------------------------------------------

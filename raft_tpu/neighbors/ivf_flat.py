@@ -48,6 +48,7 @@ from raft_tpu.neighbors.common import (
     as_filter,
     filter_keep,
     merge_topk,
+    resolve_filter_bits,
     sentinel_for,
 )
 from raft_tpu.matrix.select_k import select_k
@@ -674,7 +675,10 @@ def search(
                         queries=int(queries.shape[0]), k=int(k),
                         n_probes=n_probes) as _sp:
         filt = as_filter(prefilter)
-        bits = getattr(filt, "bitset", None)
+        # materializes "keep"-mode tombstone filters (new ids past the
+        # filter default to kept) for the drop-semantics scan kernels —
+        # docs/serving.md §5; index.size stays lazy (device reduction)
+        bits = resolve_filter_bits(filt, lambda: index.size)
         scan_impl = _resolve_scan_impl(
             str(search_params.scan_impl), cap, min(int(k), cap),
             approx=float(search_params.local_recall_target) < 1.0,

@@ -45,6 +45,7 @@ from raft_tpu.neighbors.common import (
     as_filter,
     filter_keep,
     merge_topk,
+    resolve_filter_bits,
     sentinel_for,
 )
 from raft_tpu.neighbors.ivf_flat import (
@@ -1977,7 +1978,10 @@ def search(
     with obs.entry_span("search", "ivf_pq", queries=int(queries.shape[0]),
                         k=int(k), n_probes=n_probes) as _sp:
         filt = as_filter(prefilter)
-        bits = getattr(filt, "bitset", None)
+        # materializes "keep"-mode tombstone filters (new ids past the
+        # filter default to kept) for the drop-semantics scan kernels —
+        # docs/serving.md §5; index.size stays lazy (device reduction)
+        bits = resolve_filter_bits(filt, lambda: index.size)
         arrays = (
             queries, index.centers, index.centers_rot, index.rotation,
             index.pq_centers, index.codes, index.indices, index.list_sizes,

@@ -1,0 +1,94 @@
+"""graft-serve: the online serving engine (ISSUE 5; docs/serving.md).
+
+Everything before this package is a library: you hold the index, you
+call search, you own the batch shapes. ``raft_tpu.serve`` makes it a
+*service* — the piece FusionANNS (PAPERS.md) shows the end-to-end win
+lives in, and the piece TPU-KNN's peak-FLOP/s numbers quietly assume
+(fixed, padded batch shapes):
+
+* **dynamic micro-batching** (:mod:`raft_tpu.serve.batcher`) —
+  concurrent ``submit(query, k)`` calls coalesce into padded batches
+  drawn from a fixed power-of-two bucket ladder, warmed at startup so
+  steady-state serving never traces (the GL007 zero-recompile bar);
+  bounded-queue backpressure rejects with :class:`Overloaded`
+  (classified transient through ``resilience``);
+* **versioned hot-swap** (:mod:`raft_tpu.serve.registry`) — named
+  indexes advance through refcounted generations: background build/load,
+  one atomic swap, in-flight batches finish on the generation they
+  pinned, the old one frees when its last pin drains;
+* **tombstone mutation** (:mod:`raft_tpu.serve.mutation`) —
+  ``delete``/``upsert`` as a keep-mask composed into the existing
+  filtered-search paths of all four index types, upserts served from a
+  brute-force side buffer merged via ``merge_topk`` until a background
+  ``extend`` + swap compacts them in;
+* the engine (:mod:`raft_tpu.serve.engine`) threading it through
+  ``obs`` (queue depth, fill ratio, rejects, swaps, per-bucket
+  latency), ``resilience.run`` (classified retry; OOM downshifts the
+  bucket ceiling), and ``tuning`` (measured bucket choice, learned
+  row budgets).
+"""
+
+from raft_tpu.serve.batcher import (
+    Batch,
+    MicroBatcher,
+    Overloaded,
+    Request,
+    bucket_ladder,
+    choose_bucket,
+)
+from raft_tpu.serve.engine import ServeParams, Server
+from raft_tpu.serve.mutation import MutableState
+from raft_tpu.serve.registry import Generation, Registry
+
+# the jitted hot-path entry points whose trace caches must stay FLAT in
+# steady-state serving — the serve-side extension of
+# obs.metrics._TRACKED_JITS; tests/test_serve.py asserts zero growth
+# across a mixed-size post-warmup stream with trace_cache_sizes()
+TRACKED_JITS = (
+    ("raft_tpu.neighbors.brute_force", "_search"),
+    ("raft_tpu.neighbors.ivf_flat", "_ivf_search"),
+    ("raft_tpu.neighbors.ivf_pq", "_pq_search"),
+    ("raft_tpu.neighbors.cagra", "_beam_search"),
+    ("raft_tpu.neighbors.cagra", "_beam_search_pallas"),
+    ("raft_tpu.neighbors.refine", "_refine"),
+    ("raft_tpu.serve.engine", "_merge_with_side"),
+    ("raft_tpu.matrix.select_k", "_select_k"),
+    ("raft_tpu.matrix.select_k", "_tournament_topk"),
+)
+
+
+def trace_cache_sizes() -> dict:
+    """Per-function jit trace-cache entry counts for the serving hot
+    paths (the GL007 trace-counting hook, serving edition). Compare
+    before/after a traffic window: any growth means a shape escaped the
+    bucket/k ladder."""
+    import importlib
+
+    out = {}
+    for mod_name, fn_name in TRACKED_JITS:
+        try:
+            fn = getattr(importlib.import_module(mod_name), fn_name, None)
+        except ImportError:
+            continue
+        size_of = getattr(fn, "_cache_size", None)
+        if size_of is None:
+            continue
+        try:
+            out[f"{mod_name.rsplit('.', 1)[-1]}.{fn_name}"] = int(size_of())
+        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow private jax API probe; a missing gauge is the degraded answer
+            continue
+    return out
+
+
+def total_trace_count() -> int:
+    """Sum of :func:`trace_cache_sizes` — the single number the
+    trace-stability acceptance test pins."""
+    return sum(trace_cache_sizes().values())
+
+
+__all__ = [
+    "Batch", "Generation", "MicroBatcher", "MutableState", "Overloaded",
+    "Registry", "Request", "ServeParams", "Server", "TRACKED_JITS",
+    "bucket_ladder", "choose_bucket", "total_trace_count",
+    "trace_cache_sizes",
+]

@@ -1,0 +1,356 @@
+"""Dynamic micro-batching: the request queue and the bucket ladder.
+
+The TPU serving problem (docs/serving.md §2): XLA compiles one program
+per input *shape*, so a query stream with arbitrary row counts would
+retrace constantly — the exact failure mode the GL007 recompile audit
+gates against. The fix is the FusionANNS/TPU-KNN serving shape: requests
+land in a thread-safe queue, a dispatcher coalesces whatever is pending
+into a batch padded up to a **fixed bucket ladder** (powers of two up to
+``max_batch_rows``), and every bucket × k-rung combination is traced
+once at warmup — steady-state serving then never compiles.
+
+Pieces here:
+
+* :func:`bucket_ladder` / :func:`choose_bucket` — the ladder and the
+  measured bucket choice (``tuning.choose("serve_bucket", ...)``: a
+  dispatch table can prefer padding further up the ladder when the
+  larger matmul measures faster than the smaller one plus a second
+  dispatch);
+* :class:`Overloaded` — the bounded-queue admission rejection,
+  classified through ``resilience.classify`` (``queue_full`` is
+  transient — the client's correct move is backoff-and-retry;
+  ``closed`` is fatal — the server can never accept again);
+* :class:`MicroBatcher` — the queue + linger/drain dispatcher loop with
+  ``max_wait_ms`` and ``max_batch_rows`` knobs.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.resilience import errors as _rerrors
+from raft_tpu.utils.math import next_pow2
+
+# batch_fill_ratio histogram edges: rows / bucket after padding
+FILL_BUCKETS: Tuple[float, ...] = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                                   0.875, 1.0)
+
+
+class Overloaded(RuntimeError):
+    """Admission rejection. ``reason="queue_full"`` (bounded queue) and
+    ``reason="not_ready"`` (first generation still building/warming)
+    carry ``fault_kind = "transient"`` so
+    :func:`raft_tpu.resilience.classify` files them with the retryable
+    kinds — both are backoff-and-retry signals, not errors in the
+    request. ``reason="closed"`` is the opposite contract: the server
+    can never accept again, so it classifies ``fatal`` and
+    resilience-aware clients fail fast instead of retrying a shutdown
+    forever."""
+
+    def __init__(self, msg: str, reason: str = "queue_full"):
+        super().__init__(msg)
+        self.reason = reason
+        self.fault_kind = (_rerrors.FATAL if reason == "closed"
+                           else _rerrors.TRANSIENT)
+
+
+def bucket_ladder(max_rows: int) -> Tuple[int, ...]:
+    """The fixed bucket ladder: powers of two ``1..next_pow2(max_rows)``.
+
+    Every batch dispatches at exactly one of these row counts, so the
+    set of traced shapes is finite and warmable."""
+    top = next_pow2(max(int(max_rows), 1))
+    out, b = [], 1
+    while b <= top:
+        out.append(b)
+        b <<= 1
+    return tuple(out)
+
+
+def choose_bucket(ladder: Sequence[int], rows: int,
+                  ceiling: Optional[int] = None) -> int:
+    """Pick the dispatch bucket for ``rows`` pending rows.
+
+    The analytic fallback is the smallest ladder rung >= rows; the
+    choice is registered with ``tuning/`` under op ``serve_bucket`` so a
+    measured table can prefer the next rung up (on a TPU the 2x-wider
+    matmul can cost the same wall-clock, and the wider trace doubles as
+    headroom for the next batch). ``ceiling`` (the OOM-downshifted
+    max) caps the answer except when a single oversized request needs
+    the bigger rung anyway — the dispatcher's splitter handles that.
+    """
+    from raft_tpu import tuning
+
+    rows = max(int(rows), 1)
+    eligible = [b for b in ladder if b >= rows]
+    if not eligible:
+        return ladder[-1]
+    if ceiling is not None:
+        capped = [b for b in eligible if b <= ceiling]
+        eligible = capped or eligible[:1]
+    fallback = eligible[0]
+    cands = [str(b) for b in eligible[:2]]   # this rung or one up
+    w = tuning.choose("serve_bucket", {"rows_bucket": fallback},
+                      cands, str(fallback))
+    try:
+        return int(w)
+    except (TypeError, ValueError):
+        return fallback
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued ``submit`` call: ``rows`` query rows answered together."""
+
+    queries: np.ndarray           # [rows, dim] host array
+    k: int
+    prefilter: object             # user filter (batch-grouping key)
+    future: Future
+    t_enqueue: float = 0.0
+
+    @property
+    def rows(self) -> int:
+        return int(self.queries.shape[0])
+
+
+@dataclasses.dataclass
+class Batch:
+    """One coalesced dispatch unit: requests sharing a user prefilter,
+    padded up to ``bucket`` rows."""
+
+    requests: List[Request]
+    rows: int
+    bucket: int
+    prefilter: object
+    seq: int = 0
+
+    @property
+    def k_max(self) -> int:
+        return max(r.k for r in self.requests)
+
+
+class MicroBatcher:
+    """Thread-safe request queue + coalescing dispatcher.
+
+    ``submit`` enqueues and returns immediately (backpressure: a full
+    queue raises :class:`Overloaded`); a daemon dispatcher thread
+    lingers up to ``max_wait_ms`` for the queue to fill toward the
+    bucket ceiling, drains a filter-homogeneous run of requests, and
+    hands the padded :class:`Batch` to ``dispatch_fn`` (the engine's
+    resilience-wrapped search). The ceiling is dynamic: the engine's OOM
+    ladder calls :meth:`set_ceiling` to downshift it.
+    """
+
+    def __init__(
+        self,
+        dispatch_fn: Callable[[Batch], None],
+        *,
+        max_batch_rows: int = 256,
+        max_wait_ms: float = 2.0,
+        max_queue_rows: int = 4096,
+        name: str = "default",
+    ):
+        self.ladder = bucket_ladder(max_batch_rows)
+        self.max_batch_rows = self.ladder[-1]
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self.name = name
+        self._dispatch = dispatch_fn
+        self._q: "collections.deque[Request]" = collections.deque()
+        self._pending_rows = 0
+        self._ceiling = self.max_batch_rows
+        self._closed = False
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"raft-tpu-serve-batcher-{name}",
+        )
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, queries: np.ndarray, k: int,
+               prefilter=None) -> Future:
+        """Enqueue ``queries`` ([rows, dim]) at ``k``; returns the Future
+        the dispatcher resolves with ``(distances, ids)`` host arrays.
+
+        Raises :class:`Overloaded` (classified transient) when admission
+        would push the queue past ``max_queue_rows`` — bounded queues
+        are the backpressure contract: reject at the door, never grow
+        an unbounded latency tail."""
+        with obs.span("serve.submit", index=self.name,
+                      rows=int(queries.shape[0]), k=int(k)):
+            req = Request(queries=queries, k=int(k), prefilter=prefilter,
+                          future=Future())
+            if req.rows > self.max_batch_rows:
+                raise ValueError(
+                    f"request rows={req.rows} exceeds max_batch_rows="
+                    f"{self.max_batch_rows}; split the query block or "
+                    "raise ServeParams.max_batch_rows"
+                )
+            reason = None
+            with self._cond:
+                if self._closed or \
+                        self._pending_rows + req.rows > self.max_queue_rows:
+                    reason = "closed" if self._closed else "queue_full"
+                    pending = self._pending_rows
+                else:
+                    req.t_enqueue = time.monotonic()
+                    self._q.append(req)
+                    self._pending_rows += req.rows
+                    depth = self._pending_rows
+                    self._cond.notify_all()
+            # bookkeeping OUTSIDE the admission lock: classify() in
+            # flight mode synchronously dumps the 4096-event ring to
+            # disk for the fatal `closed` rejection — doing that under
+            # _cond would stall every concurrent submit and the
+            # dispatcher for the dump's duration
+            if reason is not None:
+                obs.counter("serve.rejects_total", index=self.name,
+                            reason=reason)
+                exc = Overloaded(
+                    f"serve[{self.name}]: {reason} "
+                    f"(pending={pending} rows, "
+                    f"max_queue_rows={self.max_queue_rows})",
+                    reason=reason,
+                )
+                _rerrors.classify(exc)   # file with errors_total/flight
+                raise exc
+            obs.gauge("serve.queue_depth", depth, index=self.name)
+            obs.counter("serve.requests_total", index=self.name)
+            return req.future
+
+    # -- knobs -------------------------------------------------------------
+
+    @property
+    def ceiling(self) -> int:
+        return self._ceiling
+
+    def set_ceiling(self, rows: int) -> None:
+        """Clamp the dispatch bucket ceiling (OOM-ladder downshift); the
+        floor is the smallest ladder rung."""
+        with self._cond:
+            self._ceiling = max(min(int(rows), self.max_batch_rows),
+                                self.ladder[0])
+            obs.gauge("serve.bucket_ceiling", self._ceiling,
+                      index=self.name)
+
+    def depth_rows(self) -> int:
+        with self._lock:
+            return self._pending_rows
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop admissions, drain the queue through the dispatcher, join."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    # -- the dispatcher loop ----------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # noqa: BLE001 — classified by the engine; the loop must survive to fail ONLY this batch
+                for r in batch.requests:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _next_batch(self) -> Optional[Batch]:
+        with self._cond:
+            while True:
+                while not self._q and not self._closed:
+                    self._cond.wait(timeout=0.1)
+                if not self._q:
+                    return None                  # closed and drained
+                # linger: let the queue fill toward the ceiling, but
+                # never hold the head request past max_wait_ms
+                head = self._q[0]
+                deadline = head.t_enqueue + self.max_wait_s
+                while (not self._closed and self._q
+                       and self._head_run_rows() < self._ceiling):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                if not self._q:                  # close raced the linger
+                    continue
+                return self._drain_locked()
+
+    def _head_run_rows(self) -> int:
+        """Rows in the longest filter-homogeneous run at the queue head
+        (only those can coalesce into one batch)."""
+        if not self._q:
+            return 0
+        key = id(self._q[0].prefilter) if self._q[0].prefilter is not None \
+            else None
+        rows = 0
+        for r in self._q:
+            rk = id(r.prefilter) if r.prefilter is not None else None
+            if rk != key:
+                break
+            rows += r.rows
+            if rows >= self._ceiling:
+                # the linger loop only compares against the ceiling, so
+                # scanning past it is wasted work done under the shared
+                # admission lock on every dispatcher wake — bound each
+                # scan at the ceiling instead of the full backlog
+                break
+        return rows
+
+    def _drain_locked(self) -> Batch:
+        head = self._q[0]
+        key = id(head.prefilter) if head.prefilter is not None else None
+        cap = max(self._ceiling, head.rows)   # oversized head still goes
+        taken: List[Request] = []
+        rows = 0
+        while self._q:
+            r = self._q[0]
+            rk = id(r.prefilter) if r.prefilter is not None else None
+            if rk != key or (taken and rows + r.rows > cap):
+                break
+            taken.append(self._q.popleft())
+            rows += r.rows
+        self._pending_rows -= rows
+        obs.gauge("serve.queue_depth", self._pending_rows, index=self.name)
+        bucket = choose_bucket(self.ladder, rows, ceiling=cap)
+        self._seq += 1
+        obs.counter("serve.batches_total", index=self.name,
+                    bucket=str(bucket))
+        obs.observe("serve.batch_fill_ratio", rows / bucket,
+                    buckets=FILL_BUCKETS, index=self.name)
+        obs.observe("serve.queue_wait_ms",
+                    (time.monotonic() - head.t_enqueue) * 1e3,
+                    index=self.name)
+        return Batch(requests=taken, rows=rows, bucket=bucket,
+                     prefilter=head.prefilter, seq=self._seq)
+
+
+def pad_rows(queries: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad ``queries`` up to ``bucket`` rows ON THE HOST (numpy):
+    the pad must happen before the device transfer so the traced program
+    only ever sees ladder shapes — a ``jnp.pad`` here would itself trace
+    once per distinct input row count, defeating the ladder."""
+    rows = queries.shape[0]
+    if rows == bucket:
+        return queries
+    pad = np.zeros((bucket - rows,) + queries.shape[1:], queries.dtype)
+    return np.concatenate([queries, pad], axis=0)

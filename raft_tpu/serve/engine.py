@@ -1,0 +1,1038 @@
+"""The serving engine: Server, per-index serving units, warmed dispatch.
+
+This ties the serving pieces together (docs/serving.md §1):
+
+    submit ──► MicroBatcher (bucket ladder, backpressure)
+                   │ Batch
+                   ▼
+            dispatch  ──pin──►  Registry generation (hot-swap)
+                   │                    │ handle
+                   ▼                    ▼
+        resilience.run( main filtered search + side-buffer search
+                        + merge_topk )  ◄── MutableState (tombstones)
+                   │
+                   ▼
+            futures resolved with host (distances, external ids)
+
+Trace discipline: every device-facing shape is drawn from a finite set —
+query rows from the bucket ladder, k from the k-ladder (powers of two
+plus the ``max_k`` top rung),
+filter words from the mutation state's power-of-two filter-capacity
+rung (:meth:`MutableState.filter_capacity` — so per-upsert id growth
+does not change the kernels' static ``filter_nbits``), side-buffer rows
+from its power-of-two capacity — and :meth:`Server.warmup` drives each
+combination once at publish time, so steady-state serving dispatches
+only cached executables (the GL007 zero-recompile requirement; the
+`test_serve` suite asserts it with the same trace-counting hook).
+
+Failure discipline: batch dispatch runs under
+:func:`raft_tpu.resilience.run` (classified retry for transient /
+dead-backend); an OOM-classified failure downshifts the batcher's
+bucket ceiling (recorded via ``tuning.record_budget`` so later servers
+in the process start safe), splits the batch, and re-dispatches — the
+serving instance of the resilience OOM ladder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs, tuning
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.distance.types import is_min_close, resolve_metric
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.neighbors.common import BitsetFilter, merge_topk
+from raft_tpu.neighbors.refine import refine as _exact_refine
+from raft_tpu.resilience import errors as _rerrors
+from raft_tpu.resilience import faultinject
+from raft_tpu.serve.batcher import (
+    Batch,
+    MicroBatcher,
+    Overloaded,
+    Request,
+    choose_bucket,
+    pad_rows,
+)
+from raft_tpu.serve.mutation import MutableState
+from raft_tpu.serve.registry import Registry
+
+ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+# latency histogram edges tuned for ms-scale online serving
+_LAT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000)
+
+
+@dataclasses.dataclass
+class ServeParams:
+    """Serving knobs (docs/serving.md §6)."""
+
+    max_batch_rows: int = 256       # bucket-ladder top (rounded to pow2)
+    max_wait_ms: float = 2.0        # linger before dispatching a partial batch
+    max_queue_rows: int = 4096      # admission bound -> Overloaded past it
+    max_k: int = 128                # k-ladder top (requests cap here)
+    side_capacity: int = 64         # initial upsert side-buffer capacity (pow2)
+    compact_threshold: int = 512    # side rows that trigger background
+    #                                 compaction (0 = manual compact() only)
+    warmup: bool = True             # trace the ladder at publish time
+    dispatch_retries: int = 2       # classified transient/dead retries
+    retry_backoff_s: float = 0.05
+    request_timeout_s: float = 120.0  # Server.search() convenience bound
+
+
+class _Handle:
+    """One generation's immutable serving state: the index, its searcher
+    configuration, and the (shared, mutable) tombstone overlay."""
+
+    __slots__ = ("algo", "index", "state", "search_params",
+                 "user_search_params", "build_params",
+                 "refine_ratio", "metric", "select_min", "dtype", "dim",
+                 "rows", "raw_dataset", "_raw_dev", "_side_cache")
+
+    def __init__(self, algo: str, index, state: MutableState,
+                 search_params, build_params, refine_ratio: int,
+                 raw_dataset: Optional[np.ndarray],
+                 user_search_params=None):
+        self.algo = algo
+        self.index = index
+        self.state = state
+        self.search_params = search_params
+        # the params the CALLER supplied (None when defaulted): a swap
+        # inherits these, not the resolved ones — the serving defaults
+        # (n_probes = n_lists) must be re-derived against the NEW
+        # index, or a bigger successor silently serves the old index's
+        # probe count
+        self.user_search_params = user_search_params
+        self.build_params = build_params
+        self.refine_ratio = int(refine_ratio)
+        self.metric = _index_metric(algo, index)
+        self.select_min = is_min_close(self.metric)
+        self.rows = _index_rows(algo, index)
+        self.dim = _index_dim(algo, index)
+        self.dtype = np.dtype(np.float32)
+        self.raw_dataset = raw_dataset
+        self._raw_dev = None                  # device copy, cached lazily
+        self._side_cache: Optional[Tuple[int, object, object]] = None
+
+    def raw_dev(self):
+        """Device-resident raw row store (refine operand) — transferred
+        once per generation, not per batch."""
+        if self._raw_dev is None and self.raw_dataset is not None:
+            self._raw_dev = jax.device_put(self.raw_dataset)
+        return self._raw_dev
+
+    # -- the per-algo search adapters -------------------------------------
+
+    def search_main(self, qdev, k: int, filt: BitsetFilter):
+        if self.algo == "brute_force":
+            return brute_force.search(self.index, qdev, k, prefilter=filt)
+        if self.algo == "ivf_flat":
+            return ivf_flat.search(self.search_params, self.index, qdev, k,
+                                   prefilter=filt)
+        if self.algo == "ivf_pq":
+            if self.refine_ratio > 1 and self.raw_dataset is not None:
+                kc = min(k * self.refine_ratio, self.rows)
+                d, i = ivf_pq.search(self.search_params, self.index, qdev,
+                                     kc, prefilter=filt)
+                return _exact_refine(self.raw_dev(), qdev, i, k,
+                                     self.metric)
+            return ivf_pq.search(self.search_params, self.index, qdev, k,
+                                 prefilter=filt)
+        if self.algo == "cagra":
+            return cagra.search(self.search_params, self.index, qdev, k,
+                                prefilter=filt)
+        raise ValueError(f"unknown algo {self.algo!r}")
+
+    def side_index(self):
+        """Brute-force index + device id map over the (padded) side
+        buffer, cached per side-content seq — serving rebuilds it only
+        when the side buffer's CONTENT changed (an upsert appended or a
+        compaction shifted it), not on every mutation: a delete of base
+        rows bumps the global ``seq`` for the tombstone bitsets but
+        leaves the side vectors untouched, and must not force a
+        brute-force rebuild + device re-upload here."""
+        with self.state.lock:
+            snap = self.side_snapshot_locked()
+        return self.side_build(snap)
+
+    def side_snapshot_locked(self) -> Optional[tuple]:
+        """Cheap side-content snapshot; the caller must hold
+        ``state.lock``. Split from :meth:`side_build` so the dispatcher
+        can copy the side rows inside its consistency-pinned critical
+        section but run the brute-force build + device upload AFTER
+        releasing it — with the RLock held by the outer frame, doing
+        both in :meth:`side_index` stalls every concurrent
+        delete/upsert for the full build each side epoch."""
+        st = self.state
+        if st.side_cap == 0:
+            return None
+        hit = self._side_cache
+        if hit is not None and hit[0] == st.side_seq:
+            return hit                     # (seq, idx, ids_dev) — built
+        return (st.side_seq, st.side_vecs.copy(), st.side_int.copy())
+
+    def side_build(self, snap: Optional[tuple]):
+        """Materialize a :meth:`side_snapshot_locked` result (lock-free
+        for the expensive part)."""
+        if snap is None:
+            return None, None
+        seq, a, b = snap
+        if not isinstance(a, np.ndarray):  # cache hit: already built
+            return a, b
+        idx = brute_force.build(a, metric=self.metric)
+        ids_dev = jax.device_put(b.astype(np.int32))
+        with self.state.lock:
+            self._side_cache = (seq, idx, ids_dev)
+        return idx, ids_dev
+
+    def k_ladder(self, max_k: int) -> Tuple[int, ...]:
+        """k rungs this generation can serve: powers of two below
+        ``max_k`` plus ``max_k`` itself as the top rung — submit admits
+        any ``k <= max_k``, so the ladder must always have a rung that
+        covers it (a pow2-only ladder under e.g. ``max_k=100`` would
+        top out at 64 and fail every admitted k in (64, 100] at
+        delivery). Each rung is capped by the index size (brute force
+        rejects k > n)."""
+        out: List[int] = []
+        b = 1
+        while b < max_k:
+            out.append(min(b, self.rows))
+            b <<= 1
+        out.append(min(max_k, self.rows))
+        return tuple(sorted(set(out)))
+
+    def k_pad(self, k: int, max_k: int) -> int:
+        ladder = self.k_ladder(max_k)
+        for rung in ladder:
+            if rung >= k:
+                return rung
+        return ladder[-1]
+
+
+def _index_rows(algo: str, index) -> int:
+    if algo == "cagra":
+        return int(index.dataset.shape[0])
+    return int(index.size)
+
+
+def _index_dim(algo: str, index) -> int:
+    return int(index.dim)
+
+
+def _index_metric(algo: str, index):
+    return resolve_metric(index.metric)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _merge_with_side(d, i, sd, sp, side_int, k: int, select_min: bool):
+    """Merge the main index's top-k with the side-buffer's: side result
+    POSITIONS resolve to internal ids through the device id map, then one
+    ``merge_topk`` keeps the global best-k. Invalid side slots (-1 /
+    filtered) ride at the sentinel distance and sink."""
+    si = jnp.where(
+        sp >= 0,
+        side_int[jnp.clip(sp, 0, side_int.shape[0] - 1)],
+        jnp.int32(-1),
+    )
+    cd = jnp.concatenate([d, sd.astype(d.dtype)], axis=1)
+    ci = jnp.concatenate([i.astype(jnp.int32), si], axis=1)
+    return merge_topk(cd, ci, k, select_min)
+
+
+class _IndexServing:
+    """One named index's serving unit: batcher + mutation overlay +
+    dispatch/warmup logic against the shared registry."""
+
+    def __init__(self, server: "Server", name: str):
+        self.server = server
+        self.name = name
+        self.params = server.params
+        self.registry = server.registry
+        # effective warmup choice for THIS index: _install overwrites it
+        # with the per-call override, and every later implicit warmup
+        # (upsert re-warm, compaction, swap) honors it — a user who
+        # opted out at create_index must not eat a full ladder compile
+        # on their first growing upsert
+        self.warmup_enabled = self.params.warmup
+        # non-blocking acquire = atomic test-and-set: exactly one
+        # compaction runs per index (released by the background thread)
+        self.compacting = threading.Lock()
+        self.batcher = MicroBatcher(
+            self._dispatch,
+            max_batch_rows=self.params.max_batch_rows,
+            max_wait_ms=self.params.max_wait_ms,
+            max_queue_rows=self.params.max_queue_rows,
+            name=name,
+        )
+        # an OOM survivor recorded by an earlier server in this process
+        # clamps the starting ceiling (same contract as the streaming
+        # paths' budget names)
+        ceiling = tuning.budget("serve_batch_rows",
+                                self.batcher.max_batch_rows)
+        if ceiling < self.batcher.max_batch_rows:
+            self.batcher.set_ceiling(ceiling)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _pin_consistent(self):
+        """Pin the current generation AND acquire its mutation lock such
+        that (generation, mutation state) are a consistent pair — a
+        compaction commits its side-buffer shift and publishes the
+        extended generation under the same lock, so observing one
+        without the other would drop the compacted rows for one batch."""
+        for _ in range(8):
+            gen = self.registry.pin(self.name)
+            st = gen.handle.state
+            st.lock.acquire()
+            if self.registry.get(self.name) is gen:
+                return gen, st
+            st.lock.release()
+            gen.release()
+        # a swap storm: serve from the latest pin anyway (its handle and
+        # state are still a valid pair for a non-compaction swap)
+        gen = self.registry.pin(self.name)
+        st = gen.handle.state
+        st.lock.acquire()
+        return gen, st
+
+    def _dispatch(self, batch: Batch) -> None:
+        """Batcher callback: resilience-wrapped dispatch + OOM ladder."""
+        try:
+            _rerrors.run(
+                self._dispatch_once, batch,
+                retries=self.params.dispatch_retries,
+                backoff_s=self.params.retry_backoff_s,
+            )
+        except BaseException as e:  # noqa: BLE001 — classified right below
+            kind = _rerrors.classify(e)
+            if kind == _rerrors.OOM and len(batch.requests) > 1:
+                self._downshift_and_split(batch)
+                return
+            if kind == _rerrors.OOM:
+                # single request: record the learned ceiling anyway
+                self._downshift(max(batch.bucket // 2, 1))
+            for r in batch.requests:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _downshift(self, new_ceiling: int) -> None:
+        new_ceiling = max(int(new_ceiling), self.batcher.ladder[0])
+        self.batcher.set_ceiling(min(self.batcher.ceiling, new_ceiling))
+        tuning.record_budget("serve_batch_rows", new_ceiling)
+        obs.counter("oom_ladder_downshifts", path="serve")
+        obs.event("serve_downshift", index=self.name, ceiling=new_ceiling)
+
+    def _downshift_and_split(self, batch: Batch) -> None:
+        """The serving OOM ladder: halve the bucket ceiling and re-dispatch
+        the batch as two ladder-shaped halves (requests are the atomic
+        unit — row-independent searches make the split result-identical)."""
+        self._downshift(batch.bucket // 2)
+        half_rows = batch.rows // 2
+        left: List = []
+        rows = 0
+        for r in batch.requests:
+            if left and rows + r.rows > half_rows:
+                break
+            left.append(r)
+            rows += r.rows
+        right = batch.requests[len(left):]
+        for part in (left, right):
+            if not part:
+                continue
+            prows = sum(r.rows for r in part)
+            sub = Batch(
+                requests=part, rows=prows,
+                bucket=choose_bucket(self.batcher.ladder, prows,
+                                     ceiling=self.batcher.ceiling),
+                prefilter=batch.prefilter, seq=batch.seq,
+            )
+            self._dispatch(sub)
+
+    def _dispatch_once(self, batch: Batch) -> None:
+        gen, st = self._pin_consistent()
+        try:
+            h: _Handle = gen.handle
+            try:
+                # snapshot the mutation overlay while (generation, state)
+                # are verified consistent; the device arrays captured here
+                # are immutable, so the search itself runs lock-free
+                if batch.prefilter is None:
+                    main_bits = st.tombstone_bits()
+                    side_bits = st.side_keep_bits()
+                else:
+                    main_bits, side_bits = st.compose_user_filter(
+                        batch.prefilter)
+                # snapshot only — the brute-force build + upload run
+                # below, after the mutation lock drops
+                side_snap = h.side_snapshot_locked()
+            finally:
+                st.lock.release()
+            side_idx, side_ids = h.side_build(side_snap)
+            t0 = time.perf_counter()
+            with obs.span("serve.batch", index=self.name,
+                          bucket=batch.bucket, rows=batch.rows,
+                          generation=gen.version) as sp:
+                # fault point: where a real device failure would surface
+                faultinject.check(stage="serve.dispatch", chunk=batch.seq)
+                d, i = self._run_search(
+                    h, batch, main_bits, side_bits, side_idx, side_ids)
+                jax.block_until_ready((d, i))
+                sp.set(k_pad=int(d.shape[1]))
+            self._deliver(batch, gen, h, np.asarray(d), np.asarray(i),
+                          (time.perf_counter() - t0) * 1e3)
+        finally:
+            gen.release()
+
+    def _run_search(self, h: _Handle, batch: Batch, main_bits: Bitset,
+                    side_bits: Optional[Bitset], side_idx, side_ids):
+        """The shape-stable search core (shared verbatim by warmup): pad
+        rows on the HOST up to the bucket, search the main index under
+        the composed keep-mask, then merge the side buffer's exact
+        results."""
+        q = np.concatenate([r.queries for r in batch.requests], axis=0) \
+            if batch.requests else np.zeros((0, h.dim), h.dtype)
+        q = pad_rows(np.ascontiguousarray(q, dtype=h.dtype), batch.bucket)
+        qdev = jax.device_put(q)
+        kq = h.k_pad(batch.k_max, self.params.max_k)
+        d, i = h.search_main(qdev, kq, BitsetFilter(main_bits))
+        if side_idx is not None:
+            k_side = min(kq, side_idx.size)
+            sd, sp = brute_force.search(
+                side_idx, qdev, k_side,
+                prefilter=None if side_bits is None
+                else BitsetFilter(side_bits))
+            d, i = _merge_with_side(d, i, sd, sp, side_ids, kq,
+                                    h.select_min)
+        return d, i
+
+    def _deliver(self, batch: Batch, gen, h: _Handle,
+                 d: np.ndarray, i: np.ndarray, latency_ms: float) -> None:
+        row = 0
+        ext = h.state.translate_out(i.astype(np.int64)) \
+            if h.state.has_translation else i
+        # a slot at the sentinel distance is a filtered-out (tombstoned)
+        # or padding candidate that survived top-k only because fewer
+        # than k live rows existed: brute_force._search and the side
+        # merge keep such slots' REAL ids (ivf_* map them to -1 in the
+        # kernel), so mask them here rather than hand a deleted row's id
+        # to the client
+        sent = np.inf if h.select_min else -np.inf
+        ext = np.where(d == sent, np.asarray(-1, ext.dtype), ext)
+        for r in batch.requests:
+            rd = d[row:row + r.rows, :r.k]
+            ri = ext[row:row + r.rows, :r.k]
+            row += r.rows
+            r.future.generation = gen.version
+            if r.future.done():
+                continue
+            if rd.shape[1] < r.k:
+                # a swap shrank the index below this request's k after
+                # admission: fail loudly, never hand back fewer columns
+                # than asked
+                r.future.set_exception(ValueError(
+                    f"k={r.k} exceeds index rows={h.rows} after swap"))
+            else:
+                r.future.set_result((rd, ri))
+        obs.counter("serve.queries_total", batch.rows, index=self.name)
+        obs.observe("serve.batch_latency_ms", latency_ms,
+                    buckets=_LAT_BUCKETS, index=self.name,
+                    bucket=str(batch.bucket))
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup_handle(self, h: _Handle) -> int:
+        """Trace every (bucket, k-rung) combination through the REAL
+        dispatch core so steady-state serving never compiles. Returns
+        the number of (bucket, k) shapes warmed."""
+        with obs.span("serve.warmup", index=self.name):
+            st = h.state
+            with st.lock:
+                main_bits = st.tombstone_bits()
+                side_bits = st.side_keep_bits()
+            side_idx, side_ids = h.side_index()
+            warmed = 0
+            oom = False
+            for bucket in self.batcher.ladder:
+                if oom:
+                    break
+                q = np.zeros((bucket, h.dim), h.dtype)
+                for kq in h.k_ladder(self.params.max_k):
+                    fake = Batch(requests=[], rows=bucket, bucket=bucket,
+                                 prefilter=None)
+                    fake.requests = [_warm_request(q, kq)]
+                    try:
+                        out = self._run_search(h, fake, main_bits,
+                                               side_bits, side_idx,
+                                               side_ids)
+                        jax.block_until_ready(out)
+                        warmed += 1
+                    except ValueError as e:
+                        # a rung this index cannot serve (e.g. k beyond
+                        # the probed candidate pool) fails identically at
+                        # dispatch — nothing to warm, but a silently
+                        # skipped rung voids the zero-recompile
+                        # guarantee for that shape, so leave a signal
+                        # naming which one and why
+                        obs.counter("serve.warmup_skipped",
+                                    index=self.name)
+                        obs.event("serve_warmup_rung_skipped",
+                                  index=self.name, bucket=bucket, k=kq,
+                                  error=str(e))
+                        continue
+                    except Exception as e:  # noqa: BLE001 — only the classified-OOM kind is handled; the rest re-raise
+                        if _rerrors.classify(e) != _rerrors.OOM:
+                            raise
+                        # device OOM tracing this rung: at dispatch the
+                        # ladder would halve the ceiling and keep
+                        # serving — do the same here, so a server whose
+                        # top bucket doesn't fit still comes up serving
+                        # the buckets that do (larger rungs can only
+                        # OOM harder)
+                        self._downshift(bucket // 2)
+                        obs.event("serve_warmup_oom", index=self.name,
+                                  bucket=bucket, k=kq)
+                        oom = True
+                        break
+            obs.counter("serve.warmup_shapes", warmed, index=self.name)
+            return warmed
+
+
+def _warm_request(q: np.ndarray, k: int) -> Request:
+    return Request(queries=q, k=k, prefilter=None, future=Future())
+
+
+class Server:
+    """The online serving engine (ISSUE 5 tentpole; docs/serving.md).
+
+    One ``Server`` hosts any number of named indexes, each with its own
+    micro-batcher, versioned generations, and tombstone overlay::
+
+        srv = serve.Server()
+        srv.create_index("vectors", dataset, algo="ivf_flat")
+        fut = srv.submit(queries, k=10, index="vectors")
+        dists, ids = fut.result()
+        srv.delete([3, 17], index="vectors")
+        srv.swap("vectors", dataset=new_dataset)     # background + atomic
+        srv.close()
+    """
+
+    def __init__(self, params: Optional[ServeParams] = None):
+        self.params = params or ServeParams()
+        self.registry = Registry()
+        self._servings: Dict[str, _IndexServing] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- index lifecycle ---------------------------------------------------
+
+    def create_index(self, name: str, dataset, algo: str = "brute_force",
+                     build_params=None, search_params=None,
+                     ids=None, refine_ratio: int = 1,
+                     warmup: Optional[bool] = None):
+        """Build ``algo`` over ``dataset`` in-process and publish it as
+        generation 1 of ``name`` (warming the trace ladder first unless
+        disabled). ``ids`` optionally names rows with external ids
+        (default: row positions)."""
+        with obs.span("serve.create_index", index=name, algo=algo):
+            dataset = np.ascontiguousarray(np.asarray(dataset),
+                                           dtype=np.float32)
+            index = _build_index(algo, dataset, build_params)
+            return self._install(name, algo, index, dataset, build_params,
+                                 search_params, ids, refine_ratio, warmup)
+
+    def add_index(self, name: str, index, algo: str, dataset=None,
+                  build_params=None, search_params=None, ids=None,
+                  refine_ratio: int = 1, warmup: Optional[bool] = None):
+        """Publish a prebuilt index object under ``name``."""
+        with obs.span("serve.add_index", index=name, algo=algo):
+            ds = None if dataset is None else np.ascontiguousarray(
+                np.asarray(dataset), dtype=np.float32)
+            return self._install(name, algo, index, ds, build_params,
+                                 search_params, ids, refine_ratio, warmup)
+
+    def load_index(self, name: str, path: str, algo: str,
+                   search_params=None, refine_ratio: int = 1,
+                   warmup: Optional[bool] = None):
+        """Load a ``core/serialize`` snapshot and publish it — the
+        cold-start / cross-process half of the hot-swap protocol."""
+        with obs.span("serve.load_index", index=name, algo=algo):
+            index = _ALGO_MODULES[algo].load(path)
+            return self._install(name, algo, index, None, None,
+                                 search_params, None, refine_ratio, warmup)
+
+    def _install(self, name, algo, index, dataset, build_params,
+                 search_params, ids, refine_ratio, warmup):
+        if algo not in ALGOS:
+            raise ValueError(f"algo must be one of {ALGOS}, got {algo!r}")
+        rows = _index_rows(algo, index)
+        dim = _index_dim(algo, index)
+        state = MutableState(
+            rows, dim, np.float32, ext_ids=ids,
+            side_capacity=self.params.side_capacity,
+        )
+        h = _Handle(algo, index, state,
+                    _default_search_params(algo, index, search_params),
+                    build_params, refine_ratio,
+                    _raw_dataset(algo, index, dataset),
+                    user_search_params=search_params)
+        with self._lock:
+            # checked under the SAME lock that registers the serving: a
+            # close() racing the unlocked gap would snapshot _servings
+            # without this entry and leave its batcher thread running
+            # forever
+            if self._closed:
+                raise RuntimeError("server is closed")
+            serving = self._servings.get(name)
+            if serving is None:
+                serving = _IndexServing(self, name)
+                self._servings[name] = serving
+        serving.warmup_enabled = warmup if warmup is not None \
+            else self.params.warmup
+        if serving.warmup_enabled:
+            serving.warmup_handle(h)
+        gen = self._publish_guarded(name, h)
+        return gen.version
+
+    def _publish_guarded(self, name: str, h: "_Handle"):
+        """Publish under the server lock: a background build finishing
+        after :meth:`close` must not resurrect the name — a generation
+        published then would hold its device arrays with nothing left to
+        retire it."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            return self.registry.publish(name, h)
+
+    # -- the data plane ----------------------------------------------------
+
+    def submit(self, queries, k: int, *, index: str = "default",
+               prefilter=None) -> Future:
+        """Enqueue a search; returns a Future resolving to host
+        ``(distances [rows, k], external ids [rows, k])``. ``queries``
+        is one query ``[dim]`` or a block ``[rows, dim]`` answered
+        together. Raises :class:`Overloaded` when the bounded queue is
+        full (classified transient — back off and retry)."""
+        with obs.span("serve.request", index=index):
+            q = np.asarray(queries, dtype=np.float32)
+            if q.ndim == 1:
+                q = q[None, :]
+            if q.ndim != 2:
+                raise ValueError(f"queries must be [dim] or [rows, dim], "
+                                 f"got shape {q.shape}")
+            if not 0 < int(k) <= self.params.max_k:
+                raise ValueError(
+                    f"k={k} outside (0, max_k={self.params.max_k}]")
+            serving = self._serving(index)
+            gen = self.registry.get(index)
+            handle = gen.handle if gen is not None else None
+            if handle is None and not self._closed:
+                # create_index/add_index registers the serving BEFORE its
+                # first publish, and warmup can hold that window open for
+                # minutes — a request admitted now would skip the k/dim
+                # door checks below and fail later with the dispatcher's
+                # internal KeyError instead of a retryable rejection
+                obs.counter("serve.rejects_total", index=index,
+                            reason="not_ready")
+                exc = Overloaded(
+                    f"serve[{index}]: not_ready "
+                    "(first generation still building/warming)",
+                    reason="not_ready",
+                )
+                _rerrors.classify(exc)
+                raise exc
+            if handle is not None and int(k) > handle.rows:
+                # the k-ladder caps at the index size, so this request
+                # would be silently truncated at delivery — reject it at
+                # the door instead
+                raise ValueError(
+                    f"k={k} exceeds index rows={handle.rows}")
+            if handle is not None and q.shape[1] != handle.dim:
+                # a wrong-width query would fail the whole coalesced
+                # batch at dispatch (np.concatenate), taking innocent
+                # requests down with it — reject it at the door
+                raise ValueError(
+                    f"query dim {q.shape[1]} != index dim {handle.dim}")
+            return serving.batcher.submit(q, int(k), prefilter=prefilter)
+
+    def search(self, queries, k: int, *, index: str = "default",
+               prefilter=None, timeout_s: Optional[float] = None):
+        """Blocking convenience over :meth:`submit`."""
+        with obs.span("serve.search", index=index):
+            fut = self.submit(queries, k, index=index, prefilter=prefilter)
+            return fut.result(timeout=timeout_s
+                              if timeout_s is not None
+                              else self.params.request_timeout_s)
+
+    # -- mutation ----------------------------------------------------------
+
+    def delete(self, ids, *, index: str = "default") -> int:
+        """Tombstone rows by external id; takes effect on the next batch
+        (the keep-mask composes with any user prefilter). Returns the
+        number of rows that were live."""
+        with obs.span("serve.delete", index=index):
+            self._serving(index)
+            # pin: a concurrent swap retiring the generation must not
+            # drain its handle out from under the mutation
+            gen = self._pin(index)
+            try:
+                st = gen.handle.state
+                n = st.delete(ids)
+                obs.counter("serve.deletes_total", n, index=index)
+                obs.gauge("serve.tombstoned_rows", st.deleted_rows(),
+                          index=index)
+                return n
+            finally:
+                gen.release()
+
+    def upsert(self, vectors, ids, *, index: str = "default") -> int:
+        """Insert-or-replace vectors under external ``ids``: old rows are
+        tombstoned, new rows land in the brute-force side buffer (merged
+        into every search) until compaction folds them into the main
+        index. Returns the side-buffer occupancy."""
+        with obs.span("serve.upsert", index=index):
+            serving = self._serving(index)
+            # pin: a concurrent swap retiring the generation must not
+            # drain its handle out from under the mutation
+            gen = self._pin(index)
+            try:
+                h: _Handle = gen.handle
+                v = np.asarray(vectors)
+                n_rows = 1 if v.ndim == 1 else int(v.shape[0])
+                side_rows, grew = h.state.upsert(v, ids)
+                obs.counter("serve.upserts_total", n_rows, index=index)
+                obs.gauge("serve.side_rows", side_rows, index=index)
+                if grew and serving.warmup_enabled:
+                    # a traced shape grew (side capacity, or the filter
+                    # capacity rung crossed a pow2 boundary): re-warm so
+                    # serving goes back to zero-compile steady state
+                    serving.warmup_handle(h)
+            finally:
+                gen.release()
+            if (self.params.compact_threshold
+                    and side_rows >= self.params.compact_threshold):
+                self.compact(index=index)
+            return side_rows
+
+    def compact(self, *, index: str = "default",
+                wait: bool = False) -> Optional[Future]:
+        """Fold the side buffer into the main index: background
+        ``extend`` (or full rebuild for graph indexes) + warmup + atomic
+        swap; the tombstone mask carries over (deleted rows stay
+        tombstoned inside the extended index until the next full swap).
+        No-op when the side buffer is empty."""
+        with obs.span("serve.compact", index=index):
+            serving = self._serving(index)
+            if not serving.compacting.acquire(blocking=False):
+                return None
+            fut: Future = Future()
+
+            def _run():
+                try:
+                    fut.set_result(self._compact_sync(serving))
+                except BaseException as e:  # noqa: BLE001 — handed to the future; classified by resilience inside
+                    _rerrors.classify(e)
+                    fut.set_exception(e)
+                finally:
+                    serving.compacting.release()
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name=f"raft-tpu-serve-compact-{index}")
+            t.start()
+            if wait:
+                fut.result()
+            return fut
+
+    def _compact_sync(self, serving: _IndexServing) -> int:
+        name = serving.name
+        gen = self._pin(name)
+        try:
+            h: _Handle = gen.handle
+            st = h.state
+            ticket = st.begin_compaction()
+            if ticket is None:
+                return self.registry.version(name)
+            with obs.span("serve.compact_build", index=name,
+                          rows=ticket.count):
+                new_index, new_raw = _extend_index(
+                    h, ticket.vectors, ticket.int_ids)
+                # extend keeps n_lists, so the resolved params stay
+                # valid; the raw user params ride along for later swaps
+                new_h = _Handle(h.algo, new_index, st, h.search_params,
+                                h.build_params, h.refine_ratio, new_raw,
+                                user_search_params=h.user_search_params)
+                if serving.warmup_enabled:
+                    serving.warmup_handle(new_h)
+                # commit + publish under the mutation lock: a dispatcher
+                # pins (generation, state) as a consistent pair, so the
+                # side-buffer shift and the extended index appear
+                # atomically. self._lock nests inside (never the reverse
+                # order anywhere), serializing against close().
+                with st.lock, self._lock:
+                    if self._closed:
+                        obs.event("compaction_aborted", index=name,
+                                  reason="server_closed")
+                        return self.registry.version(name)
+                    if self.registry.get(name) is not gen:
+                        # a content swap superseded the generation this
+                        # extend was built from — publishing would revert
+                        # it to pre-swap data. Abort; the swap reset the
+                        # overlay, so the snapshot is moot.
+                        obs.event("compaction_aborted", index=name,
+                                  reason="superseded_by_swap")
+                        return self.registry.version(name)
+                    st.commit_compaction(ticket)
+                    v = self.registry.publish(name, new_h).version
+                obs.counter("serve.compactions_total", index=name)
+                return v
+        finally:
+            gen.release()
+
+    # -- hot swap ----------------------------------------------------------
+
+    def swap(self, name: str = "default", *, dataset=None, prebuilt=None,
+             path=None, algo: Optional[str] = None, build_params=None,
+             search_params=None, ids=None,
+             refine_ratio: Optional[int] = None,
+             wait: bool = False) -> Future:
+        """Replace ``name``'s content with a freshly built/loaded index —
+        in the background, then one atomic generation swap. In-flight
+        batches finish on the old generation; it drains (and frees) when
+        their pins drop. Exactly one of ``dataset`` (in-process build),
+        ``prebuilt`` (an already-built index object), or ``path``
+        (``core/serialize`` snapshot). The kwarg is ``prebuilt``, NOT
+        ``index``, on purpose: every other Server method spells the
+        index *name* ``index=``, so an ``index=`` here would make the
+        habitual ``srv.swap(index="vectors")`` silently target
+        "default" and hand the name string to the build thread.
+
+        The mutable overlay RESETS with the new content (a swap is a
+        wholesale replacement; use :meth:`compact` to fold mutations in
+        instead)."""
+        with obs.span("serve.swap", index=name):
+            serving = self._serving(name)
+            # pin for the handle read: an unpinned registry.get().handle
+            # races a concurrent swap's drain (handle nulled) and raises
+            # AttributeError after close() instead of KeyError. The local
+            # `h` keeps the _Handle itself alive for the build thread.
+            cur = self._pin(name)
+            try:
+                h: _Handle = cur.handle
+            finally:
+                cur.release()
+            a = algo or h.algo
+            fut: Future = Future()
+
+            def _run():
+                try:
+                    if path is not None:
+                        new_index = _ALGO_MODULES[a].load(path)
+                        ds = None
+                    elif prebuilt is not None:
+                        new_index, ds = prebuilt, dataset
+                    else:
+                        ds = np.ascontiguousarray(np.asarray(dataset),
+                                                  dtype=np.float32)
+                        new_index = _build_index(
+                            a, ds, build_params
+                            if build_params is not None else h.build_params)
+                    rows = _index_rows(a, new_index)
+                    dim = _index_dim(a, new_index)
+                    state = MutableState(
+                        rows, dim, np.float32, ext_ids=ids,
+                        side_capacity=self.params.side_capacity)
+                    # inherit the caller's RAW params (not the resolved
+                    # ones): defaulted n_probes = n_lists must be
+                    # re-derived from the NEW index, or a swap to a
+                    # bigger dataset silently clamps probing at the old
+                    # index's n_lists and serves non-exhaustive results
+                    sp_user = (search_params if search_params is not None
+                               else h.user_search_params
+                               if a == h.algo else None)
+                    new_h = _Handle(
+                        a, new_index, state,
+                        _default_search_params(a, new_index, sp_user),
+                        build_params if build_params is not None
+                        else h.build_params,
+                        refine_ratio if refine_ratio is not None
+                        else h.refine_ratio,
+                        _raw_dataset(a, new_index, ds),
+                        user_search_params=sp_user)
+                    if serving.warmup_enabled:
+                        serving.warmup_handle(new_h)
+                    gen = self._publish_guarded(name, new_h)
+                    fut.set_result(gen.version)
+                except BaseException as e:  # noqa: BLE001 — handed to the future; classified for obs/flight
+                    _rerrors.classify(e)
+                    fut.set_exception(e)
+
+            t = threading.Thread(target=_run, daemon=True,
+                                 name=f"raft-tpu-serve-swap-{name}")
+            t.start()
+            if wait:
+                fut.result()
+            return fut
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def warmup(self, index: str = "default") -> int:
+        """(Re)trace the serving ladder for ``index``'s current
+        generation; returns the number of shapes warmed."""
+        with obs.span("serve.warmup_entry", index=index):
+            serving = self._serving(index)
+            # pinned: the generation cannot drain (and null its handle)
+            # while the warmup sweep is tracing against it
+            gen = self._pin(index)
+            try:
+                return serving.warmup_handle(gen.handle)
+            finally:
+                gen.release()
+
+    def generation(self, index: str = "default") -> int:
+        return self.registry.version(index)
+
+    def stats(self, index: str = "default") -> dict:
+        gen = self.registry.get(index)
+        serving = self._servings.get(index)
+        handle = gen.handle if gen is not None else None  # single read: a
+        #                       concurrent drain nulls it between accesses
+        st = handle.state if handle is not None else None
+        return {
+            "generation": self.registry.version(index),
+            "queue_rows": serving.batcher.depth_rows() if serving else 0,
+            "bucket_ceiling": serving.batcher.ceiling if serving else 0,
+            "ladder": list(serving.batcher.ladder) if serving else [],
+            "live_rows": st.live_rows() if st else 0,
+            "tombstoned_rows": st.deleted_rows() if st else 0,
+            "side_rows": st.side_rows_live() if st else 0,
+            "generations_live": len(self.registry.live_generations()),
+        }
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop admissions, drain every queue, retire every index."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            servings = list(self._servings.values())
+        for s in servings:
+            s.batcher.close(timeout_s=timeout_s)
+        for name in self.registry.names():
+            self.registry.drop(name)
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def _serving(self, name: str) -> _IndexServing:
+        with self._lock:
+            s = self._servings.get(name)
+        if s is None:
+            raise KeyError(
+                f"no index named {name!r}; create_index/add_index first")
+        return s
+
+    def _pin(self, name: str):
+        """Pin ``name``'s current generation, diagnosing a closed server
+        correctly: close() drops every registry name, so a bare
+        registry.pin after close raises KeyError claiming the index was
+        never published — the truthful, fail-fast signal is 'server is
+        closed' (the submit path's Overloaded(reason="closed")
+        analog for the mutation/warmup entry points)."""
+        try:
+            return self.registry.pin(name)
+        except KeyError:
+            if self._closed:
+                raise RuntimeError("server is closed") from None
+            raise
+
+
+# ---------------------------------------------------------------------------
+# per-algo construction adapters
+# ---------------------------------------------------------------------------
+
+_ALGO_MODULES = {
+    "brute_force": brute_force,
+    "ivf_flat": ivf_flat,
+    "ivf_pq": ivf_pq,
+    "cagra": cagra,
+}
+
+
+def _build_index(algo: str, dataset: np.ndarray, build_params):
+    if algo == "brute_force":
+        if build_params is None:
+            return brute_force.build(dataset)
+        return brute_force.build(dataset, metric=build_params.metric,
+                                 metric_arg=build_params.metric_arg)
+    if build_params is None:
+        n = dataset.shape[0]
+        if algo == "ivf_flat":
+            build_params = ivf_flat.IndexParams(
+                n_lists=max(1, min(64, n // 32)))
+        elif algo == "ivf_pq":
+            build_params = ivf_pq.IndexParams(
+                n_lists=max(1, min(64, n // 32)))
+        else:
+            build_params = cagra.IndexParams()
+    return _ALGO_MODULES[algo].build(build_params, dataset)
+
+
+def _default_search_params(algo: str, index, search_params):
+    if search_params is not None:
+        return search_params
+    if algo == "ivf_flat":
+        # serving default: exhaustive probing — exact recall over the
+        # tombstone-filtered index, the contract the correctness
+        # acceptance tests pin; drop n_probes for throughput
+        return ivf_flat.SearchParams(n_probes=index.n_lists,
+                                     compute_dtype="f32",
+                                     local_recall_target=1.0)
+    if algo == "ivf_pq":
+        return ivf_pq.SearchParams(n_probes=index.n_lists,
+                                   local_recall_target=1.0)
+    if algo == "cagra":
+        return cagra.SearchParams(itopk_size=128)
+    return None
+
+
+def _raw_dataset(algo: str, index, dataset: Optional[np.ndarray]):
+    """The raw row store serving keeps for refine + graph rebuilds,
+    indexed by internal id. brute_force/cagra carry it on the index."""
+    if algo in ("brute_force", "cagra"):
+        return np.asarray(index.dataset)
+    return dataset
+
+
+def _extend_index(h: _Handle, vectors: np.ndarray, int_ids: np.ndarray):
+    """Compaction build: fold side rows into the main index. ivf_* use
+    the module ``extend``; brute_force/cagra (positional ids) rebuild
+    over the concatenated row store. Returns (new_index, new_raw)."""
+    algo = h.algo
+    if algo == "ivf_flat":
+        new = ivf_flat.extend(h.index, vectors,
+                              int_ids.astype(np.int32))
+        raw = None if h.raw_dataset is None else np.concatenate(
+            [h.raw_dataset, vectors], axis=0)
+        return new, raw
+    if algo == "ivf_pq":
+        new = ivf_pq.extend(h.index, vectors, int_ids.astype(np.int32))
+        raw = None if h.raw_dataset is None else np.concatenate(
+            [h.raw_dataset, vectors], axis=0)
+        return new, raw
+    full = np.concatenate([np.asarray(h.raw_dataset), vectors], axis=0)
+    if algo == "brute_force":
+        return brute_force.build(full, metric=h.metric,
+                                 metric_arg=h.index.metric_arg), full
+    params = h.build_params or cagra.IndexParams()
+    return cagra.build(params, full), full

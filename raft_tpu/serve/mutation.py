@@ -1,0 +1,422 @@
+"""Tombstone mutation: delete/upsert over a live index without rebuilds.
+
+The mutation model (docs/serving.md §4): the served index itself is
+immutable (generations swap atomically — :mod:`raft_tpu.serve.registry`);
+mutability is layered on top as
+
+* a **tombstone keep-mask** (:class:`raft_tpu.core.bitset.Bitset`
+  semantics, maintained host-side as a dense bool array and lowered to
+  packed device words on demand) composed with any user ``prefilter``
+  and fed to the existing filtered-search paths of every index type;
+* an **upsert side-buffer**: new/replacement vectors accumulate in a
+  small brute-force-searched buffer (padded to a power-of-two capacity
+  so its traces are stable) whose per-batch results are merged into the
+  main index's via ``merge_topk`` — FusionANNS' delta-store shape;
+* a **compaction** step: past a threshold the engine folds the side
+  buffer into the main index with a background ``extend`` + hot-swap.
+
+Ids: callers speak **external ids**; internally every row ever admitted
+gets a fresh monotonically-increasing **internal id** (never reused), so
+a replaced row and its replacement coexist under different internal ids
+and the tombstone mask can hide exactly the old one. While no upsert has
+ever happened the two spaces are identical and the translation layer is
+skipped entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.utils.math import next_pow2
+
+
+def _dense_from_bitset_host(bits_words: np.ndarray, n_bits: int) -> np.ndarray:
+    """Host-side unpack of packed uint32 filter words to dense bool."""
+    w = bits_words.astype(np.uint32, copy=False)
+    idx = np.arange(n_bits)
+    return ((w[idx // 32] >> (idx % 32)) & 1).astype(bool)
+
+
+def _pow2_ceil(n: int) -> int:
+    # next_pow2 maps 0 -> 1 (a ladder rung); an empty id space stays 0
+    return next_pow2(n) if n else 0
+
+
+class CompactionTicket:
+    """Snapshot handed to the background compactor: the side rows (and
+    their internal ids) that the new generation's ``extend`` will fold
+    in. Mutations arriving while the build runs keep editing the live
+    state; the tombstone mask is shared, so a delete of a snapshotted
+    row simply holds its keep-bit down across the swap."""
+
+    __slots__ = ("base_ids", "count", "vectors", "int_ids")
+
+    def __init__(self, base_ids: int, count: int, vectors: np.ndarray,
+                 int_ids: np.ndarray):
+        self.base_ids = base_ids
+        self.count = count
+        self.vectors = vectors
+        self.int_ids = int_ids
+
+
+class MutableState:
+    """The mutable overlay of one named index: tombstones, the side
+    buffer, and the external↔internal id maps. Thread-safe. The overlay
+    is carried across *compaction* swaps (the extended generation keeps
+    this object, so tombstones and post-snapshot upserts survive), but a
+    *content* swap (:meth:`Server.swap` — new dataset, new id space)
+    installs a fresh overlay: deletes and upserts against the old
+    content do not apply to the replacement."""
+
+    def __init__(self, n_rows: int, dim: int, dtype,
+                 ext_ids: Optional[np.ndarray] = None,
+                 side_capacity: int = 256):
+        self.lock = threading.RLock()
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.base_ids = int(n_rows)          # internal ids [0, base_ids)
+        self.next_int = int(n_rows)
+        self.seq = 0                          # bumped on every mutation
+        self.side_seq = 0                     # bumped only when side-buffer
+        #                                       CONTENT changes (append /
+        #                                       compaction shift) — keys the
+        #                                       engine's side-index cache so
+        #                                       base-row deletes don't force
+        #                                       a side rebuild
+        # keep-mask over internal ids [0, next_int): True = live
+        self._keep = np.ones(max(n_rows, 1), dtype=bool)
+        if n_rows == 0:
+            self._keep = self._keep[:0]
+        # side buffer (allocated on first upsert)
+        self.side_capacity_hint = int(side_capacity)
+        self.side_cap = 0
+        self.side_used = 0
+        self.side_vecs: Optional[np.ndarray] = None
+        self.side_int: Optional[np.ndarray] = None   # internal id per slot
+        self._side_keep: Optional[np.ndarray] = None
+        # id translation (None while external == internal)
+        self._ext2int: Optional[Dict[int, int]] = None
+        self._int2ext: Optional[np.ndarray] = None
+        if ext_ids is not None:
+            ext_ids = np.asarray(ext_ids, dtype=np.int64)
+            if ext_ids.shape != (n_rows,):
+                raise ValueError("ext_ids must be [n_rows]")
+            if not np.array_equal(ext_ids, np.arange(n_rows)):
+                self._install_translation(ext_ids)
+        # packed-device caches (rebuilt lazily per seq)
+        self._dev_cache: Dict[object, Tuple[int, object]] = {}
+
+    # -- id translation ----------------------------------------------------
+
+    def _install_translation(self, ext_ids: Optional[np.ndarray] = None):
+        if self._ext2int is not None:
+            return
+        if ext_ids is None:
+            ext_ids = np.arange(self.next_int, dtype=np.int64)
+        self._int2ext = ext_ids.copy()
+        # only LIVE rows get a forward mapping: ids deleted back in
+        # identity mode must stay deleted (to_internal → None), not be
+        # resurrected by the switch to explicit translation
+        self._ext2int = {int(e): i for i, e in enumerate(ext_ids)
+                         if i >= self._keep.shape[0] or self._keep[i]}
+
+    @property
+    def has_translation(self) -> bool:
+        return self._ext2int is not None
+
+    def to_internal(self, ext_id: int) -> Optional[int]:
+        """Live internal id for ``ext_id`` (None when absent/deleted)."""
+        with self.lock:
+            if self._ext2int is None:
+                i = int(ext_id)
+                return i if 0 <= i < self.next_int and self._keep[i] \
+                    else None
+            return self._ext2int.get(int(ext_id))
+
+    def translate_out(self, internal_ids: np.ndarray) -> np.ndarray:
+        """Map result internal ids back to external (-1 passes through)."""
+        with self.lock:
+            if self._int2ext is None:
+                return internal_ids
+            out = np.where(
+                internal_ids >= 0,
+                self._int2ext[np.clip(internal_ids, 0,
+                                      self._int2ext.shape[0] - 1)],
+                np.int64(-1),
+            )
+            return out
+
+    # -- mutation ----------------------------------------------------------
+
+    def delete(self, ext_ids) -> int:  # graft-lint: allow-unspanned-entry state layer; Server.delete opens the serve.delete entry span around this
+        """Tombstone ``ext_ids``; returns how many were live. Idempotent:
+        already-deleted / never-seen ids are skipped."""
+        ext_ids = np.atleast_1d(np.asarray(ext_ids)).astype(np.int64)
+        n = 0
+        with self.lock:
+            for e in ext_ids:
+                i = self._to_internal_locked(int(e))
+                if i is None:
+                    continue
+                self._keep[i] = False
+                if i >= self.base_ids and self.side_used:
+                    slots = np.nonzero(self.side_int[:self.side_used] == i)[0]
+                    if slots.size:
+                        self._side_keep[slots] = False
+                if self._ext2int is not None:
+                    self._ext2int.pop(int(e), None)
+                n += 1
+            if n:
+                self.seq += 1
+        return n
+
+    def _to_internal_locked(self, ext_id: int) -> Optional[int]:
+        if self._ext2int is None:
+            i = ext_id
+            return i if 0 <= i < self.next_int and self._keep[i] else None
+        return self._ext2int.get(ext_id)
+
+    def upsert(self, vectors: np.ndarray, ext_ids) -> Tuple[int, bool]:  # graft-lint: allow-unspanned-entry state layer; Server.upsert opens the serve.upsert entry span around this
+        """Insert-or-replace ``vectors`` under ``ext_ids``. Returns
+        ``(side_rows_now, shape_grew)`` — the engine compacts past its
+        threshold and re-warms when a traced shape grew (the side
+        capacity, or the filter capacity rung of
+        :meth:`filter_capacity`)."""
+        vectors = np.asarray(vectors, dtype=self.dtype)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        ext_ids = np.atleast_1d(np.asarray(ext_ids)).astype(np.int64)
+        if vectors.shape[0] != ext_ids.shape[0]:
+            raise ValueError("vectors and ids row counts differ")
+        if vectors.shape[1] != self.dim:
+            raise ValueError(
+                f"vector dim {vectors.shape[1]} != index dim {self.dim}")
+        grew = False
+        with self.lock:
+            cap0 = self._filter_capacity_locked()
+            # upserts break the identity assumption the moment a fresh
+            # internal id stands in for an external one
+            self._install_translation()
+            for v, e in zip(vectors, ext_ids):
+                old = self._ext2int.get(int(e))
+                if old is not None:
+                    self._keep[old] = False
+                    if old >= self.base_ids and self.side_used:
+                        slots = np.nonzero(
+                            self.side_int[:self.side_used] == old)[0]
+                        if slots.size:
+                            self._side_keep[slots] = False
+                i = self.next_int
+                self.next_int += 1
+                if self._keep.shape[0] < self.next_int:
+                    extra = max(self._keep.shape[0], 64)
+                    self._keep = np.concatenate(
+                        [self._keep, np.zeros(extra, dtype=bool)])
+                self._keep[i] = True
+                if self._int2ext.shape[0] < self.next_int:
+                    extra = max(self._int2ext.shape[0], 64)
+                    self._int2ext = np.concatenate(
+                        [self._int2ext, np.full(extra, -1, np.int64)])
+                self._int2ext[i] = int(e)
+                self._ext2int[int(e)] = i
+                grew |= self._side_append(v, i)
+            grew |= self._filter_capacity_locked() != cap0
+            self.seq += 1
+            return self.side_used, grew
+
+    def _side_append(self, vec: np.ndarray, internal_id: int) -> bool:
+        grew = False
+        if self.side_vecs is None or self.side_used >= self.side_cap:
+            new_cap = next_pow2(max(self.side_capacity_hint,
+                                    1 if self.side_cap == 0
+                                    else self.side_cap * 2))
+            vecs = np.zeros((new_cap, self.dim), self.dtype)
+            ints = np.full(new_cap, -1, np.int64)
+            keep = np.zeros(new_cap, dtype=bool)
+            if self.side_vecs is not None:
+                vecs[:self.side_used] = self.side_vecs[:self.side_used]
+                ints[:self.side_used] = self.side_int[:self.side_used]
+                keep[:self.side_used] = self._side_keep[:self.side_used]
+            self.side_vecs, self.side_int, self._side_keep = vecs, ints, keep
+            self.side_cap = new_cap
+            grew = True
+        s = self.side_used
+        self.side_vecs[s] = vec
+        self.side_int[s] = internal_id
+        self._side_keep[s] = True
+        self.side_used += 1
+        self.side_seq += 1
+        return grew
+
+    # -- filters (device views) -------------------------------------------
+
+    _DEV_CACHE_MAX = 32
+
+    def _cached(self, key, build, pin=None):
+        """Mutation-seq-keyed device-view cache. ``pin`` holds a strong
+        reference to the object whose ``id()`` is part of ``key`` — while
+        the entry lives, CPython cannot reuse that address for a new
+        filter, so identity keying is safe. Bounded: stale-seq entries
+        are evicted first, then oldest-inserted, so per-request filters
+        cannot grow device memory without bound."""
+        with self.lock:
+            hit = self._dev_cache.get(key)
+            if hit is not None and hit[0] == self.seq:
+                return hit[1]
+            val = build()
+            self._dev_cache[key] = (self.seq, val, pin)
+            if len(self._dev_cache) > self._DEV_CACHE_MAX:
+                stale = [k for k, v in self._dev_cache.items()
+                         if v[0] != self.seq]
+                for k in stale:
+                    del self._dev_cache[k]
+                while len(self._dev_cache) > self._DEV_CACHE_MAX:
+                    self._dev_cache.pop(next(iter(self._dev_cache)))
+            return val
+
+    def _filter_capacity_locked(self) -> int:
+        return _pow2_ceil(self.next_int)
+
+    def filter_capacity(self) -> int:
+        """``n_bits`` of every device filter this state hands out: the
+        next power of two ≥ ``next_int``. ``n_bits`` (and the packed
+        word count behind it) is a STATIC argument of every filtered
+        search kernel, so growing it per upsert would retrace each
+        (bucket, k) shape on every single upsert — the pow2 ladder makes
+        it step only when ``next_int`` crosses a boundary, and
+        :meth:`upsert` reports that crossing as ``shape_grew`` so the
+        engine re-warms. Pad bits cover ids no index row ever carries
+        (main sample ids < base_ids ≤ next_int), so their value is
+        inert; they are left 0."""
+        with self.lock:
+            return self._filter_capacity_locked()
+
+    def tombstone_bits(self) -> Bitset:
+        """The packed device keep-mask over internal ids [0, next_int)
+        (every id the main index OR the side buffer can produce),
+        zero-padded to the stable :meth:`filter_capacity` rung."""
+        def _build():
+            with self.lock:
+                n = self.next_int
+                dense = np.zeros(self._filter_capacity_locked(),
+                                 dtype=bool)
+                dense[:n] = self._keep[:n]
+            return Bitset.from_dense(dense)
+        return self._cached("tomb", _build)
+
+    def side_keep_bits(self) -> Optional[Bitset]:
+        """Keep-mask over side-buffer SLOTS (pad + dead slots dropped)."""
+        if self.side_cap == 0:
+            return None
+
+        def _build():
+            with self.lock:
+                dense = self._side_keep.copy()
+            return Bitset.from_dense(dense)
+        return self._cached("side_keep", _build)
+
+    def compose_user_filter(self, filt) -> Tuple[Bitset, Optional[Bitset]]:
+        """Compose a user prefilter (over EXTERNAL ids, honoring its
+        ``out_of_range`` mode) with the tombstone mask. Returns
+        ``(main_bits, side_bits)`` device bitsets — main over internal
+        ids (padded to :meth:`filter_capacity`), side over side slots.
+        Cached per (filter identity, filter content version, mutation
+        seq): the host-side translation pass is paid once per filter per
+        mutation epoch, not per batch, and an in-place ``set``/``flip``/
+        ``resize`` of the user's Bitset bumps its version so the stale
+        composition is never served."""
+        bitset = getattr(filt, "bitset", filt)
+        oor = getattr(filt, "out_of_range", "drop")
+        # safe: _cached pins `bitset`, so its id cannot be reused while
+        # the entry lives, and _version tracks in-place mutation
+        key = ("user", id(bitset), getattr(bitset, "_version", 0), oor)
+
+        def _build():
+            user_words = np.asarray(bitset.bits)
+            user_n = int(bitset.n_bits)
+            with self.lock:
+                n = self.next_int
+                cap = self._filter_capacity_locked()
+                keep = self._keep[:n].copy()
+                int2ext = None if self._int2ext is None \
+                    else self._int2ext[:n].copy()
+                side_cap, side_used = self.side_cap, self.side_used
+                side_keep = None if self._side_keep is None \
+                    else self._side_keep.copy()
+                side_int = None if self.side_int is None \
+                    else self.side_int.copy()
+            ext = np.arange(n, dtype=np.int64) if int2ext is None \
+                else int2ext
+            in_range = (ext >= 0) & (ext < user_n)
+            user_dense = np.zeros(n, dtype=bool)
+            if user_n:
+                safe = np.clip(ext, 0, user_n - 1)
+                user_dense = _dense_from_bitset_host(user_words, user_n)[safe]
+            user_keep = np.where(in_range, user_dense, oor == "keep")
+            main_dense = np.zeros(cap, dtype=bool)
+            main_dense[:n] = keep & user_keep
+            main = Bitset.from_dense(main_dense)
+            side = None
+            if side_cap:
+                slot_user = np.zeros(side_cap, dtype=bool)
+                live = side_int[:side_used]
+                slot_user[:side_used] = user_keep[
+                    np.clip(live, 0, n - 1)] & (live >= 0)
+                side = Bitset.from_dense(side_keep & slot_user)
+            return main, side
+        return self._cached(key, _build, pin=bitset)
+
+    # -- accounting --------------------------------------------------------
+
+    def live_rows(self) -> int:
+        with self.lock:
+            return int(self._keep[:self.next_int].sum())
+
+    def deleted_rows(self) -> int:
+        with self.lock:
+            return int(self.next_int - self._keep[:self.next_int].sum())
+
+    def side_rows_live(self) -> int:
+        with self.lock:
+            if self._side_keep is None:
+                return 0
+            return int(self._side_keep[:self.side_used].sum())
+
+    # -- compaction --------------------------------------------------------
+
+    def begin_compaction(self) -> Optional[CompactionTicket]:
+        """Snapshot the current side rows for a background extend."""
+        with self.lock:
+            if self.side_used == 0:
+                return None
+            s0 = self.side_used
+            return CompactionTicket(
+                base_ids=self.base_ids,
+                count=s0,
+                vectors=self.side_vecs[:s0].copy(),
+                int_ids=self.side_int[:s0].copy(),
+            )
+
+    def commit_compaction(self, ticket: CompactionTicket) -> None:
+        """Fold the snapshotted rows into the base id range and shift the
+        side tail left. Runs under the mutation lock at swap time; the
+        shared keep-mask already reflects any deletes that landed while
+        the extend was building."""
+        with self.lock:
+            s0 = ticket.count
+            tail = self.side_used - s0
+            if tail > 0:
+                self.side_vecs[:tail] = self.side_vecs[s0:self.side_used]
+                self.side_int[:tail] = self.side_int[s0:self.side_used]
+                self._side_keep[:tail] = self._side_keep[s0:self.side_used]
+            self.side_used = max(tail, 0)
+            self.side_vecs[self.side_used:] = 0
+            self.side_int[self.side_used:] = -1
+            self._side_keep[self.side_used:] = False
+            self.base_ids = ticket.base_ids + s0
+            self.seq += 1
+            self.side_seq += 1

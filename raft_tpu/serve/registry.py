@@ -1,0 +1,186 @@
+"""Versioned index registry: named indexes, generations, atomic hot-swap.
+
+The serving invariant (docs/serving.md §3): a batch answers from exactly
+ONE generation. Each named index points at its current
+:class:`Generation`; a swap publishes a fully-built successor and
+atomically redirects the name. In-flight batches keep a **pin**
+(refcount) on the generation they started with and finish on it; the
+retired generation is released — its drain event fires — only when the
+last pin drops, which is when its device arrays become collectable.
+This is the reference's ``raft::resources``-lifetime discipline applied
+to whole indexes, and the serving-side analog of
+``core/serialize``'s versioned index files (a generation can be
+published from one).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from raft_tpu import obs
+
+
+class Generation:
+    """One immutable published version of a named index.
+
+    ``handle`` is the engine's serving state (index + searcher +
+    shapes); the registry only manages identity and lifetime. Pins are
+    taken via :meth:`Registry.pin` (atomic with the name lookup) and
+    dropped with :meth:`release`; after :meth:`retire`, the final
+    release fires ``drained`` and the ``on_drain`` callbacks.
+    """
+
+    __slots__ = ("name", "version", "handle", "drained", "_refs",
+                 "_retired", "_lock", "_on_drain")
+
+    def __init__(self, name: str, version: int, handle):
+        self.name = name
+        self.version = int(version)
+        self.handle = handle
+        self.drained = threading.Event()
+        self._refs = 0
+        self._retired = False
+        self._lock = threading.Lock()
+        self._on_drain: List[Callable[["Generation"], None]] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Generation({self.name!r}, v{self.version}, "
+                f"refs={self._refs}, retired={self._retired})")
+
+    @property
+    def refs(self) -> int:
+        return self._refs
+
+    @property
+    def retired(self) -> bool:
+        return self._retired
+
+    def _pin_locked(self) -> "Generation":
+        self._refs += 1
+        return self
+
+    def release(self) -> None:
+        """Drop one pin; the last release of a retired generation drains
+        it (fires ``drained`` + callbacks, drops the handle)."""
+        fire = False
+        with self._lock:
+            self._refs -= 1
+            if self._refs <= 0 and self._retired and \
+                    not self.drained.is_set():
+                fire = True
+        if fire:
+            self._drain()
+
+    def retire(self) -> None:
+        """Mark superseded; drains immediately if nothing has it pinned."""
+        with self._lock:
+            self._retired = True
+            fire = self._refs <= 0 and not self.drained.is_set()
+        if fire:
+            self._drain()
+
+    def add_on_drain(self, cb: Callable[["Generation"], None]) -> None:
+        with self._lock:
+            if not self.drained.is_set():
+                self._on_drain.append(cb)
+                return
+        # already drained: invoke OUTSIDE the lock, matching _drain's
+        # contract — a callback touching release()/retire() would
+        # deadlock on the non-reentrant lock otherwise
+        cb(self)
+
+    def _drain(self) -> None:
+        obs.counter("serve.generations_drained", index=self.name)
+        obs.event("generation_drained", index=self.name,
+                  version=self.version)
+        for cb in list(self._on_drain):
+            cb(self)
+        self._on_drain.clear()
+        # the handle holds the device arrays; dropping the reference here
+        # is what actually returns the old generation's HBM once callers
+        # holding pins are gone
+        self.handle = None
+        self.drained.set()
+
+
+class Registry:
+    """Name → current :class:`Generation`, with monotone versions.
+
+    All transitions go through :meth:`publish` (atomic swap) and
+    :meth:`pin` (atomic current-lookup + refcount) under one lock, so a
+    reader can never observe a half-swapped index: it either pins the
+    old generation (and the old generation survives, whole, until that
+    pin drops) or the new one.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current: Dict[str, Generation] = {}
+        self._versions: Dict[str, int] = {}
+        self._live: List[Generation] = []   # published, not yet drained
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._current)
+
+    def get(self, name: str) -> Optional[Generation]:
+        """The current generation (NOT pinned — introspection only)."""
+        with self._lock:
+            return self._current.get(name)
+
+    def version(self, name: str) -> int:
+        with self._lock:
+            return self._versions.get(name, 0)
+
+    def pin(self, name: str) -> Generation:
+        """Atomically look up the current generation and take a pin on
+        it. Callers MUST :meth:`Generation.release` when their batch
+        completes (success or failure)."""
+        with self._lock:
+            gen = self._current.get(name)
+            if gen is None:
+                raise KeyError(f"no index published under {name!r}")
+            with gen._lock:
+                return gen._pin_locked()
+
+    def publish(self, name: str, handle,
+                on_drain: Optional[Callable] = None) -> Generation:
+        """Atomically swap ``name`` to a new generation wrapping
+        ``handle``; the previous generation (if any) is retired and
+        drains when its last pin drops. Returns the new generation."""
+        with obs.span("serve.publish", index=name):
+            with self._lock:
+                v = self._versions.get(name, 0) + 1
+                self._versions[name] = v
+                gen = Generation(name, v, handle)
+                if on_drain is not None:
+                    gen.add_on_drain(on_drain)
+                old = self._current.get(name)
+                self._current[name] = gen
+                self._live = [g for g in self._live
+                              if not g.drained.is_set()]
+                self._live.append(gen)
+                # counted under the lock: a concurrent publish reassigns
+                # _live, so an off-lock comprehension would read a list
+                # from neither consistent state
+                live_n = len(self._live)
+            if old is not None:
+                old.retire()
+            obs.counter("serve.swaps_total", index=name)
+            obs.gauge("serve.generation", v, index=name)
+            obs.gauge("serve.generations_live", live_n)
+            obs.event("generation_published", index=name, version=v)
+            return gen
+
+    def drop(self, name: str) -> None:
+        """Unpublish ``name`` (retire its current generation)."""
+        with self._lock:
+            old = self._current.pop(name, None)
+        if old is not None:
+            old.retire()
+
+    def live_generations(self) -> List[Generation]:
+        with self._lock:
+            self._live = [g for g in self._live if not g.drained.is_set()]
+            return list(self._live)

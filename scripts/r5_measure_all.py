@@ -15,7 +15,10 @@ Stages (artifact, rough budget):
   7. dispatch_tables  — raft_tpu/tuning/tables/tpu.json (~15 min)
 
 Run: python scripts/r5_measure_all.py [--only stage1,stage2] [--skip ...]
-                                      [--obs-snapshot]
+                                      [--obs-snapshot] [--serve]
+
+--serve appends the optional graft-serve load-generator stage
+(scripts/serve_loadgen.py -> SERVE_r05.json; docs/serving.md §7).
 Progress + per-stage rc stream to stdout and R5_MEASURE_STATUS.json.
 
 --obs-snapshot runs every stage instrumented (RAFT_TPU_OBS=flight in the
@@ -56,6 +59,17 @@ STAGES = [
      [PY, "scripts/capture_dispatch_tables.py", "--full"], 1800),
 ]
 
+# OPTIONAL stages (run with --serve, or name them in --only): the
+# graft-serve closed-loop load generator — SERVE_r05.json latency/
+# throughput sidecar + obs metrics snapshot (docs/serving.md §7)
+OPTIONAL_STAGES = [
+    ("serve_loadgen",
+     [PY, "scripts/serve_loadgen.py", "--n", "200000", "--dim", "96",
+      "--algo", "ivf_flat", "--concurrency", "32", "--duration-s", "60",
+      "--k", "1,10,100", "--out", "SERVE_r05.json",
+      "--obs-snapshot", "SERVE_r05.obs.json"], 900),
+]
+
 
 def main():
     sys.path.insert(0, ROOT)
@@ -90,7 +104,12 @@ def main():
         return 1
     print(f"TPU up: {detail}", flush=True)
 
-    for name, argv, tmo in STAGES:
+    stages = list(STAGES)
+    if "--serve" in sys.argv or (
+            only is not None
+            and any(n in only for n, _, _ in OPTIONAL_STAGES)):
+        stages += OPTIONAL_STAGES
+    for name, argv, tmo in stages:
         if only is not None and name not in only:
             continue
         if skip is not None and name in skip:

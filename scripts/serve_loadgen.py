@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the graft-serve engine (ISSUE 5).
+
+Builds an index, stands up a :class:`raft_tpu.serve.Server`, and drives
+it with ``--concurrency`` worker threads in closed loop (each worker
+submits, waits, submits again) or at a target open-loop ``--qps``;
+requests draw k uniformly from the mixed ``--k`` list and optionally
+carry a delete/upsert mutation mix. Emits a latency/throughput sidecar
+(default ``SERVE_r05.json``):
+
+    {"config": {...}, "throughput_qps": ..., "completed": ...,
+     "rejected": ..., "latency_ms": {"p50": ..., "p90": ..., "p99": ...},
+     "per_k": {...}, "server": {...}}
+
+``--obs-snapshot PATH`` additionally turns graft-scope on and writes the
+full metrics snapshot (queue depth, per-bucket fill/latency histograms,
+admission rejects, swap counts — docs/serving.md §7) next to it.
+
+Wired as the optional ``serve_loadgen`` stage of
+``scripts/r5_measure_all.py`` (pass ``--serve`` there, or select it with
+``--only serve_loadgen``).
+
+Examples:
+    python scripts/serve_loadgen.py --n 20000 --dim 64 --algo ivf_flat \
+        --concurrency 16 --duration-s 10 --k 1,10,32
+    python scripts/serve_loadgen.py --qps 500 --swap-mid-run \
+        --obs-snapshot SERVE_r05.obs.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _percentiles(lat_ms):
+    if not lat_ms:
+        return {}
+    a = np.asarray(lat_ms)
+    return {
+        "mean": round(float(a.mean()), 3),
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p90": round(float(np.percentile(a, 90)), 3),
+        "p99": round(float(np.percentile(a, 99)), 3),
+        "max": round(float(a.max()), 3),
+        "n": int(a.size),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=20000, help="index rows")
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--algo", default="brute_force",
+                    choices=["brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop worker threads")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="target aggregate QPS (0 = closed loop, no pacing)")
+    ap.add_argument("--duration-s", type=float, default=5.0)
+    ap.add_argument("--requests", type=int, default=0,
+                    help="stop after N completed requests; a time "
+                         "failsafe of max(--duration-s, 60s) still "
+                         "bounds the run so persistent rejects/errors "
+                         "cannot hang it")
+    ap.add_argument("--k", default="1,10,32",
+                    help="comma list; each request draws one uniformly")
+    ap.add_argument("--max-batch-rows", type=int, default=128)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue-rows", type=int, default=2048)
+    ap.add_argument("--delete-every", type=int, default=0,
+                    help="every Nth completed request also deletes one id")
+    ap.add_argument("--upsert-every", type=int, default=0,
+                    help="every Nth completed request also upserts one row")
+    ap.add_argument("--swap-mid-run", action="store_true",
+                    help="trigger one background rebuild+hot-swap halfway")
+    ap.add_argument("--out", default="SERVE_r05.json")
+    ap.add_argument("--obs-snapshot", default=None,
+                    help="also write the graft-scope metrics snapshot here")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from raft_tpu import obs, serve
+
+    if args.obs_snapshot and obs.mode() == "off":
+        # the snapshot needs metrics recording, but an env-selected mode
+        # must win: r5_measure_all runs this stage under RAFT_TPU_OBS=
+        # flight so a classified fatal mid-run leaves a flight dump —
+        # forcing "on" here would silently downgrade that post-mortem
+        obs.set_mode("on")
+
+    ks = sorted({max(1, int(s)) for s in args.k.split(",") if s.strip()})
+    rng = np.random.default_rng(args.seed)
+    dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+
+    params = serve.ServeParams(
+        max_batch_rows=args.max_batch_rows,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_rows=args.max_queue_rows,
+        max_k=max(ks),
+    )
+    srv = serve.Server(params)
+    t_build = time.perf_counter()
+    srv.create_index("default", dataset, algo=args.algo)
+    build_s = time.perf_counter() - t_build
+    print(f"index up: {args.algo} n={args.n} d={args.dim} "
+          f"(build+warmup {build_s:.1f}s)", flush=True)
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms: list = []
+    per_k = {k: [] for k in ks}
+    counts = {"completed": 0, "rejected": 0, "errors": 0,
+              "deletes": 0, "upserts": 0}
+    # pacing gate for --qps: tokens added by a timer thread
+    interval = (args.concurrency / args.qps) if args.qps > 0 else 0.0
+
+    def worker(wid: int):
+        wrng = np.random.default_rng(args.seed + 1000 + wid)
+        next_t = time.monotonic()
+        while not stop.is_set():
+            if interval:
+                next_t += interval
+                pause = next_t - time.monotonic()
+                if pause > 0:
+                    time.sleep(pause)
+            k = int(wrng.choice(ks))
+            q = wrng.standard_normal(args.dim).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                d, ids = srv.search(q, k, timeout_s=60.0)
+            except serve.Overloaded:
+                with lock:
+                    counts["rejected"] += 1
+                time.sleep(0.001 * (1 + wrng.random()))
+                continue
+            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow loadgen accounting only; the server already classified the failure
+                with lock:
+                    counts["errors"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                counts["completed"] += 1
+                done = counts["completed"]
+                lat_ms.append(ms)
+                per_k[k].append(ms)
+                if args.requests and done >= args.requests:
+                    stop.set()
+            if args.delete_every and done % args.delete_every == 0:
+                srv.delete([int(wrng.integers(args.n))])
+                with lock:
+                    counts["deletes"] += 1
+            if args.upsert_every and done % args.upsert_every == 0:
+                srv.upsert(wrng.standard_normal(args.dim).astype(np.float32),
+                           [args.n + done])
+                with lock:
+                    counts["upserts"] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
+    t_run = time.perf_counter()
+    for t in threads:
+        t.start()
+    swap_version = None
+    if args.swap_mid_run:
+        time.sleep(args.duration_s / 2)
+        print("mid-run hot swap...", flush=True)
+        swap_version = srv.swap("default", dataset=dataset,
+                                wait=True).result()
+    deadline = t_run + (max(args.duration_s, 60.0) if args.requests
+                        else args.duration_s)
+    while not stop.is_set():
+        if time.perf_counter() >= deadline:
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    wall_s = time.perf_counter() - t_run
+
+    stats = srv.stats()
+    srv.close()
+    report = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "algo": args.algo, "n": args.n, "dim": args.dim,
+            "concurrency": args.concurrency, "qps_target": args.qps,
+            "k": ks, "max_batch_rows": args.max_batch_rows,
+            "max_wait_ms": args.max_wait_ms,
+            "max_queue_rows": args.max_queue_rows,
+            "duration_s": round(wall_s, 2), "build_s": round(build_s, 2),
+        },
+        "throughput_qps": round(counts["completed"] / max(wall_s, 1e-9), 1),
+        **counts,
+        "swap_generation": swap_version,
+        "latency_ms": _percentiles(lat_ms),
+        "per_k": {str(k): _percentiles(v) for k, v in per_k.items()},
+        "server": stats,
+    }
+    with open(os.path.join(ROOT, args.out), "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    if args.obs_snapshot:
+        obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    print(json.dumps({k: report[k] for k in
+                      ("throughput_qps", "completed", "rejected",
+                       "latency_ms")}), flush=True)
+    print(f"wrote {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    from raft_tpu.core.exit_guard import guarded_exit
+
+    guarded_exit(main())

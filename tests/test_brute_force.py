@@ -48,6 +48,70 @@ def test_brute_force_prefilter(rng):
     assert eval_recall(ind, want) > 0.99
 
 
+@pytest.mark.parametrize("tile_n", [300, 64])  # whole-dataset + scan paths
+def test_brute_force_prefilter_out_of_range_modes(rng, tile_n):
+    """out_of_range semantics (ISSUE 5 satellite): a filter narrower
+    than the dataset drops ids >= n_bits by default (allow-list), while
+    "keep" treats them as kept (tombstone keep-mask over an index
+    extended after the filter was built)."""
+    from raft_tpu.neighbors.common import BitsetFilter
+
+    n, m, d, k = 300, 10, 16, 5
+    n_old = 180
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    keep = rng.random(n_old) < 0.5       # filter over the OLD rows only
+    narrow = Bitset.from_dense(keep)
+    idx = brute_force.build(x, "sqeuclidean")
+
+    # default "drop": out-of-range (new) rows rejected
+    _, i_drop = brute_force.search(idx, q, k, prefilter=narrow,
+                                   tile_n=tile_n)
+    i_drop = np.asarray(i_drop)
+    assert (i_drop < n_old).all() and keep[i_drop.ravel()].all()
+
+    # "keep": new rows eligible — must equal the materialized full mask
+    d_keep, i_keep = brute_force.search(
+        idx, q, k, prefilter=BitsetFilter(narrow, out_of_range="keep"),
+        tile_n=tile_n)
+    full = Bitset.from_dense(np.concatenate([keep,
+                                             np.ones(n - n_old, bool)]))
+    d_ref, i_ref = brute_force.search(idx, q, k, prefilter=full,
+                                      tile_n=tile_n)
+    np.testing.assert_array_equal(np.asarray(i_keep), np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(d_keep), np.asarray(d_ref))
+
+
+def test_bitset_filter_out_of_range_validation():
+    from raft_tpu.neighbors.common import BitsetFilter
+
+    with pytest.raises(ValueError, match="out_of_range"):
+        BitsetFilter(Bitset(8), out_of_range="maybe")
+
+
+def test_resolve_filter_bits_caches_materialized_keep():
+    """A keep-mode filter reused across searches must pay the resize's
+    device ops once: the materialized bitset is cached on the filter,
+    keyed by (bound, Bitset._version) so an in-place mutation or a
+    different index width invalidates it."""
+    from raft_tpu.neighbors.common import BitsetFilter, resolve_filter_bits
+
+    bits = Bitset.from_dense(np.array([True, False, True, True]))
+    filt = BitsetFilter(bits, out_of_range="keep")
+    a = resolve_filter_bits(filt, 10)
+    assert a.n_bits == 10
+    assert resolve_filter_bits(filt, 10) is a          # cache hit
+    b = resolve_filter_bits(filt, 12)                  # wider index: miss
+    assert b.n_bits == 12 and b is not a
+    bits.set(1, True)                                  # in-place mutation
+    c = resolve_filter_bits(filt, 12)                  # version bump: miss
+    assert c is not b
+    assert bool(np.asarray(c.to_dense())[1])
+    # drop-mode and wide-enough filters bypass materialization entirely
+    assert resolve_filter_bits(BitsetFilter(bits), 10) is bits
+    assert resolve_filter_bits(filt, 4) is bits
+
+
 def test_knn_one_shot_and_serialize(rng, tmp_path):
     x = rng.standard_normal((200, 8)).astype(np.float32)
     q = rng.standard_normal((7, 8)).astype(np.float32)

@@ -63,6 +63,48 @@ def test_bitset_from_dense(rng):
     assert int(bs.count()) == mask.sum()
 
 
+def test_bitset_resize_grow_keep(rng):
+    """resize(n, default=True): new bits (incl. the old last word's tail
+    bits) default to SET — the tombstone-over-extend contract (ISSUE 5)."""
+    mask = rng.random(77) < 0.5          # 77: mid-word tail
+    bs = Bitset.from_dense(mask).resize(200, default=True)
+    assert bs.n_bits == 200
+    want = np.concatenate([mask, np.ones(123, bool)])
+    np.testing.assert_array_equal(np.asarray(bs.to_dense()), want)
+    assert int(bs.count()) == want.sum()
+
+
+def test_bitset_resize_grow_drop_and_shrink(rng):
+    mask = rng.random(64) < 0.5          # word-aligned boundary too
+    bs = Bitset.from_dense(mask).resize(150, default=False)
+    want = np.concatenate([mask, np.zeros(86, bool)])
+    np.testing.assert_array_equal(np.asarray(bs.to_dense()), want)
+    # shrink truncates
+    bs.resize(40)
+    np.testing.assert_array_equal(np.asarray(bs.to_dense()), mask[:40])
+    assert int(bs.count()) == mask[:40].sum()
+
+
+def test_bitset_copy_is_independent(rng):
+    mask = rng.random(50) < 0.5
+    a = Bitset.from_dense(mask)
+    b = a.copy()
+    b.set(jnp.arange(50), False)
+    np.testing.assert_array_equal(np.asarray(a.to_dense()), mask)
+    assert int(b.count()) == 0
+
+
+def test_bitset_count_bits_functional(rng):
+    mask = rng.random(70) < 0.5
+    bs = Bitset.from_dense(mask)
+    # raw-word functional count matches, incl. under jit
+    assert int(Bitset.count_bits(bs.bits, 70)) == mask.sum()
+    import jax
+
+    jitted = jax.jit(Bitset.count_bits, static_argnums=(1,))
+    assert int(jitted(bs.bits, 70)) == mask.sum()
+
+
 def test_interruptible_cancel_unblocks_sync():
     """interruptible: cancel from another thread makes the target's next
     synchronize raise (reference core/interruptible.hpp:39-105)."""

@@ -464,6 +464,70 @@ def test_gl009_suppressed_with_reason():
     assert "GL009" not in rules
 
 
+# serve/ scope (ISSUE 5): the serving surface is method-shaped, so class
+# methods count there — and the prefix set widens to the serving verbs
+
+
+def _serve_rules(src):
+    findings = lint_source(textwrap.dedent(src),
+                           "raft_tpu/serve/fixture.py")
+    return [f.rule for f in findings if not f.suppressed]
+
+
+def test_gl009_serve_unspanned_method_positive():
+    rules = _serve_rules("""
+        class Server:
+            def submit(self, queries, k):
+                return self.batcher.submit(queries, k)
+
+            def upsert(self, vectors, ids):
+                return self.state.upsert(vectors, ids)
+    """)
+    assert rules.count("GL009") == 2
+
+
+def test_gl009_serve_spanned_method_negative():
+    rules = _serve_rules("""
+        from raft_tpu import obs
+
+        class Server:
+            def submit(self, queries, k):
+                with obs.span("serve.submit"):
+                    return self.batcher.submit(queries, k)
+
+        def publish(name, handle):
+            with obs.span("serve.publish", index=name):
+                return handle
+    """)
+    assert "GL009" not in rules
+
+
+def test_gl009_serve_word_boundary_and_private_exempt():
+    # "deleted_rows" is an accounting getter, not the "delete" entry
+    # point; private classes/methods are infrastructure
+    rules = _serve_rules("""
+        class Server:
+            def deleted_rows(self):
+                return self._n
+
+            def _submit_internal(self, q):
+                return q
+
+        class _Handle:
+            def search_main(self, q, k):
+                return q, k
+    """)
+    assert "GL009" not in rules
+
+
+def test_gl009_serve_module_function_positive():
+    rules = _serve_rules("""
+        def swap_index(name, dataset):
+            return rebuild(name, dataset)
+    """)
+    assert "GL009" in rules
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------

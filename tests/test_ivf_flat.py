@@ -111,6 +111,41 @@ def test_prefilter(dataset):
     assert eval_recall(idx, want) > 0.99
 
 
+def test_extend_then_prefilter(dataset):
+    """extend × prefilter (ISSUE 5 satellite): a filter built BEFORE the
+    extend still applies afterwards — default "drop" rejects the new
+    rows, out_of_range="keep" admits them (tombstone semantics)."""
+    from raft_tpu.neighbors.common import BitsetFilter
+
+    x, q = dataset
+    k = 10
+    n_old = 4000
+    index = _build(x[:n_old])
+    allowed = np.zeros(n_old, bool)
+    allowed[: n_old // 2] = True
+    bits = Bitset.from_dense(allowed)          # narrower than the index
+    index = ivf_flat.extend(index, x[n_old:])  # ids n_old..8000 appended
+    sp = ivf_flat.SearchParams(n_probes=32, query_group=64,
+                               compute_dtype="f32", local_recall_target=1.0)
+
+    # default drop: only kept OLD rows can surface
+    _, idx = ivf_flat.search(sp, index, q, k, prefilter=bits)
+    idx = np.asarray(idx)
+    assert ((idx == -1) | ((idx < n_old // 2))).all()
+    _, want = naive_knn(q, x[: n_old // 2], k)
+    assert eval_recall(idx, want) > 0.99
+
+    # keep: new rows join the allowed set
+    _, idx2 = ivf_flat.search(
+        sp, index, q, k, prefilter=BitsetFilter(bits, out_of_range="keep"))
+    idx2 = np.asarray(idx2)
+    assert ((idx2 < n_old // 2) | (idx2 >= n_old)).all()
+    sub = np.concatenate([np.arange(n_old // 2),
+                          np.arange(n_old, x.shape[0])])
+    _, want_sub = naive_knn(q, x[sub], k)
+    assert eval_recall(idx2, sub[want_sub]) > 0.99
+
+
 def test_prefilter_fewer_than_k_valid(dataset):
     """Restrictive filter (< k allowed points): ids at sentinel distance
     must be -1, never a filtered-out id (ADVICE r1 medium finding)."""

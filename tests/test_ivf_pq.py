@@ -271,6 +271,45 @@ def test_extend(dataset):
     assert eval_recall(np.asarray(idx), want) > 0.9
 
 
+def test_extend_then_prefilter(dataset):
+    """extend × prefilter (ISSUE 5 satellite): a filter built BEFORE the
+    extend applies afterwards — default "drop" rejects the appended
+    rows, out_of_range="keep" admits them (tombstone semantics over an
+    extended index)."""
+    from raft_tpu.neighbors.common import BitsetFilter
+
+    x, q = dataset
+    k = 10
+    n_old = 3000
+    index = _build(x[:n_old])
+    allowed = np.zeros(n_old, bool)
+    allowed[: n_old // 2] = True
+    bits = Bitset.from_dense(allowed)          # narrower than the index
+    index = ivf_pq.extend(index, x[n_old:])
+    sp = ivf_pq.SearchParams(n_probes=16, query_group=64, bucket_batch=4)
+
+    # default drop: only kept OLD rows can surface
+    _, idx = ivf_pq.search(sp, index, q, k, prefilter=bits)
+    idx = np.asarray(idx)
+    assert ((idx == -1) | (idx < n_old // 2)).all()
+    _, cand = ivf_pq.search(sp, index, q, 80, prefilter=bits)
+    _, ref = refine(x, q, cand, k)
+    _, want = naive_knn(q, x[: n_old // 2], k)
+    assert eval_recall(np.asarray(ref), want) > 0.9
+
+    # keep: appended rows join the allowed set
+    keep_filt = BitsetFilter(bits, out_of_range="keep")
+    _, idx2 = ivf_pq.search(sp, index, q, k, prefilter=keep_filt)
+    idx2 = np.asarray(idx2)
+    assert ((idx2 == -1) | (idx2 < n_old // 2) | (idx2 >= n_old)).all()
+    sub = np.concatenate([np.arange(n_old // 2),
+                          np.arange(n_old, x.shape[0])])
+    _, cand2 = ivf_pq.search(sp, index, q, 80, prefilter=keep_filt)
+    _, ref2 = refine(x, q, cand2, k)
+    _, want_sub = naive_knn(q, x[sub], k)
+    assert eval_recall(np.asarray(ref2), sub[want_sub]) > 0.9
+
+
 def test_serialize_roundtrip(dataset, tmp_path):
     x, q = dataset
     index = _build(x)
