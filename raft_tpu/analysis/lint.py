@@ -623,6 +623,11 @@ class FileLinter:
         "search", "build", "submit", "publish", "delete", "upsert",
         "compact", "swap", "warmup", "create_index", "add_index",
         "load_index",
+        # the multi-host fabric's control plane (ISSUE 6): recovery
+        # actions are serving-surface latency too — an unobserved
+        # probe/restart is a blind spot exactly when the cluster is
+        # degraded and observability matters most
+        "probe", "restart",
     )
 
     def _check_unspanned_entries(self) -> None:

@@ -115,7 +115,8 @@ register_rule(
     "point without an obs.span",
     "graft-scope (docs/observability.md) is only as complete as its "
     "coverage: a public search/build path (or a serve/ submit/publish/"
-    "delete/upsert/compact/swap surface, where per-request latency IS the "
+    "delete/upsert/compact/swap/probe/restart surface, where per-request "
+    "latency IS the "
     "product — docs/serving.md) that opens no span produces latency and "
     "query counts attributed to nobody, which is exactly the blind spot "
     "the reference's NVTX-everywhere convention prevents; open an "

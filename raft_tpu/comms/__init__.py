@@ -31,6 +31,11 @@ from raft_tpu.comms.session import (
     get_comm_state,
     session_handle,
 )
+from raft_tpu.comms.procgroup import (
+    LocalGroup,
+    ProcGroup,
+    WorkerRuntime,
+)
 from raft_tpu.comms.sharded import (
     sharded_cagra_build,
     sharded_cagra_search,
@@ -45,6 +50,9 @@ from raft_tpu.comms.sharded import (
 
 __all__ = [
     "Comms",
+    "LocalGroup",
+    "ProcGroup",
+    "WorkerRuntime",
     "default_mesh",
     "local_handle",
     "allreduce",

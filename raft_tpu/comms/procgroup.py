@@ -1,0 +1,648 @@
+"""Process-boundary worker groups for the multi-host serving fabric.
+
+The reference's cluster tier is raft-dask: one OS process per GPU, an
+index shard per worker, queries broadcast and per-worker top-ks merged
+(PAPER.md; raft_dask/common/comms.py). This module is the TPU-repo
+analog of that *process* layer — everything above one process boundary
+and below the router (:mod:`raft_tpu.serve.fabric`):
+
+* :class:`WorkerRuntime` — the worker-side state machine. It owns
+  per-generation shard indexes (built with the repo's own
+  ``brute_force``/``ivf_flat`` paths, warmed at prepare time) and
+  answers a small RPC vocabulary: ``search`` / ``ping`` (data plane)
+  and ``prepare`` / ``publish`` / ``abort`` / ``retire`` (the two-phase
+  hot-swap control plane, docs/serving.md §10).
+* :class:`ProcGroup` — N real ``multiprocessing`` (spawn) children,
+  one :class:`WorkerRuntime` each, request/response queues per worker
+  and a parent-side receiver thread matching responses to futures.
+  This is the tier the SIGKILL / machine-loss failure modes live in.
+* :class:`LocalGroup` — the in-process twin: the SAME runtime on
+  daemon threads. Every router behavior (hedging, circuit breaking,
+  two-phase swap, coverage) is exercised without process-spawn cost —
+  the fabric counterpart of the CPU-mesh
+  ``--xla_force_host_platform_device_count`` strategy the sharded
+  tests use.
+
+Failure semantics are *absences*, not exceptions: a dead worker never
+answers (the router diagnoses the timeout), a dropped RPC loses only
+its response, a slow worker answers late enough to trigger hedging.
+The deterministic fault points come from
+:func:`raft_tpu.resilience.faultinject.proc_action` /
+:func:`~raft_tpu.resilience.faultinject.rpc_dropped`
+(``dead@proc:R``, ``slow@proc:R*K``, ``drop@rpc:METHOD``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import os
+import queue as _pyqueue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.resilience import errors as _rerrors
+from raft_tpu.resilience import faultinject
+
+# sentinel statuses a worker's handle() can return instead of a reply
+DIE = "__die__"       # hard-exit, no response (dead@proc)
+DROP = "__drop__"     # swallow the response (drop@rpc)
+
+# methods that count as the data plane: dead@proc / slow@proc faults
+# fire here (a worker that died takes its control plane with it anyway,
+# but arming death on control RPCs would kill workers during their own
+# bootstrap prepare/publish — nondeterministic and not the failure mode
+# under test)
+DATA_PLANE = ("search", "ping")
+
+_NO_GEN = "no_gen"
+
+
+class RemoteWorkerError(RuntimeError):
+    """A failure serialized back from a worker process. ``fault_kind``
+    carries the worker-side :func:`raft_tpu.resilience.classify`
+    verdict so the router's classification agrees with the worker's."""
+
+    def __init__(self, msg: str, kind: Optional[str] = None):
+        super().__init__(msg)
+        if kind in _rerrors.KINDS:
+            self.fault_kind = kind
+
+
+def is_no_gen(exc: BaseException) -> bool:
+    """True when a worker rejected an RPC because it does not hold the
+    requested generation — a *stale* worker (missed a publish while
+    partitioned), not a broken one; the router re-syncs instead of
+    circuit-breaking."""
+    return _NO_GEN in str(exc)
+
+
+def _remote_error(payload: dict) -> RemoteWorkerError:
+    return RemoteWorkerError(
+        str(payload.get("error", "worker error")),
+        kind=payload.get("kind"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# shard index construction/search — shared by workers and the tests'
+# surviving-shard oracle (bitwise identity demands one code path)
+# ---------------------------------------------------------------------------
+
+
+def build_shard_entry(vectors: np.ndarray, offset: int,
+                      algo: str = "brute_force") -> tuple:
+    """Build one shard's index over ``vectors`` whose global row ids
+    start at ``offset``. Returns an opaque entry for
+    :func:`search_shard_entry`."""
+    vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+    if algo == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat
+
+        params = ivf_flat.IndexParams(
+            n_lists=max(1, min(16, vectors.shape[0] // 8)))
+        idx = ivf_flat.build(params, vectors)
+        # exhaustive probing: the fabric's correctness contract is that
+        # a covered shard's answer is exact for that shard
+        sp = ivf_flat.SearchParams(n_probes=idx.n_lists,
+                                   compute_dtype="f32",
+                                   local_recall_target=1.0)
+        return ("ivf_flat", idx, sp, int(offset), int(vectors.shape[0]))
+    from raft_tpu.neighbors import brute_force
+
+    idx = brute_force.build(vectors)
+    return ("brute_force", idx, None, int(offset), int(vectors.shape[0]))
+
+
+def search_shard_entry(entry: tuple, q: np.ndarray,
+                       k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Search one shard entry at ``k``, returning host ``(d, i)`` with
+    GLOBAL row ids, column-padded to exactly ``k`` with the
+    worst-possible sentinel (a shard smaller than ``k`` can only
+    contribute its real rows)."""
+    algo, idx, sp, offset, rows = entry
+    kq = int(min(k, rows))
+    if algo == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat
+
+        d, i = ivf_flat.search(sp, idx, q, kq)
+    else:
+        from raft_tpu.neighbors import brute_force
+
+        d, i = brute_force.search(idx, q, kq)
+    d = np.asarray(d).astype(np.float32, copy=False)
+    i = np.asarray(i).astype(np.int32, copy=False)
+    i = np.where(i >= 0, i + np.int32(offset), np.int32(-1))
+    if kq < k:
+        pad = k - kq
+        d = np.concatenate(
+            [d, np.full((d.shape[0], pad), np.inf, np.float32)], axis=1)
+        i = np.concatenate(
+            [i, np.full((i.shape[0], pad), -1, np.int32)], axis=1)
+    return d, i
+
+
+# ---------------------------------------------------------------------------
+# the worker-side state machine
+# ---------------------------------------------------------------------------
+
+
+class WorkerRuntime:
+    """One fabric worker's state: per-generation shard indexes and the
+    RPC vocabulary. Transport-agnostic — :class:`ProcGroup` runs one
+    per child process, :class:`LocalGroup` one per daemon thread."""
+
+    def __init__(self, rank: int, algo: str = "brute_force",
+                 slow_s: float = 0.15):
+        self.rank = int(rank)
+        self.algo = algo
+        self.slow_s = float(slow_s)
+        self.current_gen = 0
+        # gen_id -> {shard_id: entry}; staged holds prepared-not-published
+        self.gens: Dict[int, Dict[int, tuple]] = {}
+        self.staged: Dict[int, Dict[int, tuple]] = {}
+
+    def handle(self, method: str, payload: Optional[dict]):
+        """Dispatch one RPC. Returns ``("ok", reply)`` / ``("err",
+        {"error", "kind"})``, or the :data:`DIE` / :data:`DROP`
+        sentinels when an injected process fault demands an absence
+        instead of an answer."""
+        if method in DATA_PLANE:
+            action = faultinject.proc_action(self.rank)
+            if action == "die":
+                return DIE, None
+            if action == "slow":
+                time.sleep(self.slow_s)
+        if faultinject.rpc_dropped(method):
+            return DROP, None
+        try:
+            faultinject.check(stage=f"fabric.{method}")
+            fn = getattr(self, "_do_" + method, None)
+            if fn is None:
+                raise ValueError(f"unknown fabric RPC {method!r}")
+            return "ok", fn(payload or {})
+        except BaseException as e:  # noqa: BLE001 — classified here, re-classified by the router from the serialized kind
+            kind = _rerrors.classify(e)
+            return "err", {"error": f"{type(e).__name__}: {e}",
+                           "kind": kind}
+
+    # -- data plane ---------------------------------------------------------
+
+    def _do_ping(self, payload: dict) -> dict:
+        return {"rank": self.rank, "gen": self.current_gen,
+                "gens": sorted(self.gens)}
+
+    def _do_search(self, payload: dict) -> dict:
+        gen = int(payload["gen"])
+        shards = self.gens.get(gen)
+        if shards is None:
+            raise KeyError(
+                f"{_NO_GEN}: worker {self.rank} does not hold "
+                f"generation {gen} (has {sorted(self.gens)})")
+        sid = int(payload["shard"])
+        entry = shards.get(sid)
+        if entry is None:
+            raise KeyError(
+                f"{_NO_GEN}: worker {self.rank} holds generation {gen} "
+                f"but not shard {sid}")
+        d, i = search_shard_entry(entry, np.asarray(payload["q"]),
+                                  int(payload["k"]))
+        return {"gen": gen, "shard": sid, "d": d, "i": i}
+
+    # -- two-phase swap control plane ---------------------------------------
+
+    def _do_prepare(self, payload: dict) -> dict:
+        gen = int(payload["gen"])
+        built: Dict[int, tuple] = {}
+        for sid, (vec, offset) in payload["shards"].items():
+            vec = np.asarray(vec, dtype=np.float32)
+            entry = build_shard_entry(vec, int(offset), self.algo)
+            # warm: trace the search once now so publish -> first query
+            # adds no compile on the serving path
+            search_shard_entry(
+                entry, np.zeros((1, vec.shape[1]), np.float32),
+                int(min(4, vec.shape[0])))
+            built[int(sid)] = entry
+        self.staged[gen] = built
+        return {"gen": gen, "shards": sorted(built)}
+
+    def _do_publish(self, payload: dict) -> dict:
+        gen = int(payload["gen"])
+        if gen in self.gens:
+            self.current_gen = max(self.current_gen, gen)
+            return {"gen": gen}               # idempotent re-publish
+        staged = self.staged.pop(gen, None)
+        if staged is None:
+            raise KeyError(
+                f"{_NO_GEN}: worker {self.rank} has no staged "
+                f"generation {gen} to publish")
+        self.gens[gen] = staged
+        # max, not assignment: a router resync of an OLDER generation
+        # racing a newer publish must not regress the current pointer
+        self.current_gen = max(self.current_gen, gen)
+        return {"gen": gen}
+
+    def _do_abort(self, payload: dict) -> dict:
+        gen = int(payload["gen"])
+        self.staged.pop(gen, None)
+        return {"gen": gen}
+
+    def _do_retire(self, payload: dict) -> dict:
+        gen = int(payload["gen"])
+        if gen != self.current_gen:
+            self.gens.pop(gen, None)
+        return {"gen": gen}
+
+    def _do_set_faults(self, payload: dict) -> dict:
+        faultinject.install(payload.get("spec") or None)
+        return {"ok": True}
+
+
+# ---------------------------------------------------------------------------
+# multiprocessing transport
+# ---------------------------------------------------------------------------
+
+
+def _proc_worker_main(rank: int, req_q, resp_q, algo: str, slow_s: float,
+                      fault_spec: Optional[str],
+                      platform: Optional[str]) -> None:
+    """Child-process entry: run one :class:`WorkerRuntime` over the
+    request queue until a ``stop``. A ``dead@proc`` fault hard-exits
+    (``os._exit``) with no response — the honest SIGKILL analog."""
+    if platform:
+        # belt-and-braces: the parent already swapped the env before
+        # spawn, but backend selection must never fall through to a
+        # hung TPU plugin inside a fabric worker
+        os.environ.setdefault("JAX_PLATFORMS", platform)
+    if fault_spec:
+        faultinject.install(fault_spec)
+    rt = WorkerRuntime(rank, algo=algo, slow_s=slow_s)
+    while True:
+        msg = req_q.get()
+        if msg is None:
+            return
+        req_id, method, payload = msg
+        if method == "stop":
+            return
+        status, out = rt.handle(method, payload)
+        if status is DIE:
+            os._exit(17)
+        if status is DROP:
+            continue
+        resp_q.put((req_id, status == "ok", out))
+
+
+# one lock for the spawn-time environment swap (XLA_FLAGS /
+# JAX_PLATFORMS are process-global; concurrent spawns must not
+# interleave their save/restore)
+_SPAWN_ENV_LOCK = threading.Lock()
+
+
+class _ProcWorker:
+    __slots__ = ("rank", "proc", "req_q", "resp_q", "pending", "lock",
+                 "stopping", "receiver")
+
+    def __init__(self, rank, proc, req_q, resp_q):
+        self.rank = rank
+        self.proc = proc
+        self.req_q = req_q
+        self.resp_q = resp_q
+        self.pending: Dict[int, Future] = {}
+        self.lock = threading.Lock()
+        self.stopping = False
+        self.receiver: Optional[threading.Thread] = None
+
+
+class ProcGroup:
+    """N fabric workers as real OS processes (``multiprocessing`` spawn
+    context — fork after JAX initialization is unsafe).
+
+    Parent-side API (shared with :class:`LocalGroup`):
+
+    * :meth:`call` — fire an RPC, get a :class:`Future` (resolves with
+      the reply payload, or raises the classified failure);
+    * :meth:`alive` / :meth:`kill` / :meth:`restart` — process
+      lifecycle (``kill`` is SIGKILL: the machine-loss drill);
+    * :meth:`close` — stop everything.
+
+    Children inherit the parent environment minus the
+    ``--xla_force_host_platform_device_count`` test flag (a worker
+    needs one device, not eight virtual ones) and with
+    ``JAX_PLATFORMS`` pinned to ``platform`` (default ``cpu`` — a
+    fabric worker must never block on a hung TPU plugin probe).
+    """
+
+    def __init__(self, n_workers: int, algo: str = "brute_force",
+                 slow_s: float = 0.15, fault_spec: Optional[str] = None,
+                 platform: Optional[str] = "cpu"):
+        self.n_workers = int(n_workers)
+        self.algo = algo
+        self.slow_s = float(slow_s)
+        self.fault_spec = fault_spec
+        self.platform = platform
+        self._ctx = mp.get_context("spawn")
+        self._req_ids = itertools.count(1)
+        self._workers: List[_ProcWorker] = [
+            self._spawn(r, fault_spec) for r in range(self.n_workers)
+        ]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _spawn(self, rank: int, fault_spec: Optional[str]) -> _ProcWorker:
+        req_q = self._ctx.Queue()
+        resp_q = self._ctx.Queue()
+        proc = self._ctx.Process(
+            target=_proc_worker_main,
+            args=(rank, req_q, resp_q, self.algo, self.slow_s,
+                  fault_spec, self.platform),
+            daemon=True,
+            name=f"raft-tpu-fabric-w{rank}",
+        )
+        with _SPAWN_ENV_LOCK:
+            saved = {k: os.environ.get(k)
+                     for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+            flags = " ".join(
+                tok for tok in (saved["XLA_FLAGS"] or "").split()
+                if "xla_force_host_platform_device_count" not in tok)
+            if flags:
+                os.environ["XLA_FLAGS"] = flags
+            else:
+                os.environ.pop("XLA_FLAGS", None)
+            if self.platform:
+                os.environ["JAX_PLATFORMS"] = self.platform
+            try:
+                proc.start()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+        w = _ProcWorker(rank, proc, req_q, resp_q)
+        w.receiver = threading.Thread(
+            target=self._recv_loop, args=(w,), daemon=True,
+            name=f"raft-tpu-fabric-recv-{rank}")
+        w.receiver.start()
+        return w
+
+    def _recv_loop(self, w: _ProcWorker) -> None:
+        while not w.stopping:
+            try:
+                msg = w.resp_q.get(timeout=0.1)
+            except _pyqueue.Empty:
+                if not w.proc.is_alive():
+                    # drain what the child flushed before dying, then
+                    # fail everything still outstanding
+                    while True:
+                        try:
+                            self._resolve(w, w.resp_q.get_nowait())
+                        except _pyqueue.Empty:
+                            break
+                    self._fail_pending(
+                        w, f"fabric worker {w.rank} process died")
+                    return
+                continue
+            except (OSError, EOFError, ValueError):
+                # queue torn down under us (close/kill)
+                self._fail_pending(
+                    w, f"fabric worker {w.rank} channel closed")
+                return
+            self._resolve(w, msg)
+
+    def _resolve(self, w: _ProcWorker, msg) -> None:
+        req_id, ok, payload = msg
+        with w.lock:
+            fut = w.pending.pop(req_id, None)
+        if fut is None or fut.done():
+            return                      # hedge loser / timed-out caller
+        if ok:
+            fut.set_result(payload)
+        else:
+            fut.set_exception(_remote_error(payload))
+
+    def _fail_pending(self, w: _ProcWorker, msg: str) -> None:
+        with w.lock:
+            pending = list(w.pending.values())
+            w.pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(_rerrors.DeadBackendError(msg))
+
+    # -- the RPC surface ----------------------------------------------------
+
+    def call(self, rank: int, method: str,
+             payload: Optional[dict] = None) -> Future:
+        w = self._workers[rank]
+        fut: Future = Future()
+        if w.stopping or not w.proc.is_alive():
+            fut.set_exception(_rerrors.DeadBackendError(
+                f"fabric worker {rank} process is not alive"))
+            return fut
+        req_id = next(self._req_ids)
+        fut._raft_req_id = req_id
+        with w.lock:
+            w.pending[req_id] = fut
+        try:
+            w.req_q.put((req_id, method, payload))
+        except BaseException as e:  # noqa: BLE001 — classified: a torn queue is the dead-worker signal
+            _rerrors.classify(e)
+            with w.lock:
+                w.pending.pop(req_id, None)
+            if not fut.done():
+                fut.set_exception(_rerrors.DeadBackendError(
+                    f"fabric worker {rank} request channel broken: {e}"))
+        return fut
+
+    def forget(self, rank: int, fut: Future) -> None:
+        """Abandon one outstanding call: drop its pending entry so a
+        response that never arrives (dropped RPC, hung-but-alive
+        worker) cannot pin the Future + payload until process death. A
+        late response for a forgotten id is discarded by
+        :meth:`_resolve`."""
+        req_id = getattr(fut, "_raft_req_id", None)
+        if req_id is None:
+            return
+        w = self._workers[rank]
+        with w.lock:
+            w.pending.pop(req_id, None)
+
+    def alive(self, rank: int) -> bool:
+        w = self._workers[rank]
+        return not w.stopping and w.proc.is_alive()
+
+    def kill(self, rank: int) -> None:
+        """SIGKILL the worker — the machine-loss drill. Outstanding
+        futures fail with :class:`DeadBackendError`."""
+        w = self._workers[rank]
+        w.proc.kill()
+        w.proc.join(timeout=10.0)
+        self._fail_pending(w, f"fabric worker {rank} killed")
+
+    def restart(self, rank: int,
+                fault_spec: Optional[str] = None) -> None:
+        """Respawn ``rank`` as a fresh process with NO index state (the
+        router must re-sync it) and no inherited fault plan unless one
+        is given explicitly."""
+        old = self._workers[rank]
+        old.stopping = True
+        if old.proc.is_alive():
+            old.proc.kill()
+        old.proc.join(timeout=10.0)
+        self._fail_pending(old, f"fabric worker {rank} restarted")
+        self._workers[rank] = self._spawn(rank, fault_spec)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        for w in self._workers:
+            w.stopping = True
+            try:
+                w.req_q.put((0, "stop", None))
+            except BaseException as e:  # noqa: BLE001 — classified: shutdown of an already-dead queue
+                _rerrors.classify(e)
+        deadline = time.monotonic() + timeout_s
+        for w in self._workers:
+            w.proc.join(timeout=max(deadline - time.monotonic(), 0.1))
+            if w.proc.is_alive():
+                w.proc.kill()
+                w.proc.join(timeout=5.0)
+            self._fail_pending(w, f"fabric worker {w.rank} closed")
+
+
+# ---------------------------------------------------------------------------
+# in-process transport
+# ---------------------------------------------------------------------------
+
+
+class _LocalWorker:
+    __slots__ = ("rank", "runtime", "q", "pending", "lock", "dead",
+                 "thread")
+
+    def __init__(self, rank, runtime):
+        self.rank = rank
+        self.runtime = runtime
+        self.q: "_pyqueue.Queue" = _pyqueue.Queue()
+        self.pending: Dict[int, Future] = {}
+        self.lock = threading.Lock()
+        self.dead = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class LocalGroup:
+    """The in-process twin of :class:`ProcGroup`: the same
+    :class:`WorkerRuntime` per worker, on daemon threads. Identical
+    parent-side semantics — a "died" worker stops answering forever
+    (:meth:`alive` goes False, outstanding futures fail) rather than
+    raising, so every router failure path is exercised without spawn
+    cost. Fault plans are the AMBIENT :mod:`faultinject` plan (one
+    process, one plan), matching each runtime by its rank."""
+
+    def __init__(self, n_workers: int, algo: str = "brute_force",
+                 slow_s: float = 0.05, fault_spec: Optional[str] = None,
+                 platform: Optional[str] = None):
+        del platform                    # one process, one platform
+        if fault_spec:
+            faultinject.install(fault_spec)
+        self.n_workers = int(n_workers)
+        self.algo = algo
+        self.slow_s = float(slow_s)
+        self._req_ids = itertools.count(1)
+        self._workers: List[_LocalWorker] = [
+            self._spawn(r) for r in range(self.n_workers)
+        ]
+
+    def _spawn(self, rank: int) -> _LocalWorker:
+        w = _LocalWorker(rank, WorkerRuntime(rank, algo=self.algo,
+                                             slow_s=self.slow_s))
+        w.thread = threading.Thread(
+            target=self._loop, args=(w,), daemon=True,
+            name=f"raft-tpu-fabric-local-w{rank}")
+        w.thread.start()
+        return w
+
+    def _loop(self, w: _LocalWorker) -> None:
+        while True:
+            msg = w.q.get()
+            if msg is None:
+                return
+            req_id, method, payload = msg
+            if w.dead:
+                continue                # the dead answer nothing, ever
+            status, out = w.runtime.handle(method, payload)
+            if status is DIE:
+                w.dead = True
+                self._fail_pending(
+                    w, f"fabric worker {w.rank} died (injected)")
+                continue
+            if status is DROP:
+                with w.lock:
+                    w.pending.pop(req_id, None)
+                continue
+            with w.lock:
+                fut = w.pending.pop(req_id, None)
+            if fut is None or fut.done():
+                continue
+            if status == "ok":
+                fut.set_result(out)
+            else:
+                fut.set_exception(_remote_error(out))
+
+    def _fail_pending(self, w: _LocalWorker, msg: str) -> None:
+        with w.lock:
+            pending = list(w.pending.values())
+            w.pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(_rerrors.DeadBackendError(msg))
+
+    def call(self, rank: int, method: str,
+             payload: Optional[dict] = None) -> Future:
+        w = self._workers[rank]
+        fut: Future = Future()
+        if w.dead:
+            fut.set_exception(_rerrors.DeadBackendError(
+                f"fabric worker {rank} is not alive"))
+            return fut
+        req_id = next(self._req_ids)
+        fut._raft_req_id = req_id
+        with w.lock:
+            w.pending[req_id] = fut
+        w.q.put((req_id, method, payload))
+        return fut
+
+    def forget(self, rank: int, fut: Future) -> None:
+        req_id = getattr(fut, "_raft_req_id", None)
+        if req_id is None:
+            return
+        w = self._workers[rank]
+        with w.lock:
+            w.pending.pop(req_id, None)
+
+    def alive(self, rank: int) -> bool:
+        return not self._workers[rank].dead
+
+    def kill(self, rank: int) -> None:
+        w = self._workers[rank]
+        w.dead = True
+        self._fail_pending(w, f"fabric worker {rank} killed")
+
+    def restart(self, rank: int,
+                fault_spec: Optional[str] = None) -> None:
+        old = self._workers[rank]
+        old.dead = True
+        self._fail_pending(old, f"fabric worker {rank} restarted")
+        old.q.put(None)                 # let the old thread exit
+        if fault_spec:
+            faultinject.install(fault_spec)
+        self._workers[rank] = self._spawn(rank)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        for w in self._workers:
+            w.dead = True
+            w.q.put(None)
+            self._fail_pending(w, f"fabric worker {w.rank} closed")
+        for w in self._workers:
+            if w.thread is not None:
+                w.thread.join(timeout=timeout_s)

@@ -72,6 +72,16 @@ def _coverage(valid, axis_name) -> jax.Array:
     return jnp.mean(flags)
 
 
+def _record_full_coverage(what: str) -> None:
+    """The healthy-path twin of :func:`_finish_partial`'s gauge: the
+    plain (no validity scan) path serves full coverage by construction,
+    and recording ``shard_coverage{what} = 1`` there lets a dashboard
+    distinguish "healthy S/S shards" from "metric never emitted" —
+    previously the series only ever carried degraded values."""
+    if obs.enabled():
+        obs.gauge("shard_coverage", 1.0, what=what)
+
+
 def _finish_partial(out, partial_ok: bool, what: str):
     """Host-side tail of a partial-capable search: hand back (d, i,
     coverage) under ``partial_ok``, else raise on any dropout.
@@ -185,6 +195,7 @@ def sharded_knn(
         out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_knn")
+    _record_full_coverage("sharded_knn")
     return out
 
 
@@ -283,6 +294,7 @@ def sharded_ivf_search(
         out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_search")
+    _record_full_coverage("sharded_ivf_search")
     return out
 
 
@@ -457,6 +469,7 @@ def sharded_ivf_pq_search(
         out = jax.jit(fn)(*args)
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_pq_search")
+    _record_full_coverage("sharded_ivf_pq_search")
     return out
 
 

@@ -219,7 +219,12 @@ def run(
       measurement scripts use subprocess timeouts as the hard bound).
     * A ``dead_backend`` failure is only retried after
       :func:`backend_alive` confirms the device answers again; a probe
-      failure converts the retry into :class:`DeadBackendError`.
+      failure converts the retry into :class:`DeadBackendError`. The
+      probe runs on :func:`backend_alive`'s bounded daemon thread and
+      its wait is CLAMPED to the remaining ``deadline_s`` — a hanging
+      probe (the dead-axon init-hang mode) counts against the deadline
+      instead of stalling the retry loop ``probe_timeout_s`` past it,
+      and a probe that times out is classified ``dead_backend``.
     * ``token`` (an :class:`~raft_tpu.core.interruptible.Interruptible`)
       is checked before every attempt so ``cancel()`` from another
       thread stops the retry loop too.
@@ -243,11 +248,24 @@ def run(
                     f"deadline {deadline_s}s exhausted after "
                     f"{attempt + 1} attempt(s); last failure: {kind}"
                 ) from e
-            if kind == DEAD_BACKEND and not backend_alive(probe_timeout_s):
-                raise DeadBackendError(
-                    f"backend did not come back within {probe_timeout_s}s "
-                    f"after: {e}"
-                ) from e
+            if kind == DEAD_BACKEND:
+                # clamp the liveness probe to the remaining deadline:
+                # backend_alive's bounded daemon-thread join means a
+                # hung probe returns at the budget, but an unclamped
+                # probe_timeout_s (default 30s) could still stall the
+                # loop far past a tighter deadline_s
+                probe_budget = probe_timeout_s
+                if deadline_s is not None:
+                    probe_budget = min(
+                        probe_budget,
+                        deadline_s - (time.monotonic() - start) - sleep,
+                    )
+                if probe_budget <= 0 or not backend_alive(probe_budget):
+                    raise DeadBackendError(
+                        f"backend did not come back within "
+                        f"{max(probe_budget, 0.0):.3g}s probe budget "
+                        f"after: {e}"
+                    ) from e
             from raft_tpu import obs
 
             obs.counter("retries", kind=kind)

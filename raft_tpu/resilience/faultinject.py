@@ -11,6 +11,18 @@ Grammar (env ``RAFT_TPU_FAULTS``, comma-separated)::
     dead@stage:build.pass2#3   ... at that stage's chunk 3 specifically
     shard@rank:2           shard 2's local result is invalidated (queried
                            by the sharded searches, never raised)
+    dead@proc:2            fabric worker process 2 dies (hard exit, no
+                           response) at its next data-plane RPC
+    slow@proc:1*3          worker 1 stalls its next 3 data-plane RPCs
+                           (the late-answer / hedging failure mode)
+    drop@rpc:search        the next "search" RPC's response is dropped —
+                           the router sees only a timeout
+
+The ``proc``/``rpc`` scopes are consumed by the multi-host serving
+fabric's workers (:mod:`raft_tpu.comms.procgroup` via
+:func:`proc_action` / :func:`rpc_dropped`, docs/serving.md §10) rather
+than raised: process death and response loss are not exceptions at the
+fault site, they are *absences* the router must diagnose from timeouts.
 
 Instrumented loops call :func:`check` at every chunk boundary (the
 point where a real device failure would surface); matching specs raise
@@ -44,8 +56,15 @@ from raft_tpu.resilience import errors
 
 ENV_VAR = "RAFT_TPU_FAULTS"
 
-_KINDS = ("oom", "dead", "transient", "shard")
-_SCOPES = ("chunk", "stage", "rank")
+_KINDS = ("oom", "dead", "transient", "shard", "slow", "drop")
+_SCOPES = ("chunk", "stage", "rank", "proc", "rpc")
+
+# kind/scope compatibility for the process-level grammar: "slow" only
+# makes sense against a worker process, "drop" only against an RPC
+# response, and a process can only die or stall (an OOM inside a worker
+# surfaces as a normal classified exception via dead/oom@stage instead)
+_SCOPE_KINDS = {"proc": ("dead", "slow"), "rpc": ("drop",)}
+_KIND_SCOPES = {"slow": ("proc",), "drop": ("rpc",)}
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z]+)@(?P<scope>[a-z]+):(?P<arg>[^*]+?)(?:\*(?P<count>\d+))?$"
@@ -107,7 +126,17 @@ def parse(spec: str) -> List[FaultSpec]:
             raise ValueError(f"unknown fault kind {kind!r} (want {_KINDS})")
         if scope not in _SCOPES:
             raise ValueError(f"unknown fault scope {scope!r} (want {_SCOPES})")
-        if scope in ("chunk", "rank"):
+        if scope in _SCOPE_KINDS and kind not in _SCOPE_KINDS[scope]:
+            raise ValueError(
+                f"fault kind {kind!r} not valid at scope {scope!r} "
+                f"(want one of {_SCOPE_KINDS[scope]})"
+            )
+        if kind in _KIND_SCOPES and scope not in _KIND_SCOPES[kind]:
+            raise ValueError(
+                f"fault kind {kind!r} needs scope "
+                f"{_KIND_SCOPES[kind]}, got {scope!r}"
+            )
+        if scope in ("chunk", "rank", "proc"):
             int(m.group("arg"))          # validate now, fail loudly
         if scope == "stage" and "#" in m.group("arg"):
             int(m.group("arg").rpartition("#")[2])   # stage#chunk form
@@ -186,7 +215,10 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
         return
     with _lock:
         for s in specs:
-            if s.kind == "shard" or s.remaining <= 0:
+            if s.kind == "shard" or s.scope in ("proc", "rpc") \
+                    or s.remaining <= 0:
+                # shard/proc/rpc specs are queried (dead_ranks,
+                # proc_action, rpc_dropped), never raised here
                 continue
             if s.scope == "chunk":
                 hit = chunk is not None and int(s.arg) == chunk
@@ -221,3 +253,61 @@ def dead_ranks() -> FrozenSet[int]:
 
 def has_shard_faults() -> bool:
     return bool(dead_ranks())
+
+
+def proc_action(rank: int) -> Optional[str]:
+    """Consume the first live process-scoped spec matching worker
+    ``rank`` and name the action it demands:
+
+    * ``"die"``  — a ``dead@proc:R`` spec: the worker must hard-exit
+      with no response (the SIGKILL / machine-loss mode);
+    * ``"slow"`` — a ``slow@proc:R*K`` spec: the worker must stall this
+      response past the router's hedge threshold (the late-answer mode).
+
+    Returns ``None`` when nothing matches. Called by the fabric workers
+    (:mod:`raft_tpu.comms.procgroup`) at their data-plane fault points —
+    the place a real machine failure would surface."""
+    specs = plan()
+    if not specs:
+        return None
+    with _lock:
+        for s in specs:
+            if s.scope != "proc" or s.remaining <= 0:
+                continue
+            if int(s.arg) != int(rank):
+                continue
+            s.remaining -= 1
+            action = "die" if s.kind == "dead" else "slow"
+            from raft_tpu import obs
+
+            obs.counter("faults_injected", kind=s.kind,
+                        stage=f"proc:{rank}")
+            obs.event("fault_injected",
+                      spec=f"{s.kind}@{s.scope}:{s.arg}", rank=int(rank),
+                      action=action)
+            return action
+    return None
+
+
+def rpc_dropped(method: str) -> bool:
+    """Consume a ``drop@rpc:METHOD`` spec: True means this RPC's
+    response must be dropped on the floor — the caller sees only a
+    timeout, exactly like a response lost on the wire."""
+    specs = plan()
+    if not specs:
+        return False
+    with _lock:
+        for s in specs:
+            if s.scope != "rpc" or s.remaining <= 0:
+                continue
+            if s.arg != method:
+                continue
+            s.remaining -= 1
+            from raft_tpu import obs
+
+            obs.counter("faults_injected", kind=s.kind,
+                        stage=f"rpc:{method}")
+            obs.event("fault_injected",
+                      spec=f"{s.kind}@{s.scope}:{s.arg}", method=method)
+            return True
+    return False

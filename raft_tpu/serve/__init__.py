@@ -25,7 +25,13 @@ lives in, and the piece TPU-KNN's peak-FLOP/s numbers quietly assume
   ``obs`` (queue depth, fill ratio, rejects, swaps, per-bucket
   latency), ``resilience.run`` (classified retry; OOM downshifts the
   bucket ceiling), and ``tuning`` (measured bucket choice, learned
-  row budgets).
+  row budgets);
+* **multi-host fabric** (:mod:`raft_tpu.serve.fabric`, ISSUE 6) — the
+  cluster tier: N worker processes own index shards
+  (:mod:`raft_tpu.comms.procgroup`), a router fans each micro-batch to
+  shard owners with health-tracked circuit breaking, hedged retries,
+  per-row coverage on degraded answers, and a two-phase cross-host
+  hot-swap barrier over the registry (docs/serving.md §10).
 """
 
 from raft_tpu.serve.batcher import (
@@ -37,6 +43,12 @@ from raft_tpu.serve.batcher import (
     choose_bucket,
 )
 from raft_tpu.serve.engine import ServeParams, Server
+from raft_tpu.serve.fabric import (
+    Fabric,
+    FabricParams,
+    FabricSwapError,
+    WorkerHealth,
+)
 from raft_tpu.serve.mutation import MutableState
 from raft_tpu.serve.registry import Generation, Registry
 
@@ -87,8 +99,9 @@ def total_trace_count() -> int:
 
 
 __all__ = [
-    "Batch", "Generation", "MicroBatcher", "MutableState", "Overloaded",
-    "Registry", "Request", "ServeParams", "Server", "TRACKED_JITS",
+    "Batch", "Fabric", "FabricParams", "FabricSwapError", "Generation",
+    "MicroBatcher", "MutableState", "Overloaded", "Registry", "Request",
+    "ServeParams", "Server", "TRACKED_JITS", "WorkerHealth",
     "bucket_ladder", "choose_bucket", "total_trace_count",
     "trace_cache_sizes",
 ]

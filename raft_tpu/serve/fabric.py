@@ -1,0 +1,870 @@
+"""Fault-tolerant multi-host serving fabric (ISSUE 6; docs/serving.md §10).
+
+Everything below this module survives failures *inside* one process
+(the resilience ladder, the serve engine's retry/downshift). A
+production jax_graft deployment runs the index sharded across many
+hosts, where the dominant failure mode is a *peer* that hangs, dies, or
+answers late — RAFT's raft-dask tier (PAPER.md), and the regime Fantasy
+(PAPERS.md) shows wants asynchronous per-shard routing with explicit
+failure handling rather than lockstep collectives that stall every
+query on the slowest rank. This module is that tier:
+
+    search ──► router ──pin──► Registry generation (cluster shard map)
+                 │ per-shard RPC (deadline, classified retry,
+                 │               hedged duplicate past the latency
+                 │               percentile)
+                 ▼
+        worker processes (comms/procgroup.py) — shard owners
+                 │ per-shard top-k
+                 ▼
+        merge_topk + per-row coverage ──► (d, i, coverage)
+
+Robustness core:
+
+* **health tracking** — a per-worker circuit breaker
+  (:class:`WorkerHealth`): consecutive classified failures open the
+  circuit, a confirmed-dead process opens it immediately, and recovery
+  goes through half-open probing (the in-process
+  ``resilience.backend_alive`` liveness check promoted to a peer
+  ``ping`` RPC);
+* **hedged retries** — per-shard RPC deadlines with classified
+  retry/backoff (``resilience.run``'s contract generalized across the
+  process boundary), plus a hedged duplicate request to a replica once
+  the primary is slower than the measured latency percentile
+  (first answer wins, the loser is discarded);
+* **coverage-degraded answers** — a lost shard degrades the answer
+  instead of failing it: per-ROW coverage rides back with every result
+  (the ``partial_ok`` machinery of ``comms/sharded.py`` generalized
+  across processes), and :class:`ShardDropoutError` fires only when
+  coverage falls below the configured floor (or ``partial_ok=False``);
+* **coordinated hot-swap** — a two-phase generation barrier over the
+  PR 5 registry: prepare-and-warm on every live worker, then one
+  atomic cluster-wide publish; any prepare failure aborts and rolls
+  every worker back, so answers either come fully from the old
+  generation or fully from the new one (each RPC pins its generation
+  id; a mixed-generation merge is structurally impossible and counted
+  if a worker ever violates it).
+
+Every failure path is deterministically CPU-testable: workers are
+``multiprocessing`` children (:class:`~raft_tpu.comms.procgroup.ProcGroup`)
+or in-process threads (:class:`~raft_tpu.comms.procgroup.LocalGroup`),
+and the fault grammar gains process scopes (``dead@proc:R``,
+``slow@proc:R*K``, ``drop@rpc:METHOD`` — docs/resilience.md §6).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    ThreadPoolExecutor,
+)
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from concurrent.futures import wait as _futures_wait
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu import obs, tuning
+from raft_tpu.comms.procgroup import LocalGroup, ProcGroup, is_no_gen
+from raft_tpu.resilience import ShardDropoutError
+from raft_tpu.resilience import errors as _rerrors
+from raft_tpu.serve.registry import Registry
+
+# circuit-breaker states
+CLOSED = "closed"          # routable
+OPEN = "open"              # excluded from routing, awaiting half-open
+HALF_OPEN = "half_open"    # one probe decides readmission
+
+_HEALTH_VALUE = {CLOSED: 1.0, HALF_OPEN: 0.5, OPEN: 0.0}
+
+# per-shard RPC latency histogram edges (ms) — finer than the serve
+# batch buckets: hedging decisions live in the single-digit range
+_RPC_LAT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                    5000)
+
+
+class FabricSwapError(RuntimeError):
+    """A cluster-wide swap failed during PREPARE and was rolled back on
+    every worker — the old generation keeps serving, so the correct
+    client move is backoff-and-retry (``fault_kind = transient``)."""
+
+    fault_kind = _rerrors.TRANSIENT
+
+
+@dataclasses.dataclass
+class FabricParams:
+    """Fabric knobs (docs/serving.md §10)."""
+
+    n_workers: int = 3            # worker processes == shards
+    replication: int = 2          # owners per shard (hedge/failover pool)
+    worker_algo: str = "brute_force"   # per-shard index ("ivf_flat" too)
+    rpc_deadline_s: float = 5.0   # per-shard RPC budget (all attempts)
+    rpc_retries: int = 2          # classified retries per shard
+    retry_backoff_s: float = 0.02
+    hedge_after_ms: Optional[float] = None  # None -> measured percentile
+    hedge_percentile: float = 95.0
+    partial_ok: bool = True       # degrade instead of raising
+    coverage_floor: float = 0.0   # min per-row coverage before raising
+    fail_threshold: int = 3       # consecutive failures -> circuit opens
+    halfopen_after_s: float = 0.25
+    probe_interval_s: Optional[float] = None  # None -> tuning budget
+    probe_timeout_s: float = 5.0
+    swap_deadline_s: float = 120.0
+    slow_ms: float = 150.0        # injected slow@proc stall length
+    worker_platform: Optional[str] = "cpu"
+    # per-shard routing tasks are WAIT-bound (deadline waits, backoff
+    # sleeps), not CPU-bound: size this >= expected concurrent searches
+    # x n_workers, or shard tasks queue behind blocked ones and one
+    # slow worker's deadline waits head-of-line block healthy shards
+    # of unrelated requests
+    router_threads: int = 64
+    auto_probe: bool = True       # background prober thread
+
+
+class WorkerHealth:
+    """One worker's circuit breaker: CLOSED (routable) → OPEN after
+    ``fail_threshold`` consecutive classified failures (immediately on
+    a confirmed-dead process) → HALF_OPEN once ``halfopen_after_s`` has
+    passed → CLOSED again on a successful probe, or back to OPEN on a
+    failed one. Transitions are gauged/counted through graft-scope
+    (``fabric.worker_health{worker}``,
+    ``fabric.circuit_transitions{worker,to}``)."""
+
+    def __init__(self, rank: int, fail_threshold: int,
+                 halfopen_after_s: float):
+        self.rank = int(rank)
+        self.fail_threshold = int(fail_threshold)
+        self.halfopen_after_s = float(halfopen_after_s)
+        self.lock = threading.Lock()
+        self.state = CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        obs.gauge("fabric.worker_health", 1.0, worker=self.rank)
+
+    def _transition(self, to: str) -> None:
+        # caller holds self.lock
+        self.state = to
+        obs.counter("fabric.circuit_transitions", worker=self.rank,
+                    to=to)
+        obs.gauge("fabric.worker_health", _HEALTH_VALUE[to],
+                  worker=self.rank)
+        obs.event("fabric_circuit", worker=self.rank, to=to)
+
+    def record_success(self) -> None:
+        with self.lock:
+            self.failures = 0
+            if self.state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self, kind: str) -> None:
+        with self.lock:
+            self.failures += 1
+            trip = (self.state == HALF_OPEN
+                    or kind == _rerrors.DEAD_BACKEND
+                    or self.failures >= self.fail_threshold)
+            if trip:
+                if self.state != OPEN:
+                    self._transition(OPEN)
+                self.opened_at = time.monotonic()
+
+    def routable(self) -> bool:
+        with self.lock:
+            return self.state == CLOSED
+
+    def due_for_probe(self, now: float) -> bool:
+        with self.lock:
+            return (self.state == OPEN
+                    and now - self.opened_at >= self.halfopen_after_s)
+
+    def to_half_open(self) -> None:
+        with self.lock:
+            if self.state == OPEN:
+                self._transition(HALF_OPEN)
+
+    def force_open(self) -> None:
+        """Used by restart: a respawned worker is not routable until a
+        half-open probe admits it (``opened_at`` reset to the epoch so
+        the probe is due immediately)."""
+        with self.lock:
+            if self.state != OPEN:
+                self._transition(OPEN)
+            self.opened_at = 0.0
+
+
+class _ClusterGen:
+    """One published cluster generation: the shard→owners map plus the
+    shapes the router validates against. The registry manages identity
+    and lifetime (pins, drain) exactly as it does for the single-process
+    engine's handles."""
+
+    __slots__ = ("gen_id", "owners", "n_shards", "rows", "dim")
+
+    def __init__(self, gen_id: int, owners: Dict[int, Tuple[int, ...]],
+                 rows: int, dim: int):
+        self.gen_id = int(gen_id)
+        self.owners = owners
+        self.n_shards = len(owners)
+        self.rows = int(rows)
+        self.dim = int(dim)
+
+
+def shard_bounds(n_rows: int, n_shards: int) -> List[int]:
+    """Contiguous near-equal row split: ``bounds[s]:bounds[s+1]`` is
+    shard ``s``. Shared with the tests' surviving-shard oracle."""
+    return [round(n_rows * s / n_shards) for s in range(n_shards + 1)]
+
+
+def merge_shard_results(
+    n_shards: int,
+    results: Dict[int, Optional[tuple]],
+    m: int,
+    k: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Merge per-shard ``(worker, d, i)`` results (``None`` = shard
+    uncovered) into a global top-k via the existing ``merge_topk``,
+    returning host ``(d [m,k], i [m,k], validity [S,m])``.
+
+    Row-granular validity, matching ``comms/sharded._mask_invalid``:
+    an uncovered shard invalidates all its rows; a NaN row inside a
+    covered shard's answer invalidates only that row. Invalid entries
+    ride at the worst-possible sentinel with ids -1, so the merge ranks
+    every surviving candidate ahead of them."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.common import merge_topk
+
+    cd = np.full((m, n_shards * k), np.inf, np.float32)
+    ci = np.full((m, n_shards * k), -1, np.int32)
+    validity = np.zeros((n_shards, m), bool)
+    for s in range(n_shards):
+        res = results.get(s)
+        if res is None:
+            continue
+        _worker, d, i = res
+        d = np.asarray(d, np.float32)
+        i = np.asarray(i, np.int32)
+        row_ok = ~np.isnan(d).any(axis=1)
+        cd[:, s * k:(s + 1) * k] = np.where(row_ok[:, None], d, np.inf)
+        ci[:, s * k:(s + 1) * k] = np.where(row_ok[:, None], i, -1)
+        validity[s] = row_ok
+    md, mi = merge_topk(jnp.asarray(cd), jnp.asarray(ci), int(k), True)
+    return np.asarray(md), np.asarray(mi), validity
+
+
+_GROUPS = {"proc": ProcGroup, "local": LocalGroup}
+
+
+class Fabric:
+    """The multi-host serving tier: N workers each own index shards, a
+    router fans each micro-batch to shard owners and merges per-shard
+    top-k, returning ``(d, i, coverage)``::
+
+        fab = serve.Fabric(dataset, params=serve.FabricParams())
+        d, i, coverage = fab.search(queries, k=10)
+        fab.swap(new_dataset)          # two-phase cluster hot-swap
+        fab.restart_worker(2)          # after a machine loss
+        fab.close()
+
+    Metric: squared euclidean (the library's min-close default) — the
+    merge sentinel and validity masks assume select-min.
+    """
+
+    def __init__(self, dataset, *, params: Optional[FabricParams] = None,
+                 name: str = "default", group="proc",
+                 fault_spec: Optional[str] = None):
+        self.params = params or FabricParams()
+        p = self.params
+        dataset = np.ascontiguousarray(np.asarray(dataset),
+                                       dtype=np.float32)
+        if dataset.ndim != 2:
+            raise ValueError("dataset must be [rows, dim]")
+        if dataset.shape[0] < p.n_workers:
+            raise ValueError(
+                f"dataset rows {dataset.shape[0]} < n_workers "
+                f"{p.n_workers}: every worker needs a non-empty shard")
+        self.name = name
+        self.dim = int(dataset.shape[1])
+        self.registry = Registry()
+        self.health = [
+            WorkerHealth(r, p.fail_threshold, p.halfopen_after_s)
+            for r in range(p.n_workers)
+        ]
+        self._counters: collections.Counter = collections.Counter()
+        self._stats_lock = threading.Lock()
+        self._lat_ms: collections.deque = collections.deque(maxlen=256)
+        self._gen_counter = 0
+        self._swap_lock = threading.Lock()
+        self._closed = False
+        self._dataset = dataset
+        if isinstance(group, str):
+            self.group = _GROUPS[group](
+                p.n_workers, algo=p.worker_algo, slow_s=p.slow_ms / 1e3,
+                fault_spec=fault_spec, platform=p.worker_platform)
+        else:
+            self.group = group
+        self._pool = ThreadPoolExecutor(
+            max_workers=p.router_threads,
+            thread_name_prefix=f"raft-tpu-fabric-{name}")
+        # initial load rides the SAME two-phase protocol as every later
+        # swap — one code path, one set of failure modes
+        try:
+            self._publish_generation(dataset, initial=True)
+        except BaseException as e:  # noqa: BLE001 — classified, then the half-built fabric is torn down before re-raising
+            _rerrors.classify(e)
+            self._closed = True
+            self._pool.shutdown(wait=False)
+            self.group.close()
+            raise
+        interval = p.probe_interval_s
+        if interval is None:
+            # probe cadence as a measured budget: a recorded ceiling
+            # (e.g. from a deployment that learned its failure-detection
+            # latency requirement) clamps the default
+            interval = tuning.budget("fabric_probe_interval_ms", 250) / 1e3
+        self._probe_interval_s = float(interval)
+        self._prober: Optional[threading.Thread] = None
+        if p.auto_probe:
+            self._prober = threading.Thread(
+                target=self._probe_loop, daemon=True,
+                name=f"raft-tpu-fabric-prober-{name}")
+            self._prober.start()
+
+    # -- the data plane -----------------------------------------------------
+
+    def search(self, queries, k: int, *, partial_ok: Optional[bool] = None,
+               detail: bool = False):
+        """Fan one micro-batch to the shard owners and merge.
+
+        Returns ``(d [m,k], i [m,k], coverage [m])`` — ``coverage`` is
+        the per-row fraction of shards that contributed a valid answer.
+        With ``detail=True`` the return grows to ``(d, i, coverage,
+        validity [S,m], gen_id)`` for callers that need to audit which
+        shards covered which rows (the chaos acceptance test's
+        surviving-shard oracle).
+
+        ``partial_ok=False`` raises :class:`ShardDropoutError` on ANY
+        dropout; the default (:attr:`FabricParams.partial_ok`) degrades
+        gracefully until per-row coverage falls below
+        :attr:`FabricParams.coverage_floor`."""
+        p = self.params
+        partial = p.partial_ok if partial_ok is None else bool(partial_ok)
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        if q.ndim == 1:
+            q = q[None, :]
+        if q.ndim != 2 or q.shape[1] != self.dim:
+            raise ValueError(
+                f"queries must be [rows, {self.dim}], got {q.shape}")
+        if self._closed:
+            raise RuntimeError("fabric is closed")
+        m = int(q.shape[0])
+        k = int(k)
+        with obs.entry_span("search", "fabric", queries=m, k=k):
+            gen = self.registry.pin(self.name)
+            try:
+                h: _ClusterGen = gen.handle
+                if k > h.rows:
+                    raise ValueError(f"k={k} exceeds fabric rows={h.rows}")
+                futs = {
+                    s: self._pool.submit(self._search_shard, h, s, q, k)
+                    for s in range(h.n_shards)
+                }
+                results = {s: f.result() for s, f in futs.items()}
+                gen_id = h.gen_id
+                n_shards = h.n_shards
+            finally:
+                gen.release()
+            d, i, validity = merge_shard_results(n_shards, results, m, k)
+            coverage = (validity.mean(axis=0, dtype=np.float32) if m
+                        else np.ones((0,), np.float32))
+            cov_min = float(coverage.min()) if m else 1.0
+            obs.gauge("fabric.coverage",
+                      float(coverage.mean()) if m else 1.0)
+            uncovered = sorted(s for s, r in results.items() if r is None)
+            if uncovered:
+                self._count("dropouts", len(uncovered))
+                obs.counter("fabric.dropouts_total", len(uncovered))
+                obs.event("fabric_shard_dropout", shards=uncovered,
+                          coverage=cov_min, gen=gen_id)
+            if not partial and cov_min < 1.0:
+                raise ShardDropoutError(
+                    f"fabric[{self.name}]: coverage {cov_min:.3f} < 1 "
+                    f"(shards {uncovered or 'row-invalid'} dropped); "
+                    "pass partial_ok=True to accept degraded answers")
+            if partial and cov_min < p.coverage_floor:
+                raise ShardDropoutError(
+                    f"fabric[{self.name}]: coverage {cov_min:.3f} below "
+                    f"floor {p.coverage_floor} (shards {uncovered})")
+            if detail:
+                return d, i, coverage, validity, gen_id
+            return d, i, coverage
+
+    # -- per-shard routing --------------------------------------------------
+
+    def _route_order(self, owners: Sequence[int],
+                     exclude: Sequence[int]) -> List[int]:
+        """Owner preference for one attempt: healthy (closed) owners
+        first in declared order, then half-open ones as a last resort
+        (their probe-in-flight state tolerates one trial request);
+        open-circuit owners and already-tried primaries are out."""
+        closed = [r for r in owners
+                  if r not in exclude and self.health[r].routable()]
+        half = [r for r in owners
+                if r not in exclude
+                and self.health[r].state == HALF_OPEN]
+        return closed + half
+
+    def _search_shard(self, h: _ClusterGen, shard: int, q: np.ndarray,
+                      k: int) -> Optional[tuple]:
+        """One shard's routed search: deadline-bounded, classified
+        retry/backoff across owners, hedged duplicate past the latency
+        percentile. Returns ``(worker, d, i)`` or ``None`` (shard
+        uncovered this batch). Never raises — an uncovered shard is a
+        coverage event, not an exception."""
+        p = self.params
+        deadline = time.monotonic() + p.rpc_deadline_s
+        payload = {"gen": h.gen_id, "shard": int(shard), "q": q,
+                   "k": int(k)}
+        tried: List[int] = []
+        attempt = 0
+        while True:
+            owners = self._route_order(h.owners[shard], tried)
+            if not owners:
+                return None
+            primary = owners[0]
+            out = self._rpc_hedged(primary, owners[1:], payload, deadline,
+                                   shard)
+            if out is not None:
+                return out
+            tried.append(primary)
+            attempt += 1
+            if attempt > p.rpc_retries:
+                return None
+            backoff = p.retry_backoff_s * (2 ** (attempt - 1))
+            if time.monotonic() + backoff >= deadline:
+                return None
+            self._count("retries")
+            obs.counter("fabric.rpc_retries_total")
+            time.sleep(backoff)
+
+    def _rpc_hedged(self, primary: int, alternates: Sequence[int],
+                    payload: dict, deadline: float,
+                    shard: int) -> Optional[tuple]:
+        """One routed attempt: RPC the primary; once it is slower than
+        the hedge threshold, duplicate the request to the first
+        alternate and take whichever valid answer lands first. The
+        loser's late response is discarded by the transport."""
+        p = self.params
+        outstanding: List[Tuple[int, Future]] = [
+            (primary, self.group.call(primary, "search", payload))
+        ]
+        hedge_s = self._hedge_delay_ms() / 1e3
+        hedged = False
+        # per-rank send times: a hedge win must be timed from ITS call
+        # site, or every win would record hedge-delay + replica latency
+        # — inflating the measured percentile the next hedge delay is
+        # derived from, and blaming the fast replica for the wait
+        sent = {primary: time.perf_counter()}
+        while outstanding:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                for rank, f in outstanding:
+                    kind = (_rerrors.TRANSIENT if self.group.alive(rank)
+                            else _rerrors.DEAD_BACKEND)
+                    self.health[rank].record_failure(kind)
+                    obs.counter("fabric.rpc_timeouts_total", worker=rank,
+                                kind=kind)
+                    # abandon the request at the transport so a reply
+                    # that never comes (dropped RPC, hung worker) does
+                    # not pin its Future + query payload forever
+                    self.group.forget(rank, f)
+                return None
+            wait_s = remaining
+            if not hedged and alternates:
+                wait_s = min(wait_s, max(hedge_s, 1e-4))
+            done, _ = _futures_wait([f for _, f in outstanding],
+                                    timeout=wait_s,
+                                    return_when=FIRST_COMPLETED)
+            if not done:
+                if not hedged and alternates:
+                    alt = alternates[0]
+                    sent[alt] = time.perf_counter()
+                    outstanding.append(
+                        (alt, self.group.call(alt, "search", payload)))
+                    hedged = True
+                    self._count("hedges")
+                    obs.counter("fabric.hedges_total", worker=alt)
+                    obs.event("fabric_hedge", shard=shard,
+                              primary=primary, hedge=alt)
+                continue
+            for rank, f in list(outstanding):
+                if f not in done:
+                    continue
+                outstanding.remove((rank, f))
+                try:
+                    res = f.result()
+                except BaseException as e:  # noqa: BLE001 — classified right here, per worker
+                    kind = self._fail_kind(e, rank)
+                    if is_no_gen(e):
+                        # stale, not sick: missed a publish while
+                        # partitioned — the next probe round re-syncs
+                        # it (every non-open worker is pinged, and a
+                        # ping that misses the current generation
+                        # triggers _sync_worker); do not trip the
+                        # breaker
+                        obs.counter("fabric.stale_worker_total",
+                                    worker=rank)
+                    else:
+                        self.health[rank].record_failure(kind)
+                        obs.counter("fabric.rpc_errors_total",
+                                    worker=rank, kind=kind)
+                    continue
+                if int(res["gen"]) != int(payload["gen"]):
+                    # structurally impossible (workers answer from the
+                    # requested generation) — counted so the chaos
+                    # acceptance can PROVE no mixed-generation answer
+                    # ever merged
+                    self._count("mixed_gen")
+                    obs.counter("fabric.mixed_generation_total",
+                                worker=rank)
+                    continue
+                self._observe_latency(
+                    rank, (time.perf_counter() - sent[rank]) * 1e3)
+                self.health[rank].record_success()
+                for loser, lf in outstanding:
+                    # hedge loser: drop its pending entry now — a slow
+                    # reply cleans itself up on arrival, but a reply
+                    # that never comes would leak the Future
+                    self.group.forget(loser, lf)
+                return rank, np.asarray(res["d"]), np.asarray(res["i"])
+        return None
+
+    def _fail_kind(self, exc: BaseException, rank: int) -> str:
+        if isinstance(exc, FutureTimeoutError):
+            return (_rerrors.TRANSIENT if self.group.alive(rank)
+                    else _rerrors.DEAD_BACKEND)
+        return _rerrors.classify(exc)
+
+    # -- hedge-delay measurement --------------------------------------------
+
+    def _hedge_delay_ms(self) -> float:
+        p = self.params
+        if p.hedge_after_ms is not None:
+            return float(p.hedge_after_ms)
+        with self._stats_lock:
+            samples = list(self._lat_ms)
+        if len(samples) >= 16:
+            return max(
+                float(np.percentile(samples, p.hedge_percentile)), 0.5)
+        return float(tuning.budget("fabric_hedge_ms", 50))
+
+    def _observe_latency(self, rank: int, ms: float) -> None:
+        obs.observe("fabric.rpc_latency_ms", ms,
+                    buckets=_RPC_LAT_BUCKETS, worker=rank)
+        with self._stats_lock:
+            self._lat_ms.append(ms)
+
+    # -- two-phase cluster hot-swap -----------------------------------------
+
+    def swap(self, dataset) -> int:
+        """Replace the whole fabric's content with a two-phase
+        generation barrier: (1) PREPARE — every live worker builds and
+        warms its new shards under the staged generation; any failure
+        aborts and rolls all of them back
+        (:class:`FabricSwapError`, old generation keeps serving);
+        (2) PUBLISH — one atomic cluster-wide switch, after which the
+        registry advances and in-flight batches finish on the
+        generation they pinned. Returns the new generation id."""
+        with obs.span("fabric.swap", index=self.name):
+            dataset = np.ascontiguousarray(np.asarray(dataset),
+                                           dtype=np.float32)
+            if dataset.ndim != 2 or dataset.shape[1] != self.dim:
+                raise ValueError(
+                    f"dataset must be [rows, {self.dim}], "
+                    f"got {dataset.shape}")
+            if dataset.shape[0] < self.params.n_workers:
+                # same contract as __init__ — and a ValueError, not a
+                # transient FabricSwapError a resilience-aware client
+                # would retry forever
+                raise ValueError(
+                    f"dataset rows {dataset.shape[0]} < n_workers "
+                    f"{self.params.n_workers}: every worker needs a "
+                    "non-empty shard")
+            if self._closed:
+                raise RuntimeError("fabric is closed")
+            return self._publish_generation(dataset)
+
+    def _publish_generation(self, dataset: np.ndarray,
+                            initial: bool = False) -> int:
+        p = self.params
+        with self._swap_lock:
+            self._gen_counter += 1
+            gen_id = self._gen_counter
+            bounds = shard_bounds(dataset.shape[0], p.n_workers)
+            owners = {
+                s: tuple((s + j) % p.n_workers
+                         for j in range(min(p.replication, p.n_workers)))
+                for s in range(p.n_workers)
+            }
+            live = [r for r in range(p.n_workers) if self.group.alive(r)]
+            if initial and len(live) < p.n_workers:
+                raise RuntimeError(
+                    "fabric bootstrap needs every worker alive, got "
+                    f"{live} of {p.n_workers}")
+            for s, ranks in owners.items():
+                if not any(r in live for r in ranks):
+                    raise FabricSwapError(
+                        f"generation {gen_id} impossible: shard {s} has "
+                        f"no live owner (owners {ranks})")
+            per_worker: Dict[int, dict] = {r: {} for r in live}
+            for s, ranks in owners.items():
+                vec = dataset[bounds[s]:bounds[s + 1]]
+                for r in ranks:
+                    if r in per_worker:
+                        per_worker[r][s] = (vec, bounds[s])
+            deadline = time.monotonic() + p.swap_deadline_s
+            # phase 1: prepare-and-warm everywhere, or roll back
+            futs = {
+                r: self.group.call(r, "prepare",
+                                   {"gen": gen_id,
+                                    "shards": per_worker[r]})
+                for r in live
+            }
+            failed = self._await_all(futs, deadline)
+            if failed:
+                self._abort_generation(gen_id, live)
+                self._count("swap_aborts")
+                obs.counter("fabric.swap_aborts_total")
+                obs.event("fabric_swap_abort", gen=gen_id,
+                          failed={r: str(e)[:160]
+                                  for r, e in failed.items()})
+                raise FabricSwapError(
+                    f"generation {gen_id} aborted: prepare failed on "
+                    f"worker(s) {sorted(failed)}; rolled back — "
+                    f"generation {self.generation()} keeps serving")
+            # phase 2: publish. A local pointer swap — an alive worker
+            # can only fail it by dying or losing the ack, and either
+            # way it is no longer treated as live: its circuit opens
+            # and the half-open resync path re-publishes the staged
+            # generation (publish is idempotent), so live workers are
+            # never mixed-generation.
+            futs = {r: self.group.call(r, "publish", {"gen": gen_id})
+                    for r in live}
+            failed = self._await_all(futs, deadline)
+            for r in failed:
+                # a lost publish ack evicts the worker from routing
+                # until the half-open resync re-publishes the staged
+                # generation (idempotent) and readmits it
+                self.health[r].force_open()
+            # capture the prior generation's id BEFORE publishing: with
+            # no pins outstanding, publish retires-and-drains it inline,
+            # nulling its handle
+            prior = self.registry.get(self.name)
+            old_gid = (prior.handle.gen_id
+                       if prior is not None and prior.handle is not None
+                       else None)
+            handle = _ClusterGen(gen_id, owners, dataset.shape[0],
+                                 self.dim)
+            self.registry.publish(self.name, handle)
+            self._dataset = dataset
+            if old_gid is not None:
+                # workers keep the retired generation until its last
+                # router pin drops — in-flight batches finish on it
+                prior.add_on_drain(
+                    lambda _g, gid=old_gid: self._retire_cluster(gid))
+            self._count("swaps")
+            obs.counter("fabric.swaps_total")
+            obs.gauge("fabric.generation", gen_id)
+            obs.event("fabric_generation_published", gen=gen_id,
+                      workers=sorted(live))
+            return gen_id
+
+    def _await_all(self, futs: Dict[int, Future],
+                   deadline: float) -> Dict[int, BaseException]:
+        failed: Dict[int, BaseException] = {}
+        for r, f in futs.items():
+            remaining = max(deadline - time.monotonic(), 1e-3)
+            try:
+                f.result(timeout=remaining)
+                self.health[r].record_success()
+            except BaseException as e:  # noqa: BLE001 — collected per worker, classified via _fail_kind
+                failed[r] = e
+                self.health[r].record_failure(self._fail_kind(e, r))
+                self.group.forget(r, f)
+        return failed
+
+    def _abort_generation(self, gen_id: int,
+                          ranks: Sequence[int]) -> None:
+        futs = [(r, self.group.call(r, "abort", {"gen": gen_id}))
+                for r in ranks]
+        for r, f in futs:
+            try:
+                f.result(timeout=2.0)
+            except BaseException as e:  # noqa: BLE001 — classified: abort is best-effort, a dead worker has nothing staged to drop
+                _rerrors.classify(e)
+                self.group.forget(r, f)
+
+    def _retire_cluster(self, gen_id: int) -> None:
+        for r in range(self.params.n_workers):
+            if not self.group.alive(r):
+                continue
+            try:
+                self.group.call(r, "retire", {"gen": gen_id})
+            except BaseException as e:  # noqa: BLE001 — classified: retire is best-effort GC of a drained generation
+                _rerrors.classify(e)
+
+    # -- health probing / recovery ------------------------------------------
+
+    def probe_now(self) -> Dict[int, str]:
+        """One synchronous probe round (the background prober's body,
+        callable directly for deterministic tests): due open circuits
+        move to half-open; half-open and closed workers are pinged; a
+        stale-but-alive worker is re-synced to the current generation
+        before re-admission. Returns the post-round state map."""
+        with obs.span("fabric.probe_round", index=self.name):
+            now = time.monotonic()
+            for rank in range(self.params.n_workers):
+                hl = self.health[rank]
+                if hl.state == OPEN:
+                    if not hl.due_for_probe(now):
+                        continue
+                    hl.to_half_open()
+                self._probe_worker(rank)
+            return {r: self.health[r].state
+                    for r in range(self.params.n_workers)}
+
+    def _probe_worker(self, rank: int) -> bool:
+        p = self.params
+        self._count("probes")
+        fut = self.group.call(rank, "ping", {})
+        try:
+            res = fut.result(timeout=p.probe_timeout_s)
+        except BaseException as e:  # noqa: BLE001 — classified via _fail_kind
+            self.health[rank].record_failure(self._fail_kind(e, rank))
+            obs.counter("fabric.probes_total", outcome="fail")
+            self.group.forget(rank, fut)
+            return False
+        cur = self.registry.get(self.name)
+        want = (cur.handle.gen_id
+                if cur is not None and cur.handle is not None else None)
+        if want is not None and want not in res.get("gens", ()):
+            # alive but missed a publish (restarted, or partitioned
+            # through the barrier): load it before readmitting, or it
+            # would answer every search with no_gen
+            if not self._sync_worker(rank, want):
+                obs.counter("fabric.probes_total", outcome="stale")
+                return False
+        self.health[rank].record_success()
+        obs.counter("fabric.probes_total", outcome="ok")
+        return True
+
+    def _sync_worker(self, rank: int, gen_id: int) -> bool:
+        """Prepare+publish the current generation on one stale worker
+        (the unilateral tail of the two-phase protocol — safe because
+        the cluster decision for ``gen_id`` is already COMMIT)."""
+        # snapshot (generation, dataset) under the swap lock: a swap
+        # concurrent with this probe could otherwise install the NEW
+        # dataset under the OLD generation id on the worker — a silent
+        # wrong-answer source the gen-id pin could not catch
+        with self._swap_lock:
+            cur = self.registry.get(self.name)
+            if cur is None or cur.handle is None \
+                    or cur.handle.gen_id != gen_id:
+                return False
+            h: _ClusterGen = cur.handle
+            dataset = self._dataset
+        bounds = shard_bounds(dataset.shape[0], h.n_shards)
+        shards = {
+            s: (dataset[bounds[s]:bounds[s + 1]], bounds[s])
+            for s, ranks in h.owners.items() if rank in ranks
+        }
+        fut = None
+        try:
+            fut = self.group.call(rank, "prepare",
+                                  {"gen": gen_id, "shards": shards})
+            fut.result(timeout=self.params.swap_deadline_s)
+            fut = self.group.call(rank, "publish", {"gen": gen_id})
+            fut.result(timeout=self.params.probe_timeout_s)
+        except BaseException as e:  # noqa: BLE001 — classified via _fail_kind; the breaker records the verdict
+            self.health[rank].record_failure(self._fail_kind(e, rank))
+            if fut is not None:
+                self.group.forget(rank, fut)
+            return False
+        obs.counter("fabric.worker_resyncs_total", worker=rank)
+        obs.event("fabric_worker_resync", worker=rank, gen=gen_id)
+        return True
+
+    def restart_worker(self, rank: int) -> None:
+        """Respawn a lost worker and stage it for HALF-OPEN
+        re-admission: the fresh process holds no index state, so it is
+        forced open (unrouted) and the next probe round re-syncs it to
+        the current generation before closing its circuit."""
+        with obs.span("fabric.restart_worker", index=self.name,
+                      worker=rank):
+            self.group.restart(rank)
+            self.health[rank].force_open()
+            self._count("restarts")
+            obs.counter("fabric.worker_restarts_total", worker=rank)
+            obs.event("fabric_worker_restart", worker=rank)
+
+    def _probe_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self._probe_interval_s)
+            if self._closed:
+                return
+            try:
+                self.probe_now()
+            except BaseException as e:  # noqa: BLE001 — classified: the prober must outlive any single bad round
+                _rerrors.classify(e)
+
+    # -- introspection / lifecycle ------------------------------------------
+
+    def generation(self) -> int:
+        cur = self.registry.get(self.name)
+        if cur is None or cur.handle is None:
+            return 0
+        return cur.handle.gen_id
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            counters = dict(self._counters)
+            lat = list(self._lat_ms)
+        return {
+            "generation": self.generation(),
+            "n_workers": self.params.n_workers,
+            "replication": self.params.replication,
+            "health": {r: self.health[r].state
+                       for r in range(self.params.n_workers)},
+            "counters": counters,
+            "rpc_p50_ms": (round(float(np.percentile(lat, 50)), 3)
+                           if lat else None),
+            "rpc_p95_ms": (round(float(np.percentile(lat, 95)), 3)
+                           if lat else None),
+            "hedge_delay_ms": round(self._hedge_delay_ms(), 3),
+        }
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._prober is not None:
+            self._prober.join(timeout=max(self._probe_interval_s * 2,
+                                          1.0))
+        self.registry.drop(self.name)
+        self._pool.shutdown(wait=False)
+        self.group.close(timeout_s=timeout_s)
+
+    def __enter__(self) -> "Fabric":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._counters[key] += n

@@ -61,13 +61,22 @@ STAGES = [
 
 # OPTIONAL stages (run with --serve, or name them in --only): the
 # graft-serve closed-loop load generator — SERVE_r05.json latency/
-# throughput sidecar + obs metrics snapshot (docs/serving.md §7)
+# throughput sidecar + obs metrics snapshot (docs/serving.md §7) —
+# and the multi-host fabric loadgen — FABRIC_r06.json (QPS, p99,
+# coverage, hedges, dropouts; docs/serving.md §10)
 OPTIONAL_STAGES = [
     ("serve_loadgen",
      [PY, "scripts/serve_loadgen.py", "--n", "200000", "--dim", "96",
       "--algo", "ivf_flat", "--concurrency", "32", "--duration-s", "60",
       "--k", "1,10,100", "--out", "SERVE_r05.json",
       "--obs-snapshot", "SERVE_r05.obs.json"], 900),
+    ("fabric_loadgen",
+     [PY, "scripts/serve_loadgen.py", "--fabric", "--n", "120000",
+      "--dim", "96", "--fabric-workers", "4",
+      "--fabric-replication", "2", "--concurrency", "16",
+      "--duration-s", "45", "--k", "1,10,100",
+      "--out", "FABRIC_r06.json",
+      "--obs-snapshot", "FABRIC_r06.obs.json"], 900),
 ]
 
 

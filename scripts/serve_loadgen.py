@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Closed-loop load generator for the graft-serve engine (ISSUE 5).
+"""Closed-loop load generator for the graft-serve engine (ISSUE 5)
+and the multi-host fabric (ISSUE 6, ``--fabric``).
 
 Builds an index, stands up a :class:`raft_tpu.serve.Server`, and drives
 it with ``--concurrency`` worker threads in closed loop (each worker
@@ -16,15 +17,25 @@ carry a delete/upsert mutation mix. Emits a latency/throughput sidecar
 full metrics snapshot (queue depth, per-bucket fill/latency histograms,
 admission rejects, swap counts — docs/serving.md §7) next to it.
 
-Wired as the optional ``serve_loadgen`` stage of
-``scripts/r5_measure_all.py`` (pass ``--serve`` there, or select it with
-``--only serve_loadgen``).
+``--fabric`` stands up a :class:`raft_tpu.serve.Fabric` (N worker
+processes owning index shards, docs/serving.md §10) instead of the
+single-process Server and drives ``fab.search`` directly, emitting a
+``FABRIC_r06.json`` sidecar (QPS, latency percentiles, per-row
+coverage, hedge/retry/dropout counters, worker health). ``--fault``
+installs a process-level fault spec (e.g. ``slow@proc:1*50``) in the
+workers so degraded-mode numbers are measurable on demand.
+
+Wired as the optional ``serve_loadgen`` / ``fabric_loadgen`` stages of
+``scripts/r5_measure_all.py`` (pass ``--serve`` there, or select with
+``--only``).
 
 Examples:
     python scripts/serve_loadgen.py --n 20000 --dim 64 --algo ivf_flat \
         --concurrency 16 --duration-s 10 --k 1,10,32
     python scripts/serve_loadgen.py --qps 500 --swap-mid-run \
         --obs-snapshot SERVE_r05.obs.json
+    python scripts/serve_loadgen.py --fabric --fabric-workers 4 \
+        --concurrency 16 --duration-s 30 --k 1,10,100
 """
 
 from __future__ import annotations
@@ -83,7 +94,23 @@ def main() -> int:
                     help="every Nth completed request also upserts one row")
     ap.add_argument("--swap-mid-run", action="store_true",
                     help="trigger one background rebuild+hot-swap halfway")
-    ap.add_argument("--out", default="SERVE_r05.json")
+    ap.add_argument("--fabric", action="store_true",
+                    help="drive the multi-host fabric (serve.Fabric) "
+                         "instead of the single-process Server")
+    ap.add_argument("--fabric-workers", type=int, default=3)
+    ap.add_argument("--fabric-replication", type=int, default=2)
+    ap.add_argument("--fabric-group", default="proc",
+                    choices=["proc", "local"],
+                    help="worker transport: real processes or the "
+                         "in-process thread twin")
+    ap.add_argument("--fabric-algo", default="brute_force",
+                    choices=["brute_force", "ivf_flat"])
+    ap.add_argument("--fault", default=None,
+                    help="RAFT_TPU_FAULTS-grammar spec installed in the "
+                         "fabric workers (e.g. 'slow@proc:1*50')")
+    ap.add_argument("--out", default=None,
+                    help="report path (default SERVE_r05.json, or "
+                         "FABRIC_r06.json with --fabric)")
     ap.add_argument("--obs-snapshot", default=None,
                     help="also write the graft-scope metrics snapshot here")
     ap.add_argument("--seed", type=int, default=0)
@@ -101,6 +128,11 @@ def main() -> int:
     ks = sorted({max(1, int(s)) for s in args.k.split(",") if s.strip()})
     rng = np.random.default_rng(args.seed)
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+
+    if args.out is None:
+        args.out = "FABRIC_r06.json" if args.fabric else "SERVE_r05.json"
+    if args.fabric:
+        return _run_fabric(args, ks, dataset, rng, obs, serve)
 
     params = serve.ServeParams(
         max_batch_rows=args.max_batch_rows,
@@ -214,6 +246,129 @@ def main() -> int:
     print(json.dumps({k: report[k] for k in
                       ("throughput_qps", "completed", "rejected",
                        "latency_ms")}), flush=True)
+    print(f"wrote {args.out}", flush=True)
+    return 0
+
+
+def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
+    """The --fabric leg: closed-loop/paced load against a
+    :class:`raft_tpu.serve.Fabric`, FABRIC_r06.json sidecar out."""
+    params = serve.FabricParams(
+        n_workers=args.fabric_workers,
+        replication=args.fabric_replication,
+        worker_algo=args.fabric_algo,
+    )
+    t_build = time.perf_counter()
+    fab = serve.Fabric(dataset, params=params, group=args.fabric_group,
+                       fault_spec=args.fault)
+    build_s = time.perf_counter() - t_build
+    print(f"fabric up: {args.fabric_workers} workers x "
+          f"{args.fabric_replication} replicas, {args.fabric_algo} "
+          f"n={args.n} d={args.dim} (spawn+load {build_s:.1f}s)",
+          flush=True)
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms: list = []
+    per_k = {k: [] for k in ks}
+    cov_sum = [0.0]
+    cov_min = [1.0]
+    counts = {"completed": 0, "degraded": 0, "errors": 0}
+    interval = (args.concurrency / args.qps) if args.qps > 0 else 0.0
+
+    def worker(wid: int):
+        wrng = np.random.default_rng(args.seed + 1000 + wid)
+        next_t = time.monotonic()
+        while not stop.is_set():
+            if interval:
+                next_t += interval
+                pause = next_t - time.monotonic()
+                if pause > 0:
+                    time.sleep(pause)
+            k = int(wrng.choice(ks))
+            q = wrng.standard_normal((1, args.dim)).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                d, ids, cov = fab.search(q, k)
+            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow loadgen accounting only; the fabric already classified the failure
+                with lock:
+                    counts["errors"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            c = float(cov.min()) if cov.size else 1.0
+            with lock:
+                counts["completed"] += 1
+                done = counts["completed"]
+                lat_ms.append(ms)
+                per_k[k].append(ms)
+                cov_sum[0] += c
+                cov_min[0] = min(cov_min[0], c)
+                if c < 1.0:
+                    counts["degraded"] += 1
+                if args.requests and done >= args.requests:
+                    stop.set()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
+    t_run = time.perf_counter()
+    for t in threads:
+        t.start()
+    swap_generation = None
+    if args.swap_mid_run:
+        time.sleep(args.duration_s / 2)
+        print("mid-run cluster hot swap...", flush=True)
+        try:
+            swap_generation = fab.swap(dataset)
+        except serve.FabricSwapError as e:
+            print(f"swap rolled back: {e}", flush=True)
+            swap_generation = "aborted"
+    deadline = t_run + (max(args.duration_s, 60.0) if args.requests
+                        else args.duration_s)
+    while not stop.is_set():
+        if time.perf_counter() >= deadline:
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    wall_s = time.perf_counter() - t_run
+
+    stats = fab.stats()
+    fab.close()
+    done = counts["completed"]
+    report = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "mode": "fabric", "algo": args.fabric_algo, "n": args.n,
+            "dim": args.dim, "workers": args.fabric_workers,
+            "replication": args.fabric_replication,
+            "group": args.fabric_group, "fault": args.fault,
+            "concurrency": args.concurrency, "qps_target": args.qps,
+            "k": ks, "duration_s": round(wall_s, 2),
+            "build_s": round(build_s, 2),
+        },
+        "throughput_qps": round(done / max(wall_s, 1e-9), 1),
+        **counts,
+        "swap_generation": swap_generation,
+        "latency_ms": _percentiles(lat_ms),
+        "per_k": {str(k): _percentiles(v) for k, v in per_k.items()},
+        "coverage": {
+            "mean": round(cov_sum[0] / done, 5) if done else None,
+            "min": round(cov_min[0], 5) if done else None,
+        },
+        "hedges": stats["counters"].get("hedges", 0),
+        "retries": stats["counters"].get("retries", 0),
+        "dropouts": stats["counters"].get("dropouts", 0),
+        "fabric": stats,
+    }
+    with open(os.path.join(ROOT, args.out), "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    if args.obs_snapshot:
+        obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    print(json.dumps({k: report[k] for k in
+                      ("throughput_qps", "completed", "coverage",
+                       "hedges", "dropouts", "latency_ms")}), flush=True)
     print(f"wrote {args.out}", flush=True)
     return 0
 

@@ -1,0 +1,368 @@
+"""Multi-host serving fabric (ISSUE 6; marker ``multihost``).
+
+Covers: exact routing vs a surviving-shard oracle (bitwise — the oracle
+runs the SAME per-shard search + merge code path), hedged retries past
+an injected slow worker, circuit breaking + half-open re-admission
+after a worker death, the two-phase cluster hot-swap (commit AND
+abort-rollback legs), the cross-process SIGKILL kill-and-resume drill,
+and the chaos acceptance: a closed-loop load run under injected
+``dead@proc`` + ``slow@proc`` faults with a mid-run swap, where every
+answer must be bitwise-correct for the shards it reports covered.
+
+Most tests run the in-process :class:`LocalGroup` transport (identical
+router semantics, no spawn cost); the kill-and-resume and chaos tests
+spawn real ``multiprocessing`` workers. Worker counts and timeouts are
+bounded so the suite rides tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import serve, tuning
+from raft_tpu.comms import procgroup
+from raft_tpu.resilience import ShardDropoutError, faultinject
+from raft_tpu.serve import fabric as fabmod
+
+pytestmark = pytest.mark.multihost
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultinject.clear()
+    tuning.reload()
+    yield
+    faultinject.clear()
+    tuning.reload()
+
+
+def _params(**kw):
+    base = dict(
+        n_workers=3, replication=2, rpc_deadline_s=3.0,
+        rpc_retries=2, retry_backoff_s=0.01, hedge_after_ms=25.0,
+        halfopen_after_s=0.05, probe_timeout_s=10.0,
+        swap_deadline_s=30.0, slow_ms=150.0, auto_probe=False,
+        fail_threshold=2,
+    )
+    base.update(kw)
+    return serve.FabricParams(**base)
+
+
+def _data(n=96, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, dim)).astype(np.float32),
+            rng.standard_normal((4, dim)).astype(np.float32))
+
+
+def _oracle(dataset, q, k, n_workers, covered, algo="brute_force"):
+    """Surviving-shard oracle: the same shard search + merge code path
+    the workers and router run, restricted to ``covered`` shards —
+    bitwise identity is the contract, not approximate recall."""
+    bounds = fabmod.shard_bounds(dataset.shape[0], n_workers)
+    results = {}
+    for s in range(n_workers):
+        if s not in covered:
+            results[s] = None
+            continue
+        entry = procgroup.build_shard_entry(
+            dataset[bounds[s]:bounds[s + 1]], bounds[s], algo)
+        d, i = procgroup.search_shard_entry(entry, q, k)
+        results[s] = (0, d, i)
+    return fabmod.merge_shard_results(n_workers, results, q.shape[0], k)
+
+
+# ---------------------------------------------------------------------------
+# LocalGroup: routing, hedging, circuit breaking, swap protocol
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_matches_oracle_full_coverage():
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        d, i, cov = fab.search(q, 5)
+        assert cov.shape == (4,) and (cov == 1.0).all()
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        # single-row convenience: 1-D query promotes to [1, dim]
+        d1, i1, cov1 = fab.search(q[0], 5)
+        np.testing.assert_array_equal(i1, oi[:1])
+        assert cov1.shape == (1,)
+
+
+def test_fabric_hedges_past_slow_worker():
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        fab.search(q, 5)                      # warm every traced shape
+        before = fab.stats()["counters"].get("hedges", 0)
+        # shard 0's primary owner is worker 0: stall exactly that RPC
+        # past the 25ms hedge threshold; the replica (worker 1) covers
+        with faultinject.inject("slow@proc:0*1"):
+            d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        assert fab.stats()["counters"].get("hedges", 0) > before
+
+
+def test_fabric_dead_worker_degrades_with_honest_coverage():
+    ds, q = _data()
+    p = _params(replication=1, rpc_deadline_s=0.5)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        with faultinject.inject("dead@proc:1"):
+            d, i, cov = fab.search(q, 5)
+        # shard 1 lost; per-row coverage says so on every row
+        np.testing.assert_allclose(cov, 2 / 3)
+        od, oi, validity = _oracle(ds, q, 5, 3, covered={0, 2})
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        assert not validity[1].any()
+        # the confirmed-dead worker's circuit opened
+        assert fab.stats()["health"][1] == "open"
+        assert fab.stats()["counters"]["dropouts"] >= 1
+        # partial_ok=False refuses silent degradation
+        with pytest.raises(ShardDropoutError):
+            fab.search(q, 5, partial_ok=False)
+        # coverage floor: 2/3 < 0.9 floor refuses too
+        fab.params.coverage_floor = 0.9
+        with pytest.raises(ShardDropoutError):
+            fab.search(q, 5)
+
+
+def test_fabric_halfopen_readmission_after_restart():
+    ds, q = _data()
+    p = _params(replication=1, rpc_deadline_s=0.5)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        with faultinject.inject("dead@proc:1"):
+            fab.search(q, 5)
+        assert fab.stats()["health"][1] == "open"
+        fab.restart_worker(1)                 # fresh worker, no state
+        assert fab.stats()["health"][1] == "open"   # not routed yet
+        # half-open probe: ping ok but stale -> resync -> closed
+        deadline = time.monotonic() + 20.0
+        while fab.stats()["health"][1] != "closed":
+            fab.probe_now()
+            assert time.monotonic() < deadline, fab.stats()
+            time.sleep(0.05)
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)
+        c = fab.stats()["counters"]
+        assert c.get("restarts", 0) == 1 and c.get("probes", 0) >= 1
+
+
+def test_fabric_two_phase_swap_commits_everywhere():
+    ds, q = _data()
+    rng = np.random.default_rng(7)
+    ds2 = rng.standard_normal((120, 8)).astype(np.float32)
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        assert fab.generation() == 1
+        gen = fab.swap(ds2)
+        assert gen == 2 and fab.generation() == 2
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle(ds2, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        # the retired generation is garbage-collected on every worker
+        # once its last router pin drained (retire is async)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            gens = [fab.group.call(r, "ping", {}).result(timeout=5.0)
+                    ["gens"] for r in range(3)]
+            if all(g == [2] for g in gens):
+                break
+            time.sleep(0.05)
+        assert all(g == [2] for g in gens), gens
+
+
+def test_fabric_swap_abort_rolls_back_cleanly():
+    ds, q = _data()
+    rng = np.random.default_rng(8)
+    ds2 = rng.standard_normal((120, 8)).astype(np.float32)
+    p = _params(swap_deadline_s=1.0)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        # one worker's prepare response vanishes -> barrier aborts,
+        # every worker rolls back, generation 1 keeps serving
+        with faultinject.inject("drop@rpc:prepare"):
+            with pytest.raises(serve.FabricSwapError):
+                fab.swap(ds2)
+        assert fab.generation() == 1
+        assert fab.stats()["counters"]["swap_aborts"] == 1
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)       # still OLD content
+        # nothing staged anywhere; the next swap succeeds
+        assert fab.swap(ds2) == 3
+        d, i, _ = fab.search(q, 5)
+        od, oi, _ = _oracle(ds2, q, 5, 3, covered={0, 1, 2})
+        np.testing.assert_array_equal(i, oi)
+
+
+def test_fabric_ivf_flat_workers_match_oracle():
+    ds, q = _data(n=120)
+    p = _params(worker_algo="ivf_flat")
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 1, 2},
+                            algo="ivf_flat")
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+
+
+def test_fabric_dropped_rpc_does_not_leak_pending():
+    """A response that never arrives (drop@rpc) must not pin its Future
+    + query payload in the transport's pending map forever: the router
+    forgets abandoned requests at the deadline / on hedge win."""
+    ds, q = _data()
+    p = _params(replication=1, rpc_deadline_s=0.3, rpc_retries=1)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        with faultinject.inject("drop@rpc:search*2"):
+            d, i, cov = fab.search(q, 5)
+        assert cov.min() < 1.0          # some shard lost its response
+        # every abandoned request was forgotten at the transport
+        deadline = time.monotonic() + 5.0
+        while any(w.pending for w in fab.group._workers):
+            assert time.monotonic() < deadline, [
+                dict(w.pending) for w in fab.group._workers]
+            time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# real multiprocessing: SIGKILL kill-and-resume + chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_kill_and_resume_multiprocess():
+    """ISSUE 6 satellite: kill a worker mid-stream (SIGKILL), assert
+    partial answers carry correct coverage, the circuit opens, and a
+    restarted worker is re-admitted through half-open probing with
+    bitwise-identical results vs an uninjected run on the surviving
+    shards."""
+    ds, q = _data(n=60)
+    p = _params(replication=1, rpc_deadline_s=10.0, probe_timeout_s=10.0,
+                hedge_after_ms=5000.0)
+    fab = serve.Fabric(ds, params=p, group="proc")
+    try:
+        d0, i0, cov0 = fab.search(q, 5)
+        assert (cov0 == 1.0).all()
+        fab.group.kill(1)                     # SIGKILL, mid-stream
+        d, i, cov = fab.search(q, 5)
+        np.testing.assert_allclose(cov, 2 / 3)
+        od, oi, _ = _oracle(ds, q, 5, 3, covered={0, 2})
+        np.testing.assert_array_equal(i, oi)  # bitwise vs the oracle
+        np.testing.assert_array_equal(d, od)
+        assert fab.stats()["health"][1] == "open"
+        # rejoin: respawn + half-open probing until the circuit closes
+        fab.restart_worker(1)
+        deadline = time.monotonic() + 60.0
+        while fab.stats()["health"][1] != "closed":
+            fab.probe_now()
+            assert time.monotonic() < deadline, fab.stats()
+            time.sleep(0.25)
+        d2, i2, cov2 = fab.search(q, 5)
+        assert (cov2 == 1.0).all()
+        np.testing.assert_array_equal(i2, i0)
+        np.testing.assert_array_equal(d2, d0)
+    finally:
+        fab.close()
+
+
+def test_fabric_chaos_acceptance_multiprocess():
+    """ISSUE 6 acceptance: closed-loop load under injected dead@proc +
+    slow@proc faults with a mid-run cluster hot-swap. The fabric must
+    return ZERO wrong answers (every answer bitwise-correct for the
+    shards it reports covered, per its pinned generation), report
+    coverage honestly, never mix generations, complete (or fully roll
+    back) the swap, and re-admit the killed worker through half-open
+    probing — with counters matching the injected fault script."""
+    from raft_tpu import obs
+
+    rng = np.random.default_rng(3)
+    ds1 = rng.standard_normal((120, 8)).astype(np.float32)
+    ds2 = rng.standard_normal((150, 8)).astype(np.float32)
+    datasets = {}
+    p = _params(replication=2, rpc_deadline_s=5.0, slow_ms=300.0,
+                hedge_after_ms=25.0, probe_timeout_s=10.0,
+                swap_deadline_s=60.0)
+    obs.set_mode("on")
+    fab = serve.Fabric(ds1, params=p, group="proc",
+                       fault_spec="dead@proc:2,slow@proc:1*2")
+    datasets[1] = ds1
+    recorded = []
+    rec_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(wid):
+        crng = np.random.default_rng(100 + wid)
+        while not stop.is_set():
+            q = crng.standard_normal((1, 8)).astype(np.float32)
+            out = fab.search(q, 4, detail=True)
+            with rec_lock:
+                recorded.append((q,) + out)
+
+    threads = [threading.Thread(target=client, args=(w,), daemon=True)
+               for w in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.5)                       # faults fire under load
+        gen2 = fab.swap(ds2)                  # barrier inside the storm
+        assert gen2 == 2
+        datasets[2] = ds2
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        # worker 2 died on its first search; rejoin through half-open
+        assert fab.stats()["health"][2] == "open"
+        fab.restart_worker(2)
+        deadline = time.monotonic() + 60.0
+        while fab.stats()["health"][2] != "closed":
+            fab.probe_now()
+            assert time.monotonic() < deadline, fab.stats()
+            time.sleep(0.25)
+        dF, iF, covF = fab.search(rng.standard_normal(
+            (2, 8)).astype(np.float32), 4)
+        assert (covF == 1.0).all()
+        counters = fab.stats()["counters"]
+        health = fab.stats()["health"]
+    finally:
+        fab.close()
+        obs.set_mode(None)
+
+    # --- zero wrong answers: bitwise vs the surviving-shard oracle ----
+    assert len(recorded) >= 10
+    degraded = 0
+    for q, d, i, cov, validity, gen_id in recorded:
+        assert gen_id in datasets, gen_id     # no phantom generations
+        # coverage must restate the validity matrix exactly (honesty)
+        np.testing.assert_allclose(cov, validity.mean(axis=0))
+        rows_uniform = [validity[s].all() or not validity[s].any()
+                        for s in range(3)]
+        assert all(rows_uniform)              # no NaN rows in this drill
+        covered = {s for s in range(3) if validity[s].all()}
+        if len(covered) < 3:
+            degraded += 1
+        od, oi, _ = _oracle(datasets[gen_id], q, 4, 3, covered=covered)
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+    # --- counters match the injected fault script ---------------------
+    # slow@proc:1*2 stalled two responses 300ms past the 25ms hedge
+    # threshold -> hedges fired; dead@proc:2 killed a worker -> its
+    # circuit cycled open -> half_open -> closed on rejoin
+    assert counters.get("hedges", 0) >= 1
+    assert counters.get("restarts", 0) == 1
+    assert counters.get("mixed_gen", 0) == 0  # swap atomicity held
+    assert counters.get("swaps", 0) == 2      # initial load + mid-run
+    assert counters.get("swap_aborts", 0) == 0
+    assert health == {0: "closed", 1: "closed", 2: "closed"}

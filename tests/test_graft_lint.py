@@ -486,6 +486,21 @@ def test_gl009_serve_unspanned_method_positive():
     assert rules.count("GL009") == 2
 
 
+def test_gl009_serve_fabric_recovery_surface_positive():
+    # ISSUE 6: the fabric's recovery control plane (probe/restart) is
+    # serving-surface latency too — unspanned probes are a blind spot
+    # exactly when the cluster is degraded
+    rules = _serve_rules("""
+        class Fabric:
+            def probe_now(self):
+                return {}
+
+            def restart_worker(self, rank):
+                return rank
+    """)
+    assert rules.count("GL009") == 2
+
+
 def test_gl009_serve_spanned_method_negative():
     rules = _serve_rules("""
         from raft_tpu import obs

@@ -577,3 +577,18 @@ def test_acceptance_sharded_coverage_gauge(eight_device_mesh):
                   what="sharded_knn") == pytest.approx(7 / 8)
     assert _value(snap, "shard_dropouts_total", what="sharded_knn") == 1.0
     assert _value(snap, "queries_total", algo="sharded_knn") == 4.0
+
+
+def test_sharded_full_coverage_gauge_recorded(eight_device_mesh):
+    """The PLAIN (no validity scan) path records shard_coverage = 1 too
+    — a dashboard must distinguish "healthy 8/8" from "metric never
+    emitted" (ISSUE 6 satellite)."""
+    from raft_tpu.comms import sharded
+
+    obs.set_mode("on")
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    q = x[:4]
+    d, i = sharded.sharded_knn(q, x, 3, eight_device_mesh)
+    snap = obs.snapshot(runtime_gauges=False)
+    assert _value(snap, "shard_coverage", what="sharded_knn") == 1.0

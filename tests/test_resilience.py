@@ -178,6 +178,74 @@ def test_faultinject_fires_once_per_spec():
     faultinject.check(stage="s", chunk=1)              # plan cleared
 
 
+def test_faultinject_proc_rpc_grammar():
+    specs = faultinject.parse("dead@proc:2,slow@proc:1*3,drop@rpc:search")
+    assert [(s.kind, s.scope, s.arg, s.remaining) for s in specs] == [
+        ("dead", "proc", "2", 1), ("slow", "proc", "1", 3),
+        ("drop", "rpc", "search", 1),
+    ]
+    with pytest.raises(ValueError):
+        faultinject.parse("slow@chunk:1")     # slow is proc-only
+    with pytest.raises(ValueError):
+        faultinject.parse("drop@proc:1")      # drop is rpc-only
+    with pytest.raises(ValueError):
+        faultinject.parse("oom@proc:1")       # proc takes dead/slow only
+    with pytest.raises(ValueError):
+        faultinject.parse("dead@proc:x")      # proc rank must be int
+
+
+def test_faultinject_proc_action_one_shot_and_repeat():
+    with faultinject.inject("dead@proc:2,slow@proc:1*2"):
+        assert faultinject.proc_action(0) is None
+        assert faultinject.proc_action(1) == "slow"
+        assert faultinject.proc_action(1) == "slow"
+        assert faultinject.proc_action(1) is None      # count exhausted
+        assert faultinject.proc_action(2) == "die"
+        assert faultinject.proc_action(2) is None      # one-shot
+    assert faultinject.proc_action(2) is None          # plan cleared
+
+
+def test_faultinject_rpc_drop_consumed():
+    with faultinject.inject("drop@rpc:search*2"):
+        assert not faultinject.rpc_dropped("prepare")  # method-scoped
+        assert faultinject.rpc_dropped("search")
+        assert faultinject.rpc_dropped("search")
+        assert not faultinject.rpc_dropped("search")   # count exhausted
+    assert not faultinject.rpc_dropped("search")
+
+
+def test_faultinject_proc_scopes_never_raise_from_check():
+    # proc/rpc specs are queried, not raised: check() must ignore them
+    with faultinject.inject("dead@proc:0,slow@proc:0,drop@rpc:search"):
+        faultinject.check(stage="search", chunk=0)
+
+
+def test_run_probe_clamped_to_deadline(monkeypatch):
+    # a hanging probe (the dead-axon init-hang mode) must not stall the
+    # retry loop past deadline_s: run() clamps the probe wait to the
+    # remaining deadline, and the probe timeout classifies dead_backend
+    probe_waits = []
+
+    def fake_alive(timeout_s=30.0):
+        probe_waits.append(timeout_s)
+        time.sleep(min(timeout_s, 5.0))   # hung probe honoring its bound
+        return False
+
+    monkeypatch.setattr(errors, "backend_alive", fake_alive)
+
+    def dead():
+        raise faultinject.InjectedDeadBackend("injected dead-backend")
+
+    t0 = time.monotonic()
+    with pytest.raises(errors.DeadBackendError):
+        resilience.run(dead, retries=3, backoff_s=0.01, deadline_s=0.4,
+                       probe_timeout_s=30.0)
+    assert time.monotonic() - t0 < 2.0     # NOT the 30s probe default
+    assert probe_waits and probe_waits[0] <= 0.4
+    assert resilience.classify(errors.DeadBackendError("x")) == \
+        resilience.DEAD_BACKEND
+
+
 def test_faultinject_env(monkeypatch):
     monkeypatch.setenv(faultinject.ENV_VAR, "transient@stage:probe")
     faultinject.clear()
