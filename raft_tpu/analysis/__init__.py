@@ -1,6 +1,6 @@
-"""raft_tpu.analysis — static analysis for TPU correctness hazards.
+"""raft_tpu.analysis — static + dynamic analysis for correctness hazards.
 
-Two engines, one rule set (see ``docs/static_analysis.md``):
+Three engines, one rule set (see ``docs/static_analysis.md``):
 
 * :mod:`raft_tpu.analysis.lint` — AST lint over package source
   (GL001-GL006: host syncs, tracer branches, int->float ordering
@@ -8,12 +8,19 @@ Two engines, one rule set (see ``docs/static_analysis.md``):
 * :mod:`raft_tpu.analysis.jaxpr_audit` — traces the registered public
   entry points on CPU and walks the jaxprs (GL001/GL003/GL004 with
   real dataflow, plus the GL007 recompile audit).
+* :mod:`raft_tpu.analysis.races` — graft-race: lock-discipline lint
+  over the threaded serving tier (GL010-GL014: unguarded shared state,
+  check-then-act, device work under lock, lock-order cycles, unjoined
+  threads); its dynamic complement is the ``RAFT_TPU_THREADSAN=1``
+  lock-order sanitizer (:mod:`raft_tpu.analysis.lockwatch`) the
+  serve/fabric/comms/core tiers construct their locks through.
 
-CLI: ``graft-lint`` (console script) or ``python scripts/graft_lint.py``.
-The tier-1 gate test (``tests/test_graft_lint.py``) runs both engines
-over ``raft_tpu/`` and fails on any unsuppressed finding — the JAX-port
-analog of the reference failing the build on an unvetted template
-instantiation (``util/raft_explicit.hpp``).
+CLI: ``graft-lint`` (console script) or ``python scripts/graft_lint.py``;
+``--engine=both,races`` is the full static gate. The tier-1 gate tests
+(``tests/test_graft_lint.py``) run every engine over ``raft_tpu/`` and
+fail on any unsuppressed finding — the JAX-port analog of the reference
+failing the build on an unvetted template instantiation
+(``util/raft_explicit.hpp``).
 """
 
 from raft_tpu.analysis.rules import RULES, Finding, Rule  # noqa: F401
@@ -24,4 +31,10 @@ from raft_tpu.analysis.jaxpr_audit import (  # noqa: F401
     audit_entry_points,
     audit_select_k_recompiles,
     run_audit,
+)
+from raft_tpu.analysis import lockwatch  # noqa: F401
+from raft_tpu.analysis.races import (  # noqa: F401
+    lint_file as race_lint_file,
+    lint_paths as race_lint_paths,
+    lint_source as race_lint_source,
 )

@@ -4,8 +4,14 @@
 
     graft-lint [paths...]                  # AST lint (default: raft_tpu/)
     graft-lint --engine=both raft_tpu/     # AST + jaxpr audit
+    graft-lint --engine=races raft_tpu/    # lock-discipline lint only
+    graft-lint --engine=both,races raft_tpu/   # the full tier-1 gate
     graft-lint --format=json raft_tpu/    # machine-readable
     graft-lint --list-rules
+
+``--engine`` takes a comma list of ``ast`` / ``jaxpr`` / ``races``;
+``both`` keeps meaning ``ast,jaxpr`` (its pre-races spelling) and
+``all`` is every engine.
 
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
 findings, 2 internal/usage error.
@@ -28,10 +34,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (default: raft_tpu/)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--engine", choices=("ast", "jaxpr", "both"),
-                    default="ast",
-                    help="ast = source lint only (fast); jaxpr = trace the "
-                         "entry-point registry; both = the tier-1 gate")
+    ap.add_argument("--engine", default="ast",
+                    help="comma list of ast|jaxpr|races (ast = source "
+                         "lint, fast; jaxpr = trace the entry-point "
+                         "registry; races = lock-discipline lint); "
+                         "'both' = ast,jaxpr; 'all' = every engine")
     ap.add_argument("--rules", default=None,
                     help="comma list of rule ids to run (AST engine), "
                          "e.g. GL001,GL005")
@@ -51,6 +58,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule in RULES.values():
             print(f"{rule.id}  allow-{rule.slug:<20} {rule.summary}")
         return 0
+
+    engines: set = set()
+    for tok in args.engine.split(","):
+        tok = tok.strip()
+        if tok == "both":
+            engines |= {"ast", "jaxpr"}
+        elif tok == "all":
+            engines |= {"ast", "jaxpr", "races"}
+        elif tok in ("ast", "jaxpr", "races"):
+            engines.add(tok)
+        elif tok:
+            print(f"unknown engine {tok!r} (want ast|jaxpr|races|both|"
+                  f"all, comma-separable)", file=sys.stderr)
+            return 2
+    if not engines:
+        engines = {"ast"}
 
     if args.paths:
         paths = args.paths
@@ -75,11 +98,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings = []
     report: dict = {}
     try:
-        if args.engine in ("ast", "both"):
+        if "ast" in engines:
             from raft_tpu.analysis.lint import lint_paths
 
             findings.extend(lint_paths(paths, rules))
-        if args.engine in ("jaxpr", "both"):
+        if "races" in engines:
+            from raft_tpu.analysis.races import lint_paths as race_paths
+
+            findings.extend(race_paths(paths, rules))
+        if "jaxpr" in engines:
             from raft_tpu.analysis.jaxpr_audit import run_audit
 
             names = args.entry_points.split(",") if args.entry_points else None

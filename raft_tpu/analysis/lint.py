@@ -104,6 +104,12 @@ _PERF_CLAIM_RE = re.compile(
   | (?: \d[\d.,]*\s*[x×]\s*(?:QPS|recall) )                     # 1.2x QPS
   | (?: ~?\s*\d[\d.]*\s*[x×]\s*(?:faster|slower|speedup|
         throughput|the\ bandwidth) )                            # ~7x faster
+  | (?: ~?\s*\d[\d.]*\s*[x×]-?(?:wider|narrower|bigger|larger|
+        smaller)\b [^.]{0,80} \b(?:cost|cheap|free|fast|slow|
+        wall-?clock|latency|same)\b )     # "the 2x-wider matmul can
+                                          # cost the same wall-clock"
+                                          # (the PR-5/6 serving class;
+                                          # [^.] spans the line wrap)
   | (?: \d[\d.,]*\s*[GMT]B/s )                                  # 123 GB/s
   | (?: \d[\d.,]*\s*[GT]FLOP )                                  # 9 GFLOP/s
   | (?: [+\-]\d[\d.]*\s*%\s*(?:QPS|recall|throughput|latency) ) # +20% QPS

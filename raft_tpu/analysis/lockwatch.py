@@ -1,0 +1,450 @@
+"""graft-race engine 2 (dynamic): the in-test lock-order sanitizer.
+
+The static half (:mod:`raft_tpu.analysis.races`) reads lock discipline
+off the syntax; this half *observes* it. Under ``RAFT_TPU_THREADSAN=1``
+the threaded tiers (serve engine, registry, mutation overlay, fabric
+router, comms worker groups, core token table) construct their locks
+through the factories here instead of ``threading`` directly, and every
+acquisition is checked against two invariants while tier-1's
+``serve``/``multihost`` suites run:
+
+* **acquisition order** — each observed "acquired B while holding A"
+  adds edge A→B to a process-global order graph (keyed by the lock's
+  declared *name*, so every ``MutableState`` instance contributes to
+  one ``serve.mutation`` node). An acquisition that would close a cycle
+  raises :class:`LockOrderInversion` naming the full cycle path — the
+  deterministic, single-run analog of a deadlock that needs an unlucky
+  interleaving to actually wedge;
+* **hold time** — a lock held longer than the budget
+  (``RAFT_TPU_THREADSAN_BUDGET_MS``, default 30s) raises
+  :class:`HoldBudgetExceeded` at release. The budget is a watchdog for
+  the GL012 class at runtime: a device build/compile that creeps under
+  a lock shows up as a breach long before it shows up as a production
+  stall. The default is sized for CPU-host test compiles; deployments
+  tighten it per-SLO.
+
+On either failure the acquisition graph is pushed through the
+graft-scope flight recorder (``lockwatch_failure`` event + an auto
+``flight.dump`` in flight mode), so a wedged run leaves the order
+evidence next to the error.
+
+Off mode (the default) is free: the factories return plain
+``threading`` primitives — no wrapper, no per-acquire bookkeeping.
+
+Scope notes:
+
+* graph nodes are lock *names*, not instances: two same-named locks
+  (two servers' registries) merge — deliberately, since the hierarchy
+  is a class-level contract. Reentrant re-acquisition of the *same
+  instance* is never an edge.
+* ``threading.Condition`` built over a sanitized lock keeps working:
+  ``wait()`` releases through the wrapper (the held-set and hold timer
+  stay honest across the park/wake cycle).
+* obs/tuning/resilience internals keep plain locks on purpose — they
+  are leaf-level, never nest into the serving hierarchy, and wrapping
+  them would put the sanitizer inside its own failure-dump path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "RAFT_TPU_THREADSAN"
+BUDGET_ENV_VAR = "RAFT_TPU_THREADSAN_BUDGET_MS"
+# generous by default: tier-1 CPU hosts pay first-call XLA compiles in
+# paths that legitimately run under a lock at test scale (e.g. a fabric
+# bootstrap awaiting worker prepare under the swap lock); the invariant
+# being enforced is "no UNBOUNDED work under a lock", not a latency SLO
+DEFAULT_BUDGET_MS = 30_000.0
+
+
+class LockOrderInversion(RuntimeError):
+    """An acquisition that closes a cycle in the observed lock-order
+    graph. ``cycle`` carries the path (lock names, first and last equal)."""
+
+    def __init__(self, msg: str, cycle: List[str]):
+        super().__init__(msg)
+        self.cycle = list(cycle)
+
+
+class HoldBudgetExceeded(RuntimeError):
+    """A lock held past the sanitizer's hold-time budget."""
+
+    def __init__(self, msg: str, name: str, held_ms: float):
+        super().__init__(msg)
+        self.lock_name = name
+        self.held_ms = held_ms
+
+
+def enabled() -> bool:
+    """True when the sanitizer is on (read at lock construction)."""
+    return os.environ.get(ENV_VAR, "") not in ("", "0", "off", "false")
+
+
+def budget_ms() -> float:
+    raw = os.environ.get(BUDGET_ENV_VAR, "")
+    if not raw:
+        return DEFAULT_BUDGET_MS
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_BUDGET_MS
+
+
+# ---------------------------------------------------------------------------
+# sanitizer state
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()                 # .held: List[_SanLockBase]
+_state_lock = threading.Lock()
+# name -> {successor name -> first-observed site string}
+_order: Dict[str, Dict[str, str]] = {}
+_counts = {"inversions": 0, "budget_breaches": 0, "acquires": 0}
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def order_graph() -> Dict[str, Dict[str, str]]:
+    """A copy of the observed acquisition-order graph
+    (``{holder: {acquired: first_seen_site}}``)."""
+    with _state_lock:
+        return {a: dict(bs) for a, bs in _order.items()}
+
+
+def stats() -> dict:
+    with _state_lock:
+        return dict(_counts)
+
+
+def reset() -> None:
+    """Drop the observed graph and counters (tests)."""
+    with _state_lock:
+        _order.clear()
+        for k in _counts:
+            _counts[k] = 0
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """Shortest observed-order path src -> ... -> dst (BFS). Caller
+    holds ``_state_lock``."""
+    if src == dst:
+        return [src]
+    frontier: List[List[str]] = [[src]]
+    seen = {src}
+    while frontier:
+        nxt: List[List[str]] = []
+        for path in frontier:
+            for succ in _order.get(path[-1], ()):
+                if succ == dst:
+                    return path + [succ]
+                if succ not in seen:
+                    seen.add(succ)
+                    nxt.append(path + [succ])
+        frontier = nxt
+    return None
+
+
+def _site() -> str:
+    """The nearest caller frame outside the lock machinery."""
+    import sys
+
+    try:
+        f = sys._getframe(1)
+    except (AttributeError, ValueError):  # pragma: no cover - exotic runtime
+        return "<unknown>"
+    while f is not None and "lockwatch" in (f.f_code.co_filename or ""):
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{f.f_code.co_filename}:{f.f_lineno} in {f.f_code.co_name}"
+
+
+def _dump_failure(kind: str, detail: dict) -> None:
+    """Push the acquisition graph through graft-scope: a breadcrumb
+    event always, a full flight dump once in flight mode. Never raises
+    — the sanitizer's own failure is the payload, not the plumbing."""
+    try:
+        from raft_tpu import obs
+        from raft_tpu.obs import config as _obs_config
+
+        obs.counter("lockwatch.failures", kind=kind)
+        # field name `failure`, not `kind`: flight.record's own first
+        # parameter is `kind` and a kwarg collision would TypeError
+        obs.event("lockwatch_failure", failure=kind,
+                  order_graph={a: sorted(bs) for a, bs in
+                               order_graph().items()},
+                  **detail)
+        if _obs_config.FLIGHT:
+            from raft_tpu.obs import flight
+
+            flight.dump(reason=f"lockwatch:{kind}")
+    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow failure reporting is best-effort; the sanitizer exception itself is the signal
+        pass
+
+
+def _record_acquired(lock: "_SanLockBase") -> None:
+    """Post-acquire bookkeeping: order edges from every held lock to
+    this one, cycle check, held-set push. On inversion the fresh
+    acquisition is RELEASED before raising so the failing thread does
+    not wedge everyone else on its way out."""
+    held = _held()
+    site = ""                # resolved lazily: frame walking on every
+    #                          hot-path acquire is measurable overhead,
+    #                          and it only matters for NEW edges/failures
+    cycle: Optional[List[str]] = None       # closed path, first == last
+    offender: Optional[str] = None
+    with _state_lock:
+        _counts["acquires"] += 1
+        for h in held:
+            if h.name == lock.name and h is not lock:
+                # two distinct same-named locks nested: with no
+                # intra-class tiebreak (e.g. by id) this is AB/BA-prone
+                cycle = [lock.name, lock.name]
+                offender = h.name
+                break
+            succ = _order.setdefault(h.name, {})
+            if lock.name not in succ:
+                back = _find_path(lock.name, h.name)
+                if back is not None:
+                    # acquire(X) while holding Y, with X -> ... -> Y
+                    # already observed: the closing edge Y -> X is this
+                    # very acquisition
+                    cycle = back + [lock.name]
+                    offender = h.name
+                    break
+                if not site:
+                    site = _site()
+                succ[lock.name] = site
+        if cycle is None:
+            held.append(lock)
+            lock._held_list = held
+            return
+        _counts["inversions"] += 1
+        edges = {a: dict(bs) for a, bs in _order.items()}
+    # failure path: undo the acquisition, report, raise
+    lock._inner_release_all()
+    if not site:
+        site = _site()
+    first_seen = [
+        f"{a} -> {b} (first seen {edges[a][b]})"
+        for a, b in zip(cycle, cycle[1:])
+        if b in edges.get(a, {})
+    ]
+    path = " -> ".join(cycle)
+    msg = (f"lock order inversion: acquiring {lock.name!r} while holding "
+           f"{offender!r} at {site}, but the opposite order is already "
+           f"established; cycle: {path}"
+           + ("".join("\n  " + s for s in first_seen) if first_seen else ""))
+    _dump_failure("inversion", {
+        "cycle": path, "acquiring": lock.name, "holding": offender,
+        "site": site,
+    })
+    raise LockOrderInversion(msg, cycle)
+
+
+def _pop_held(lock: "_SanLockBase") -> None:
+    """Drop the held-set entry. MUST run while the inner primitive is
+    still owned: releasing first opens a window where the next owner's
+    fresh entry (``_held_list`` reassigned by its ``_record_acquired``)
+    is the one this thread deletes — silently blinding the sanitizer
+    for that whole hold. Popping from the list captured at acquire,
+    under the state lock, also makes cross-thread releases safe."""
+    with _state_lock:
+        lst = lock._held_list
+        lock._held_list = None
+        if lst is not None and lock in lst:
+            lst.remove(lock)
+
+
+def _check_budget(lock: "_SanLockBase", t0: float) -> None:
+    """Hold-budget check; runs AFTER the inner release so the raise
+    leaves the lock free."""
+    held_ms = (time.perf_counter() - t0) * 1e3
+    limit = budget_ms()
+    if held_ms <= limit:
+        return
+    with _state_lock:
+        _counts["budget_breaches"] += 1
+    site = _site()
+    _dump_failure("hold_budget", {
+        "lock": lock.name, "held_ms": round(held_ms, 3),
+        "budget_ms": limit, "site": site,
+    })
+    raise HoldBudgetExceeded(
+        f"lock {lock.name!r} held for {held_ms:.1f} ms, over the "
+        f"{limit:.0f} ms sanitizer budget ({BUDGET_ENV_VAR}); released at "
+        f"{site} — move the blocking work outside the critical section",
+        lock.name, held_ms)
+
+
+class _SanLockBase:
+    """Shared acquire/release instrumentation over an inner primitive."""
+
+    __slots__ = ("name", "_inner", "_t0", "_held_list")
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+        self._t0 = 0.0          # owner-written only (under the lock)
+        # the acquiring thread's held list: release() removes from THIS
+        # list (under _state_lock) so a cross-thread release — legal
+        # for a plain Lock — cannot leave a phantom hold on the
+        # acquirer's stack
+        self._held_list = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._t0 = time.perf_counter()
+            _record_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        t0 = self._t0
+        _pop_held(self)          # before the inner release: we still
+        #                          own it, so no successful acquirer
+        #                          can be racing the bookkeeping
+        self._inner.release()
+        _check_budget(self, t0)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _inner_release_all(self) -> None:
+        """Failure-path unwind of the acquisition that just succeeded."""
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__} {self.name!r} {self._inner!r}>"
+
+
+class SanLock(_SanLockBase):
+    """Sanitized ``threading.Lock``."""
+
+    __slots__ = ()
+
+    def __init__(self, name: str):
+        super().__init__(name, threading.Lock())
+
+
+class SanRLock(_SanLockBase):
+    """Sanitized ``threading.RLock``: recursive re-acquisition by the
+    owner is tracked (never an order edge) and the hold timer spans the
+    OUTERMOST acquire/release pair. Implements the ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` trio so ``threading.Condition``
+    can park on it."""
+
+    __slots__ = ("_depth_tls",)
+
+    def __init__(self, name: str):
+        super().__init__(name, threading.RLock())
+        self._depth_tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._depth_tls, "n", 0)
+
+    def _set_depth(self, n: int) -> None:
+        self._depth_tls.n = n
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            return False
+        depth = self._depth() + 1
+        self._set_depth(depth)
+        if depth == 1:
+            self._t0 = time.perf_counter()
+            _record_acquired(self)
+        return True
+
+    def release(self) -> None:
+        depth = self._depth() - 1
+        self._set_depth(depth)
+        t0 = self._t0
+        if depth == 0:
+            _pop_held(self)      # while still owned — see _pop_held
+        self._inner.release()
+        if depth == 0:
+            _check_budget(self, t0)
+
+    def _inner_release_all(self) -> None:
+        self._set_depth(self._depth() - 1)
+        self._inner.release()
+
+    # -- Condition integration ---------------------------------------------
+
+    def _release_save(self):
+        depth = self._depth()
+        t0 = self._t0           # read while still owned: after the full
+        #                         release another thread may overwrite it
+        self._set_depth(0)
+        _pop_held(self)          # while still owned — see _pop_held
+        saved = self._inner._release_save()
+        _check_budget(self, t0)
+        return (saved, depth)
+
+    def _acquire_restore(self, state) -> None:
+        saved, depth = state
+        self._inner._acquire_restore(saved)
+        self._set_depth(depth)
+        self._t0 = time.perf_counter()
+        _record_acquired(self)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------------------
+# the factories the threaded tiers construct through
+# ---------------------------------------------------------------------------
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — sanitized under ``RAFT_TPU_THREADSAN=1``.
+
+    ``name`` is the lock's node in the order graph and in the
+    documented hierarchy (docs/serving.md): every instance of a class
+    shares one name."""
+    return SanLock(name) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — sanitized under ``RAFT_TPU_THREADSAN=1``."""
+    return SanRLock(name) if enabled() else threading.RLock()
+
+
+def make_condition(lock=None, name: str = "condition"):
+    """A ``threading.Condition`` over ``lock`` (or a fresh
+    :func:`make_lock`); waits release/reacquire through the wrapper, so
+    the held-set stays honest across the park."""
+    return threading.Condition(lock if lock is not None
+                               else make_lock(name))
+
+
+def make_flag_lock(name: str):
+    """A single-flight handoff FLAG: acquired with a non-blocking
+    try-acquire by one thread and released by another when the
+    background work completes (the serve engine's ``compacting``
+    guard). Deliberately a plain ``threading.Lock`` even under the
+    sanitizer: a lock that is only ever try-acquired cannot contribute
+    to a deadlock cycle (nobody blocks on it), its hold legitimately
+    spans minutes of background build, and its cross-thread handoff
+    would otherwise read as a phantom hold on the acquirer's stack."""
+    del name
+    return threading.Lock()

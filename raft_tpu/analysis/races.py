@@ -1,0 +1,929 @@
+"""graft-race engine 1 (static): lock-discipline lint over package source.
+
+The serving tier (serve engine, registry hot-swap, tombstone mutation,
+fabric router, comms worker groups) is multi-threaded, and CHANGES.md
+records that nearly every post-review fix in PRs 5-6 was a hand-found
+concurrency bug. This engine turns that recurring review-found bug
+class into a mechanical gate, the way graft-lint's GL001-GL009 did for
+TPU numeric/tracing hazards:
+
+* **GL010 unguarded-shared-state** — infer a *guarded-by* map per
+  class: an attribute written inside ``with self.<lock>:`` (or declared
+  with a ``#: guarded-by(<lock>)`` annotation) is shared state, and
+  accessing it outside that lock is flagged — writes anywhere, reads
+  from methods reachable off ``threading.Thread``/executor/dispatcher
+  entry points (methods handed to ``Thread(target=...)``, ``.submit``,
+  or escaping as callbacks). Methods named ``*_locked`` assert the
+  caller-holds-lock contract and are treated as holding every class
+  lock. The same inference runs for helper-object receivers
+  (``w.pending`` under ``with w.lock:``) module-wide.
+* **GL011 check-then-act** — a test on ``self.X`` (truthiness,
+  ``.is_set()``, dict membership) whose matching act (assignment,
+  ``.set()``, ``.pop()``...) sits in a *different* lock region: the
+  lock was dropped between check and act, so the condition can be
+  invalidated in between (the PR-5 ``compact()`` single-flight class).
+  ``threading.Event`` attributes are also flagged when both sides run
+  with no lock at all.
+* **GL012 device-work-under-lock** — ``jax.*`` calls,
+  ``block_until_ready``, ``device_put``, and index ``build``/``extend``
+  helpers inside a ``with <lock>:`` body (the
+  side-build-under-the-mutation-RLock class).
+* **GL013 lock-order-cycle** — a per-file static acquisition graph from
+  nested ``with`` statements (multi-item ``with a, b:`` included, plus
+  one hop through same-class method calls); any cycle is reported with
+  its full path. Cross-file and call-depth>1 orders are the dynamic
+  sanitizer's job (:mod:`raft_tpu.analysis.lockwatch`).
+* **GL014 unjoined-thread** — ``threading.Thread`` created neither
+  ``daemon=True`` nor joined.
+
+Everything here is a heuristic over syntax (the honest caveat GL001-006
+carry too): it resolves ``self.X``/``cls.X`` and plain-name receivers,
+sees lexical ``with`` blocks only (manual ``acquire()``/``release()``
+pairs and cross-object call chains are invisible), and trusts the
+``*_locked`` suffix. The dynamic half — the ``RAFT_TPU_THREADSAN=1``
+lock sanitizer — observes the real inter-procedural order at test time;
+the two overlap on purpose, like the AST and jaxpr engines do.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis.rules import (
+    Finding,
+    apply_suppressions,
+    scan_suppressions,
+)
+
+# calls that construct a lock (guard-capable) or an event-like primitive
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "lockwatch.make_lock", "lockwatch.make_rlock",
+}
+_CONDITION_FACTORIES = {
+    "threading.Condition", "Condition", "lockwatch.make_condition",
+}
+_EVENT_FACTORIES = {
+    "threading.Event", "Event",
+    "threading.Semaphore", "Semaphore", "threading.BoundedSemaphore",
+}
+
+# attribute names that read as locks when we cannot see the constructor
+# (helper-object receivers, cross-module state)
+_LOCKISH_ATTR_RE = re.compile(r"(^|_)(r?lock|mutex|cond(ition)?)$")
+
+# mutating method names that count as writes to the receiver attribute
+_MUTATING_CALLS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "discard", "clear", "update", "add", "set",
+    "setdefault", "sort", "reverse",
+}
+# the subset that acts on an Event/flag for GL011
+_ACT_CALLS = _MUTATING_CALLS | {"acquire", "release"}
+
+# GL012: device-work call screens
+_DEVICE_ROOTS = {"jax", "jnp", "lax", "pl", "pltpu"}
+_DEVICE_ATTRS = {"block_until_ready", "device_put"}
+_DEVICE_SUFFIXES = {"build", "extend", "build_index", "build_shard_entry",
+                    "warmup_handle"}
+
+_GUARDED_BY_RE = re.compile(r"#:?\s*guarded-by\(\s*([A-Za-z_]\w*)\s*\)")
+
+_SELF_NAMES = {"self", "cls"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_factory(node: ast.AST, names: Set[str]) -> bool:
+    return isinstance(node, ast.Call) and (_dotted(node.func) or "") in names
+
+
+# guard keys:
+#   ("self", attr)       self.<attr> / cls.<attr> lock of the current class
+#   ("mod", name)        module-level lock variable
+#   ("recv", recv, attr) plain-name receiver lock (w.lock)
+#   ("expr", dotted)     any other lock-ish dotted path (self.state.lock)
+#   ("held-all",)        synthetic region of a *_locked method
+_HELD_ALL = ("held-all",)
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    node: ast.ClassDef
+    name: str
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #   attr -> canonical attr (Condition aliases resolve to their lock)
+    event_attrs: Set[str] = dataclasses.field(default_factory=set)
+    guarded: Dict[str, Set[tuple]] = dataclasses.field(default_factory=dict)
+    #   attr -> guard keys it was written under (or annotated with)
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+
+
+class FileRaceLinter:
+    """One file's lock-discipline pass. See the module docstring."""
+
+    def __init__(self, path: str, source: str,
+                 rules: Optional[Set[str]] = None):
+        self.path = path
+        self.source = source
+        self.rules = rules
+        self.findings: List[Finding] = []
+        self.tree = ast.parse(source, filename=path)
+        self._comments = self._scan_comments(source)
+        self.module_locks: Set[str] = set()
+        self.classes: List[_ClassInfo] = []
+        self._fn_class: Dict[ast.AST, Optional[_ClassInfo]] = {}
+        self._entry_fns: Set[ast.AST] = set()
+        self._reach_fns: Set[ast.AST] = set()
+        # receiver-aggregated guard inference: attr name -> lock attr
+        # names it was written under (via `with <recv>.<lockattr>:`)
+        self._recv_guarded: Dict[str, Set[str]] = {}
+        # GL013 acquisition graph: (node_a, node_b) -> (line, via)
+        self._edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _emit(self, rule: str, line: int, message: str) -> None:
+        if self.rules is not None and rule not in self.rules:
+            return
+        self.findings.append(
+            Finding(rule, self.path, line, message, engine="races"))
+
+    @staticmethod
+    def _scan_comments(source: str) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass
+        return out
+
+    def run(self) -> List[Finding]:
+        self._collect_classes()
+        self._collect_module_locks()
+        self._collect_entries()
+        self._infer_guarded()
+        for cls in self.classes:
+            for fn in self._class_fns(cls):
+                self._check_fn(fn, cls)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for f in ast.walk(node):
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        self._check_fn(f, None)
+        self._check_gl013_cycles()
+        self._check_gl014_threads()
+        # dedupe (nested defs are visited once per enclosing walk)
+        seen: Set[Tuple[str, int, str]] = set()
+        unique: List[Finding] = []
+        for f in self.findings:
+            key = (f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        self.findings = unique
+        sup = scan_suppressions(self.source)
+        return apply_suppressions(self.findings, sup, self.path)
+
+    # -- discovery ---------------------------------------------------------
+
+    def _collect_classes(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = _ClassInfo(node, node.name)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[sub.name] = sub
+                elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name):
+                    # class-level lock (Interruptible._lock style)
+                    self._classify_lock_assign(
+                        ci, sub.targets[0].id, sub.value)
+            # self.X = <factory> anywhere in the class's methods
+            for m in ci.methods.values():
+                for sub in ast.walk(m):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1 and \
+                            isinstance(sub.targets[0], ast.Attribute) and \
+                            isinstance(sub.targets[0].value, ast.Name) and \
+                            sub.targets[0].value.id in _SELF_NAMES:
+                        self._classify_lock_assign(
+                            ci, sub.targets[0].attr, sub.value)
+            self.classes.append(ci)
+            for m in ci.methods.values():
+                for f in ast.walk(m):
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                        self._fn_class[f] = ci
+
+    def _classify_lock_assign(self, ci: _ClassInfo, attr: str,
+                              value: ast.AST) -> None:
+        if _is_factory(value, _LOCK_FACTORIES):
+            ci.lock_attrs.setdefault(attr, attr)
+        elif _is_factory(value, _CONDITION_FACTORIES):
+            target = attr
+            # Condition(self.L) aliases the condition to L
+            call = value
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for a in args:
+                if isinstance(a, ast.Attribute) and \
+                        isinstance(a.value, ast.Name) and \
+                        a.value.id in _SELF_NAMES:
+                    target = ci.lock_attrs.get(a.attr, a.attr)
+                    break
+            ci.lock_attrs.setdefault(attr, target)
+        elif _is_factory(value, _EVENT_FACTORIES):
+            ci.event_attrs.add(attr)
+
+    def _collect_module_locks(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) and \
+                    _is_factory(node.value,
+                                _LOCK_FACTORIES | _CONDITION_FACTORIES):
+                self.module_locks.add(node.targets[0].id)
+
+    def _collect_entries(self) -> None:
+        """Entry functions: handed to Thread(target=...)/executor
+        .submit(...), or escaping as a value (callback registration).
+        Reachability closes over same-class ``self.m()`` calls."""
+        name_defs: Dict[str, List[ast.AST]] = {}
+        for f, _ in self._fn_class.items():
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name_defs.setdefault(f.name, []).append(f)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for f in ast.walk(node):
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        name_defs.setdefault(f.name, []).append(f)
+                        self._fn_class.setdefault(
+                            f, self._fn_class.get(node))
+
+        def mark_target(expr: ast.AST, cls: Optional[_ClassInfo]) -> None:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id in _SELF_NAMES and cls is not None:
+                m = cls.methods.get(expr.attr)
+                if m is not None:
+                    self._entry_fns.add(m)
+            elif isinstance(expr, ast.Name):
+                for f in name_defs.get(expr.id, ()):
+                    self._entry_fns.add(f)
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func) or ""
+            cls = self._enclosing_class(node)
+            is_thread = fname.endswith("Thread")
+            is_submit = fname.rsplit(".", 1)[-1] in ("submit",
+                                                     "call_soon",
+                                                     "run_in_executor")
+            if is_thread:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        mark_target(kw.value, cls)
+            elif is_submit and node.args:
+                mark_target(node.args[0], cls)
+            else:
+                # escaping as a value: self.M passed/stored, not called
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id in _SELF_NAMES and \
+                            cls is not None and arg.attr in cls.methods:
+                        self._entry_fns.add(cls.methods[arg.attr])
+        # closure over same-class self-calls
+        frontier = list(self._entry_fns)
+        self._reach_fns = set(frontier)
+        while frontier:
+            fn = frontier.pop()
+            cls = self._fn_class.get(fn)
+            if cls is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id in _SELF_NAMES:
+                    m = cls.methods.get(sub.func.attr)
+                    if m is not None and m not in self._reach_fns:
+                        self._reach_fns.add(m)
+                        frontier.append(m)
+
+    def _enclosing_class(self, node: ast.AST) -> Optional[_ClassInfo]:
+        # cheap: attribute via the fn map of any ancestor FunctionDef —
+        # recompute by walking each class's span instead
+        for ci in self.classes:
+            if ci.node.lineno <= getattr(node, "lineno", 0) <= \
+                    (ci.node.end_lineno or 1 << 30):
+                # nested classes resolve to the innermost span
+                best = ci
+                for cj in self.classes:
+                    if cj is ci:
+                        continue
+                    if ci.node.lineno <= cj.node.lineno and \
+                            (cj.node.end_lineno or 0) <= \
+                            (ci.node.end_lineno or 1 << 30) and \
+                            cj.node.lineno <= node.lineno <= \
+                            (cj.node.end_lineno or 1 << 30):
+                        best = cj
+                return best
+        return None
+
+    # -- guard machinery ---------------------------------------------------
+
+    def _guard_key(self, expr: ast.AST,
+                   cls: Optional[_ClassInfo]) -> Optional[tuple]:
+        """The guard key of a with-item context expression, or None when
+        it is not lock-ish."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            recv, attr = expr.value.id, expr.attr
+            if recv in _SELF_NAMES and cls is not None:
+                if attr in cls.lock_attrs:
+                    return ("self", cls.name, cls.lock_attrs[attr])
+                if _LOCKISH_ATTR_RE.search(attr):
+                    return ("self", cls.name, attr)
+                return None
+            if _LOCKISH_ATTR_RE.search(attr):
+                return ("recv", recv, attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return ("mod", expr.id)
+            if _LOCKISH_ATTR_RE.search(expr.id):
+                return ("mod", expr.id)
+            return None
+        dotted = _dotted(expr)
+        if dotted and _LOCKISH_ATTR_RE.search(dotted.rsplit(".", 1)[-1]):
+            return ("expr", dotted)
+        return None
+
+    def _node_label(self, key: tuple) -> str:
+        if key[0] == "self":
+            return f"{key[1]}.{key[2]}"
+        if key[0] == "recv":
+            return f"{key[1]}.{key[2]}"
+        return key[-1]
+
+    def _class_fns(self, cls: _ClassInfo):
+        seen: Set[ast.AST] = set()
+        for m in cls.methods.values():
+            for f in ast.walk(m):
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and f not in seen:
+                    seen.add(f)
+                    yield f
+
+    def _annotated_guards(self, cls: _ClassInfo) -> Dict[str, Set[tuple]]:
+        """``#: guarded-by(<lock>)`` annotations on `self.attr = ...`
+        lines (same line or the line above)."""
+        out: Dict[str, Set[tuple]] = {}
+        for m in cls.methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Attribute) and \
+                        isinstance(sub.targets[0].value, ast.Name) and \
+                        sub.targets[0].value.id in _SELF_NAMES:
+                    for line in (sub.lineno, sub.lineno - 1):
+                        c = self._comments.get(line, "")
+                        mt = _GUARDED_BY_RE.search(c)
+                        if mt:
+                            lock = cls.lock_attrs.get(mt.group(1),
+                                                      mt.group(1))
+                            out.setdefault(sub.targets[0].attr, set()).add(
+                                ("self", cls.name, lock))
+                            break
+        return out
+
+    def _infer_guarded(self) -> None:
+        for cls in self.classes:
+            cls.guarded = self._annotated_guards(cls)
+            for fn in self._class_fns(cls):
+                self._walk_regions(
+                    fn, cls,
+                    on_access=self._guard_recorder(cls))
+        # receiver-aggregated inference (module-wide)
+        for node in self.tree.body:
+            targets = [node] if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)) else []
+            for t in targets:
+                for fn in ast.walk(t):
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        self._walk_regions(
+                            fn, self._fn_class.get(fn),
+                            on_access=self._recv_recorder())
+
+    def _guard_recorder(self, cls: _ClassInfo):
+        def on_access(recv, attr, is_write, guards, node, fn):
+            if recv in _SELF_NAMES and is_write and guards:
+                keys = {g for g in guards
+                        if g[0] == "self" and g[1] == cls.name}
+                if keys:
+                    cls.guarded.setdefault(attr, set()).update(keys)
+        return on_access
+
+    def _recv_recorder(self):
+        def on_access(recv, attr, is_write, guards, node, fn):
+            if recv in _SELF_NAMES or not is_write:
+                return
+            locks = {g[2] for g in guards
+                     if g[0] == "recv" and g[1] == recv}
+            if locks:
+                self._recv_guarded.setdefault(attr, set()).update(locks)
+        return on_access
+
+    def _walk_regions(self, fn: ast.AST, cls: Optional[_ClassInfo],
+                      on_access=None, on_call=None, on_with=None,
+                      on_node=None) -> None:
+        """Walk one function body with an active guard-region stack.
+
+        Nested function definitions are NOT descended into (their bodies
+        run later, outside these regions); they are analyzed as their
+        own functions. ``*_locked`` methods start inside the synthetic
+        :data:`_HELD_ALL` region. ``on_node(node, stack)`` fires for
+        every visited non-``With`` node with the LIVE (read-only) stack
+        of ``(guard_key, with_node)`` entries — the one walker every
+        region-aware rule builds on."""
+        stack: List[Tuple[tuple, ast.With]] = []
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                fn.name.endswith("_locked"):
+            stack.append((_HELD_ALL, None))
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    key = self._guard_key(item.context_expr, cls)
+                    if key is not None:
+                        if on_with is not None:
+                            on_with(key, [k for k, _ in stack], node)
+                        stack.append((key, node))
+                        pushed += 1
+                for item in node.items:
+                    visit(item.context_expr)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars)
+                for child in node.body:
+                    visit(child)
+                for _ in range(pushed):
+                    stack.pop()
+                return
+            if on_access is not None:
+                self._emit_accesses(node, on_access,
+                                    [k for k, _ in stack], fn)
+            if on_call is not None and isinstance(node, ast.Call):
+                on_call(node, [(k, w) for k, w in stack])
+            if on_node is not None:
+                on_node(node, stack)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for child in body:
+            visit(child)
+
+    def _emit_accesses(self, node: ast.AST, on_access, guards,
+                       fn) -> None:
+        """Classify direct attribute reads/writes on plain receivers."""
+        def attr_of(target: ast.AST):
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name):
+                return target.value.id, target.attr
+            return None
+
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                ra = attr_of(t)
+                if ra:
+                    on_access(ra[0], ra[1], True, guards, node, fn)
+                elif isinstance(t, ast.Subscript):
+                    ra = attr_of(t.value)
+                    if ra:
+                        on_access(ra[0], ra[1], True, guards, node, fn)
+        elif isinstance(node, ast.AugAssign):
+            ra = attr_of(node.target)
+            if ra:
+                on_access(ra[0], ra[1], True, guards, node, fn)
+            elif isinstance(node.target, ast.Subscript):
+                ra = attr_of(node.target.value)
+                if ra:
+                    on_access(ra[0], ra[1], True, guards, node, fn)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATING_CALLS:
+            ra = attr_of(node.func.value)
+            if ra:
+                on_access(ra[0], ra[1], True, guards, node, fn)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.value, ast.Name):
+            on_access(node.value.id, node.attr, False, guards, node, fn)
+
+    # -- GL010 / GL011 / GL012 per-function checks -------------------------
+
+    def _check_fn(self, fn: ast.AST, cls: Optional[_ClassInfo]) -> None:
+        cls = self._fn_class.get(fn, cls)
+        fn_name = getattr(fn, "name", "<lambda>")
+        in_reach = fn in self._reach_fns
+        is_init = fn_name in ("__init__", "__new__")
+        is_locked_fn = fn_name.endswith("_locked")
+
+        def on_access(recv, attr, is_write, guards, node, afn):
+            if self.rules is not None and "GL010" not in self.rules:
+                return
+            if is_init or is_locked_fn or _HELD_ALL in guards:
+                return
+            line = getattr(node, "lineno", getattr(fn, "lineno", 0))
+            if recv in _SELF_NAMES:
+                if cls is None or attr not in cls.guarded:
+                    return
+                if attr in cls.lock_attrs or attr in cls.event_attrs:
+                    return
+                want = cls.guarded[attr]
+                held = {g for g in guards
+                        if g[0] == "self" and g[1] == cls.name}
+                if held & want:
+                    return
+                if is_write or in_reach:
+                    locks = ", ".join(sorted(
+                        self._node_label(k) for k in want))
+                    kind = "write to" if is_write else \
+                        "thread-reachable read of"
+                    self._emit(
+                        "GL010", line,
+                        f"{kind} {recv}.{attr} outside its guarding "
+                        f"lock ({locks}): {attr} is written under that "
+                        f"lock elsewhere, so this access races it; "
+                        f"hold the lock, rename the method *_locked if "
+                        f"the caller holds it, or suppress with a "
+                        f"reason")
+            else:
+                want_locks = self._recv_guarded.get(attr)
+                if not want_locks or _LOCKISH_ATTR_RE.search(attr):
+                    return
+                held = {g[2] for g in guards
+                        if g[0] == "recv" and g[1] == recv}
+                if held & want_locks:
+                    return
+                if is_write or in_reach:
+                    kind = "write to" if is_write else \
+                        "thread-reachable read of"
+                    self._emit(
+                        "GL010", line,
+                        f"{kind} {recv}.{attr} outside "
+                        f"{recv}.{'/'.join(sorted(want_locks))}: "
+                        f"'{attr}' is written under that lock elsewhere "
+                        f"in this module; hold it here or suppress with "
+                        f"a reason")
+
+        def on_call(node, stack):
+            if self.rules is not None and "GL012" not in self.rules:
+                return
+            lock_keys = [k for k, _ in stack if k != _HELD_ALL]
+            if not lock_keys:
+                return
+            dotted = _dotted(node.func) or ""
+            root = dotted.split(".", 1)[0]
+            last = dotted.rsplit(".", 1)[-1]
+            hit = None
+            if root in _DEVICE_ROOTS:
+                hit = f"device call {dotted}()"
+            elif last in _DEVICE_ATTRS:
+                hit = f"blocking device call .{last}()"
+            elif last in _DEVICE_SUFFIXES:
+                hit = f"index build/upload helper {dotted or last}()"
+            if hit is None:
+                return
+            locks = ", ".join(self._node_label(k) for k in lock_keys)
+            self._emit(
+                "GL012", node.lineno,
+                f"{hit} inside `with {locks}:` — device dispatch/"
+                f"compile/upload under a lock stalls every concurrent "
+                f"acquirer; snapshot under the lock, compute outside, "
+                f"or suppress with a reason")
+
+        def on_with(key, held, node):
+            if not held:
+                return
+            a = self._node_label(held[-1])
+            b = self._node_label(key)
+            if a == b:
+                return
+            self._edges.setdefault((a, b),
+                                   (node.lineno, "nested with"))
+
+        self._walk_regions(fn, cls, on_access=on_access, on_call=on_call,
+                           on_with=on_with)
+        # one-hop call expansion for GL013: `with A:` body calling a
+        # same-class method that acquires B adds A -> B
+        if cls is not None:
+            self._expand_call_edges(fn, cls)
+        self._check_gl011(fn, cls)
+
+    def _expand_call_edges(self, fn: ast.AST, cls: _ClassInfo) -> None:
+        acquires: Dict[str, List[Tuple[tuple, int]]] = {}
+
+        def collect(m: ast.AST) -> List[Tuple[tuple, int]]:
+            out: List[Tuple[tuple, int]] = []
+            self._walk_regions(m, cls, on_with=lambda k, h, n:
+                               out.append((k, n.lineno)))
+            return out
+
+        def on_call(node, stack):
+            lock_keys = [k for k, _ in stack if k != _HELD_ALL]
+            if not lock_keys:
+                return
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in _SELF_NAMES:
+                callee = cls.methods.get(node.func.attr)
+                if callee is None or callee is fn:
+                    return
+                if node.func.attr not in acquires:
+                    acquires[node.func.attr] = collect(callee)
+                a = self._node_label(lock_keys[-1])
+                for key, _line in acquires[node.func.attr]:
+                    b = self._node_label(key)
+                    if a != b:
+                        self._edges.setdefault(
+                            (a, b),
+                            (node.lineno,
+                             f"call to {node.func.attr}()"))
+
+        self._walk_regions(fn, cls, on_call=on_call)
+
+    # -- GL011 -------------------------------------------------------------
+
+    def _check_gl011(self, fn: ast.AST, cls: Optional[_ClassInfo]) -> None:
+        if self.rules is not None and "GL011" not in self.rules:
+            return
+        fn_name = getattr(fn, "name", "<lambda>")
+        if fn_name in ("__init__", "__new__"):
+            return
+        has_locks = bool(
+            (cls is not None and cls.lock_attrs) or self.module_locks)
+        if not has_locks:
+            return
+
+        def checked_attrs(test: ast.AST):
+            out: Set[Tuple[str, str]] = set()
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Attribute) and \
+                        isinstance(sub.value, ast.Name):
+                    out.add((sub.value.id, sub.attr))
+                elif isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in ("is_set", "locked", "empty",
+                                          "full") and \
+                        isinstance(sub.func.value, ast.Attribute) and \
+                        isinstance(sub.func.value.value, ast.Name):
+                    out.add((sub.func.value.value.id,
+                             sub.func.value.attr))
+            return out
+
+        def interesting(recv: str, attr: str) -> bool:
+            if recv in _SELF_NAMES and cls is not None:
+                return attr in cls.guarded or attr in cls.event_attrs
+            return attr in self._recv_guarded
+
+        def act_attr(node: ast.AST) -> Optional[Tuple[str, str]]:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    if isinstance(base, ast.Attribute) and \
+                            isinstance(base.value, ast.Name):
+                        return base.value.id, base.attr
+            elif isinstance(node, ast.AugAssign):
+                base = node.target.value \
+                    if isinstance(node.target, ast.Subscript) \
+                    else node.target
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name):
+                    return base.value.id, base.attr
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _ACT_CALLS:
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute) and \
+                        isinstance(recv.value, ast.Name):
+                    return recv.value.id, recv.attr
+            return None
+
+        # (recv, attr) -> (check region, check line); LATEST check wins:
+        # the double-checked idiom (re-check inside the act's own
+        # region) legitimately supersedes an earlier region's check
+        # region identity = the innermost (guard_key, with_node) stack
+        # entry (None = unlocked; the *_locked synthetic entry compares
+        # equal function-wide, so caller-held checks/acts are one
+        # region). The traversal itself is _walk_regions' — one walker
+        # for every region-aware rule.
+        pending: Dict[Tuple[str, str], Tuple[object, int]] = {}
+        # local flags carrying a check: `free = k not in self._jobs` then
+        # `if free:` inherits the check's attr and region
+        flag_vars: Dict[str, Tuple[Tuple[str, str], object, int]] = {}
+
+        def on_node(node: ast.AST, stack) -> None:
+            region = stack[-1] if stack else None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                hits = [ra for ra in checked_attrs(node.value)
+                        if interesting(*ra)]
+                if hits:
+                    flag_vars[node.targets[0].id] = (
+                        hits[0], region, node.lineno)
+                else:
+                    flag_vars.pop(node.targets[0].id, None)
+            if isinstance(node, ast.If):
+                for recv, attr in checked_attrs(node.test):
+                    if interesting(recv, attr):
+                        pending[(recv, attr)] = (region, node.lineno)
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Name) and sub.id in flag_vars:
+                        key, reg, line = flag_vars[sub.id]
+                        pending[key] = (reg, line)
+            ra = act_attr(node)
+            hit = pending.get(ra) if ra is not None else None
+            if hit is None:
+                return
+            check_region, check_line = hit
+            same_region = (check_region == region and
+                           check_region is not None)
+            if check_region is None and region is None:
+                # only Events/locks are flagged fully unlocked:
+                # unguarded lazy-init of plain attrs is a
+                # single-thread idiom
+                recv, attr = ra
+                is_event = (recv in _SELF_NAMES and cls is not None and
+                            attr in cls.event_attrs)
+                if not is_event:
+                    same_region = True     # exempt
+            if not same_region:
+                self._emit(
+                    "GL011", node.lineno,
+                    f"check-then-act on {ra[0]}.{ra[1]}: checked "
+                    f"at line {check_line} in a different lock "
+                    f"region than this act — the condition can be "
+                    f"invalidated between them; merge into one "
+                    f"critical section or use an atomic "
+                    f"test-and-set (non-blocking Lock.acquire)")
+
+        self._walk_regions(fn, cls, on_node=on_node)
+
+    # -- GL013 -------------------------------------------------------------
+
+    def _check_gl013_cycles(self) -> None:
+        if self.rules is not None and "GL013" not in self.rules:
+            return
+        graph: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        for (a, b), (line, via) in self._edges.items():
+            graph.setdefault(a, {})[b] = (line, via)
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            # DFS cycle detection from each node
+            path: List[str] = []
+
+            def dfs(n: str) -> Optional[List[str]]:
+                if n in path:
+                    return path[path.index(n):] + [n]
+                if n not in graph:
+                    return None
+                path.append(n)
+                for succ in sorted(graph[n]):
+                    cyc = dfs(succ)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                return None
+
+            cyc = dfs(start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in reported:
+                continue
+            reported.add(key)
+            line = min(graph[a][b][0] for a, b in zip(cyc, cyc[1:])
+                       if b in graph.get(a, {}))
+            detail = "; ".join(
+                f"{a} -> {b} at line {graph[a][b][0]} ({graph[a][b][1]})"
+                for a, b in zip(cyc, cyc[1:]) if b in graph.get(a, {}))
+            self._emit(
+                "GL013", line,
+                f"lock-order cycle {' -> '.join(cyc)}: two paths acquire "
+                f"these locks in opposite orders and can deadlock "
+                f"({detail}); pick one global order (docs/serving.md "
+                f"lock hierarchy) and restructure the out-of-order "
+                f"acquisition")
+
+    # -- GL014 -------------------------------------------------------------
+
+    def _check_gl014_threads(self) -> None:
+        if self.rules is not None and "GL014" not in self.rules:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func) or ""
+            if fname not in ("threading.Thread", "Thread"):
+                continue
+            if any(kw.arg == "daemon" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is True for kw in node.keywords):
+                continue
+            # assigned to a name/attr that is later joined or daemonized?
+            target = self._assign_target_of(node)
+            if target is not None and (
+                    re.search(rf"\b{re.escape(target)}\s*\.\s*join\s*\(",
+                              self.source) or
+                    re.search(rf"\b{re.escape(target)}\s*\.\s*daemon\s*=",
+                              self.source)):
+                continue
+            self._emit(
+                "GL014", node.lineno,
+                "threading.Thread created neither daemon=True nor "
+                "joined: it outlives close()/shutdown, pins its closure "
+                "and can hang interpreter exit; pass daemon=True or "
+                "join it in the owning lifecycle")
+
+    def _assign_target_of(self, call: ast.Call) -> Optional[str]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and node.value is call and \
+                    len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    return t.id
+                d = _dotted(t)
+                if d:
+                    return d.rsplit(".", 1)[-1]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# public API (mirrors analysis.lint)
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Set[str]] = None) -> List[Finding]:
+    return FileRaceLinter(path, source, rules).run()
+
+
+def lint_file(path, rules: Optional[Set[str]] = None) -> List[Finding]:
+    p = Path(path)
+    try:
+        source = p.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("GL000", str(p), 0, f"unreadable: {e}",
+                        engine="races")]
+    try:
+        return lint_source(source, str(p), rules)
+    except SyntaxError as e:
+        return [Finding("GL000", str(p), e.lineno or 0,
+                        f"syntax error: {e.msg}", engine="races")]
+
+
+def lint_paths(paths: Sequence, rules: Optional[Set[str]] = None
+               ) -> List[Finding]:
+    """Race-lint files and directories (``**/*.py``, sans __pycache__)."""
+    findings: List[Finding] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files = sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        else:
+            files = [p]
+        for f in files:
+            findings.extend(lint_file(f, rules))
+    return findings

@@ -129,6 +129,55 @@ register_rule(
     "number must name its round or artifact so staleness is detectable",
 )
 register_rule(
+    "GL010", "unguarded-shared-state",
+    "shared mutable attribute accessed outside its guarding lock "
+    "(inferred from `with self.<lock>:` write sites or declared via "
+    "`#: guarded-by(<lock>)`)",
+    "the serving tier is multi-threaded: an attribute ever written under "
+    "a lock is shared state, and a thread-reachable read or any write "
+    "outside that lock is exactly the unpinned-handle / stale-flag class "
+    "every post-review fix in PRs 5-6 chased by hand; methods named "
+    "*_locked assert a caller-holds-lock contract instead",
+)
+register_rule(
+    "GL011", "check-then-act",
+    "check and act on the same shared attribute in different lock "
+    "regions (Event.is_set/flag/dict-membership test in one critical "
+    "section, mutation in another or in none)",
+    "the lock was released between the check and the act, so the "
+    "condition can be invalidated in between — the PR-5 compact() "
+    "single-flight bug class (an Event check-then-set admitted "
+    "duplicate background compactions); make it one critical section "
+    "or a real test-and-set",
+)
+register_rule(
+    "GL012", "device-work-under-lock",
+    "blocking device work (jax.* calls, block_until_ready, device_put, "
+    "index build/extend helpers) inside a `with <lock>:` body",
+    "device dispatch, compiles, and uploads take milliseconds to "
+    "minutes; under a lock they convert every concurrent "
+    "delete/upsert/dispatch into tail latency — the side-build-under-"
+    "the-mutation-RLock class PR 5's sixth review pass fixed; snapshot "
+    "under the lock, compute outside",
+)
+register_rule(
+    "GL013", "lock-order-cycle",
+    "a cycle in the static lock-acquisition graph (nested `with` over "
+    "distinct locks, reported as the cycle path)",
+    "two code paths acquiring the same pair of locks in opposite "
+    "orders deadlock under the right interleaving; the static graph "
+    "catches lexically-visible cycles, the RAFT_TPU_THREADSAN lock "
+    "sanitizer (analysis/lockwatch.py) catches the rest at test time",
+)
+register_rule(
+    "GL014", "unjoined-thread",
+    "threading.Thread created neither daemon=True nor joined",
+    "a non-daemon thread nobody joins outlives close()/shutdown, pins "
+    "its closure (device arrays, servers) and can hang interpreter "
+    "exit — the serving tier's convention is daemon threads plus "
+    "explicit close/join lifecycles",
+)
+register_rule(
     "GL006", "blockspec",
     "Pallas BlockSpec off the (sublane, 128) tile grid, or block set over "
     "the VMEM budget",

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.analysis import lockwatch
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.resilience import faultinject
 
@@ -299,12 +300,12 @@ def _proc_worker_main(rank: int, req_q, resp_q, algo: str, slow_s: float,
 # one lock for the spawn-time environment swap (XLA_FLAGS /
 # JAX_PLATFORMS are process-global; concurrent spawns must not
 # interleave their save/restore)
-_SPAWN_ENV_LOCK = threading.Lock()
+_SPAWN_ENV_LOCK = lockwatch.make_lock("comms.spawn_env")
 
 
 class _ProcWorker:
     __slots__ = ("rank", "proc", "req_q", "resp_q", "pending", "lock",
-                 "stopping", "receiver")
+                 "stopping", "receiver", "dead_reason")
 
     def __init__(self, rank, proc, req_q, resp_q):
         self.rank = rank
@@ -312,8 +313,14 @@ class _ProcWorker:
         self.req_q = req_q
         self.resp_q = resp_q
         self.pending: Dict[int, Future] = {}
-        self.lock = threading.Lock()
+        # graft-race sanitizer node "comms.procworker"
+        self.lock = lockwatch.make_lock("comms.procworker")
         self.stopping = False
+        # set (under `lock`) the moment the worker is declared dead and
+        # its pending futures are drained: `call` checks it under the
+        # SAME lock hold that registers the future, closing the window
+        # where a registration racing the drain was never resolved
+        self.dead_reason: Optional[str] = None
         self.receiver: Optional[threading.Thread] = None
 
 
@@ -426,6 +433,7 @@ class ProcGroup:
 
     def _fail_pending(self, w: _ProcWorker, msg: str) -> None:
         with w.lock:
+            w.dead_reason = msg
             pending = list(w.pending.values())
             w.pending.clear()
         for fut in pending:
@@ -438,14 +446,22 @@ class ProcGroup:
              payload: Optional[dict] = None) -> Future:
         w = self._workers[rank]
         fut: Future = Future()
-        if w.stopping or not w.proc.is_alive():
-            fut.set_exception(_rerrors.DeadBackendError(
-                f"fabric worker {rank} process is not alive"))
-            return fut
         req_id = next(self._req_ids)
         fut._raft_req_id = req_id
+        # register-or-reject ATOMICALLY against _fail_pending: the old
+        # unlocked aliveness check let a kill/close land between the
+        # check and the registration — the drain saw an empty pending
+        # map, the future was registered after it, and nobody ever
+        # resolved it (the caller hung to its timeout)
         with w.lock:
-            w.pending[req_id] = fut
+            dead = w.dead_reason
+            if dead is None and (w.stopping or not w.proc.is_alive()):
+                dead = f"fabric worker {rank} process is not alive"
+            if dead is None:
+                w.pending[req_id] = fut
+        if dead is not None:
+            fut.set_exception(_rerrors.DeadBackendError(dead))
+            return fut
         try:
             w.req_q.put((req_id, method, payload))
         except BaseException as e:  # noqa: BLE001 — classified: a torn queue is the dead-worker signal
@@ -525,7 +541,10 @@ class _LocalWorker:
         self.runtime = runtime
         self.q: "_pyqueue.Queue" = _pyqueue.Queue()
         self.pending: Dict[int, Future] = {}
-        self.lock = threading.Lock()
+        # graft-race sanitizer node "comms.localworker"; `dead` is
+        # written under it (see _fail_pending) so `call` can
+        # register-or-reject atomically against a concurrent kill
+        self.lock = lockwatch.make_lock("comms.localworker")
         self.dead = False
         self.thread: Optional[threading.Thread] = None
 
@@ -568,11 +587,13 @@ class LocalGroup:
             if msg is None:
                 return
             req_id, method, payload = msg
-            if w.dead:
+            with w.lock:
+                dead = w.dead           # guarded read: kill/close write
+                #                         it under the same lock
+            if dead:
                 continue                # the dead answer nothing, ever
             status, out = w.runtime.handle(method, payload)
             if status is DIE:
-                w.dead = True
                 self._fail_pending(
                     w, f"fabric worker {w.rank} died (injected)")
                 continue
@@ -590,7 +611,11 @@ class LocalGroup:
                 fut.set_exception(_remote_error(out))
 
     def _fail_pending(self, w: _LocalWorker, msg: str) -> None:
+        """Declare ``w`` dead and drain its futures — `dead` flips under
+        the SAME lock hold that empties ``pending``, so `call`'s
+        register-or-reject can never interleave between the two."""
         with w.lock:
+            w.dead = True
             pending = list(w.pending.values())
             w.pending.clear()
         for fut in pending:
@@ -601,14 +626,20 @@ class LocalGroup:
              payload: Optional[dict] = None) -> Future:
         w = self._workers[rank]
         fut: Future = Future()
-        if w.dead:
+        req_id = next(self._req_ids)
+        fut._raft_req_id = req_id
+        # atomic register-or-reject (see _ProcWorker.dead_reason): the
+        # old unlocked `if w.dead` check raced kill() — a future
+        # registered after the drain was never resolved and its caller
+        # hung to the RPC deadline
+        with w.lock:
+            dead = w.dead
+            if not dead:
+                w.pending[req_id] = fut
+        if dead:
             fut.set_exception(_rerrors.DeadBackendError(
                 f"fabric worker {rank} is not alive"))
             return fut
-        req_id = next(self._req_ids)
-        fut._raft_req_id = req_id
-        with w.lock:
-            w.pending[req_id] = fut
         w.q.put((req_id, method, payload))
         return fut
 
@@ -624,14 +655,13 @@ class LocalGroup:
         return not self._workers[rank].dead
 
     def kill(self, rank: int) -> None:
-        w = self._workers[rank]
-        w.dead = True
-        self._fail_pending(w, f"fabric worker {rank} killed")
+        # _fail_pending flips `dead` and drains atomically
+        self._fail_pending(self._workers[rank],
+                           f"fabric worker {rank} killed")
 
     def restart(self, rank: int,
                 fault_spec: Optional[str] = None) -> None:
         old = self._workers[rank]
-        old.dead = True
         self._fail_pending(old, f"fabric worker {rank} restarted")
         old.q.put(None)                 # let the old thread exit
         if fault_spec:
@@ -640,9 +670,8 @@ class LocalGroup:
 
     def close(self, timeout_s: float = 10.0) -> None:
         for w in self._workers:
-            w.dead = True
-            w.q.put(None)
             self._fail_pending(w, f"fabric worker {w.rank} closed")
+            w.q.put(None)
         for w in self._workers:
             if w.thread is not None:
                 w.thread.join(timeout=timeout_s)

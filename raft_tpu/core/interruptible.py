@@ -15,6 +15,8 @@ from typing import Dict
 
 import jax
 
+from raft_tpu.analysis import lockwatch
+
 
 class InterruptedException(RuntimeError):
     pass
@@ -22,7 +24,10 @@ class InterruptedException(RuntimeError):
 
 class Interruptible:
     _tokens: Dict[int, "Interruptible"] = {}
-    _lock = threading.Lock()
+    # graft-race sanitizer node "core.interruptible" (note: constructed
+    # at import, so RAFT_TPU_THREADSAN must be set process-wide to
+    # sanitize this one)
+    _lock = lockwatch.make_lock("core.interruptible")
 
     def __init__(self) -> None:
         self._cancelled = threading.Event()
@@ -44,7 +49,7 @@ class Interruptible:
     def check(self) -> None:
         """Raise if cancelled, clearing the flag (one-shot like the ref)."""
         if self._cancelled.is_set():
-            self._cancelled.clear()
+            self._cancelled.clear()  # graft-lint: allow-check-then-act token is thread-affine by contract (one token per get_token thread id); a racing double-check at worst double-raises the same cancellation
             raise InterruptedException("raft_tpu: interrupted")
 
     def synchronize(self, arr: jax.Array, poll_s: float = 0.01) -> None:

@@ -26,6 +26,8 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from raft_tpu.analysis import lockwatch
+
 
 class resource_type:
     """Slot names for the lazy resource registry.
@@ -55,7 +57,8 @@ class Resources:
     def __init__(self) -> None:
         self._factories: dict[str, Callable[[], Any]] = {}
         self._resources: dict[str, Any] = {}
-        self._lock = threading.Lock()
+        # graft-race sanitizer node "core.resources"
+        self._lock = lockwatch.make_lock("core.resources")
 
     def add_resource_factory(self, slot: str, factory: Callable[[], Any]) -> None:
         with self._lock:
@@ -162,7 +165,7 @@ class DeviceResources(Resources):
 # (reference core/device_resources_manager.hpp:43) — one handle per device,
 # created on first use.
 _default_handles: dict[int, DeviceResources] = {}
-_default_lock = threading.Lock()
+_default_lock = lockwatch.make_lock("core.resources_default")
 
 
 def get_device_resources(device: Optional[jax.Device] = None) -> DeviceResources:

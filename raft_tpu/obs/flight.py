@@ -96,7 +96,10 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> str:
             "t": time.time(), "kind": "snapshot", "reason": reason,
             "metrics": metrics.snapshot(runtime_gauges=False)["metrics"],
         }, default=str) + "\n")
-    _last_dump_path = path
+    with _lock:
+        # guarded like clear()'s write: last_dump_path() from another
+        # thread (the exit guard, tests) must not read a torn update
+        _last_dump_path = path
     metrics.counter("flight_dumps", reason=reason)
     return path
 

@@ -182,18 +182,28 @@ def inject(spec: str):
         install(None)
 
 
+def _plan_locked() -> List[FaultSpec]:
+    """The live plan; caller holds ``_lock``. The consuming checkpoints
+    (:func:`check`/:func:`proc_action`/:func:`rpc_dropped`) resolve the
+    plan under the SAME lock hold that decrements ``remaining`` — the
+    old fetch-then-relock let an ``install()``/``clear()`` swap the plan
+    in between, so a one-shot spec could be consumed off a detached
+    list (firing after a clear, or twice across the swap)."""
+    global _env_cache
+    if _plan is not None:
+        return _plan
+    env = os.environ.get(ENV_VAR, "")
+    if _env_cache is None or _env_cache[0] != env:
+        _env_cache = (env, parse(env) if env else [])
+    return _env_cache[1]
+
+
 def plan() -> List[FaultSpec]:
     """The live plan: the programmatic one if installed, else the parsed
     env var (cached against the env string so spec state persists across
     calls within one process)."""
-    global _env_cache
     with _lock:
-        if _plan is not None:
-            return _plan
-        env = os.environ.get(ENV_VAR, "")
-        if _env_cache is None or _env_cache[0] != env:
-            _env_cache = (env, parse(env) if env else [])
-        return _env_cache[1]
+        return _plan_locked()
 
 
 def active() -> bool:
@@ -209,12 +219,12 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
     """A fault point: raise the first matching live spec's synthetic
     error. Call this where a real device failure would surface (chunk
     boundaries of the streaming/build loops, stage entries of the
-    measurement battery)."""
-    specs = plan()
-    if not specs:
-        return
+    measurement battery). Spec matching + one-shot consumption happen
+    in ONE critical section (plan resolution included); the obs
+    bookkeeping and the raise run outside it."""
+    fired: Optional[FaultSpec] = None
     with _lock:
-        for s in specs:
+        for s in _plan_locked():
             if s.kind == "shard" or s.scope in ("proc", "rpc") \
                     or s.remaining <= 0:
                 # shard/proc/rpc specs are queried (dead_ranks,
@@ -228,17 +238,21 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
                     and chunk == int(idx)
             else:
                 hit = s.arg == stage
-            if not hit:
-                continue
-            s.remaining -= 1
-            cls, msg = _EXC[s.kind]
-            from raft_tpu import obs
+            if hit:
+                s.remaining -= 1
+                fired = s
+                break
+    if fired is None:
+        return
+    cls, msg = _EXC[fired.kind]
+    from raft_tpu import obs
 
-            obs.counter("faults_injected", kind=s.kind, stage=stage)
-            obs.event("fault_injected", spec=f"{s.kind}@{s.scope}:{s.arg}",
-                      stage=stage, chunk=chunk)
-            raise cls(f"{msg} ({s.kind}@{s.scope}:{s.arg} at "
-                      f"stage={stage!r} chunk={chunk})")
+    obs.counter("faults_injected", kind=fired.kind, stage=stage)
+    obs.event("fault_injected",
+              spec=f"{fired.kind}@{fired.scope}:{fired.arg}",
+              stage=stage, chunk=chunk)
+    raise cls(f"{msg} ({fired.kind}@{fired.scope}:{fired.arg} at "
+              f"stage={stage!r} chunk={chunk})")
 
 
 def dead_ranks() -> FrozenSet[int]:
@@ -267,47 +281,50 @@ def proc_action(rank: int) -> Optional[str]:
     Returns ``None`` when nothing matches. Called by the fabric workers
     (:mod:`raft_tpu.comms.procgroup`) at their data-plane fault points —
     the place a real machine failure would surface."""
-    specs = plan()
-    if not specs:
-        return None
+    fired: Optional[FaultSpec] = None
     with _lock:
-        for s in specs:
+        for s in _plan_locked():
             if s.scope != "proc" or s.remaining <= 0:
                 continue
             if int(s.arg) != int(rank):
                 continue
             s.remaining -= 1
-            action = "die" if s.kind == "dead" else "slow"
-            from raft_tpu import obs
+            fired = s
+            break
+    if fired is None:
+        return None
+    action = "die" if fired.kind == "dead" else "slow"
+    from raft_tpu import obs
 
-            obs.counter("faults_injected", kind=s.kind,
-                        stage=f"proc:{rank}")
-            obs.event("fault_injected",
-                      spec=f"{s.kind}@{s.scope}:{s.arg}", rank=int(rank),
-                      action=action)
-            return action
-    return None
+    obs.counter("faults_injected", kind=fired.kind,
+                stage=f"proc:{rank}")
+    obs.event("fault_injected",
+              spec=f"{fired.kind}@{fired.scope}:{fired.arg}",
+              rank=int(rank), action=action)
+    return action
 
 
 def rpc_dropped(method: str) -> bool:
     """Consume a ``drop@rpc:METHOD`` spec: True means this RPC's
     response must be dropped on the floor — the caller sees only a
     timeout, exactly like a response lost on the wire."""
-    specs = plan()
-    if not specs:
-        return False
+    fired: Optional[FaultSpec] = None
     with _lock:
-        for s in specs:
+        for s in _plan_locked():
             if s.scope != "rpc" or s.remaining <= 0:
                 continue
             if s.arg != method:
                 continue
             s.remaining -= 1
-            from raft_tpu import obs
+            fired = s
+            break
+    if fired is None:
+        return False
+    from raft_tpu import obs
 
-            obs.counter("faults_injected", kind=s.kind,
-                        stage=f"rpc:{method}")
-            obs.event("fault_injected",
-                      spec=f"{s.kind}@{s.scope}:{s.arg}", method=method)
-            return True
-    return False
+    obs.counter("faults_injected", kind=fired.kind,
+                stage=f"rpc:{method}")
+    obs.event("fault_injected",
+              spec=f"{fired.kind}@{fired.scope}:{fired.arg}",
+              method=method)
+    return True

@@ -36,6 +36,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu import obs
+from raft_tpu.analysis import lockwatch
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.utils.math import next_pow2
 
@@ -83,9 +84,13 @@ def choose_bucket(ladder: Sequence[int], rows: int,
     choice is registered with ``tuning/`` under op ``serve_bucket`` so a
     measured table can prefer the next rung up (on a TPU the 2x-wider
     matmul can cost the same wall-clock, and the wider trace doubles as
-    headroom for the next batch). ``ceiling`` (the OOM-downshifted
-    max) caps the answer except when a single oversized request needs
-    the bigger rung anyway — the dispatcher's splitter handles that.
+    headroom for the next batch — a TPU-shaped PROJECTION as of r6:
+    the axon backend has been dead since r4 and ``tables/cpu.json``
+    carries no ``serve_bucket`` entries, so the fallback always wins
+    until ``capture_dispatch_tables.py`` runs on a live chip).
+    ``ceiling`` (the OOM-downshifted max) caps the answer except when
+    a single oversized request needs the bigger rung anyway — the
+    dispatcher's splitter handles that.
     """
     from raft_tpu import tuning
 
@@ -169,7 +174,8 @@ class MicroBatcher:
         self._ceiling = self.max_batch_rows
         self._closed = False
         self._seq = 0
-        self._lock = threading.Lock()
+        # graft-race sanitizer node "serve.batcher" (RAFT_TPU_THREADSAN)
+        self._lock = lockwatch.make_lock("serve.batcher")
         self._cond = threading.Condition(self._lock)
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -237,13 +243,27 @@ class MicroBatcher:
         return self._ceiling
 
     def set_ceiling(self, rows: int) -> None:
-        """Clamp the dispatch bucket ceiling (OOM-ladder downshift); the
-        floor is the smallest ladder rung."""
+        """Set the dispatch bucket ceiling (clamped to the ladder)."""
         with self._cond:
             self._ceiling = max(min(int(rows), self.max_batch_rows),
                                 self.ladder[0])
             obs.gauge("serve.bucket_ceiling", self._ceiling,
                       index=self.name)
+
+    def lower_ceiling(self, rows: int) -> int:
+        """Monotonically clamp the ceiling DOWN to ``rows`` (never up),
+        atomically. The OOM ladder's downshift used to read ``ceiling``
+        then call :meth:`set_ceiling` with the min — two concurrent OOM
+        batches could interleave the read-modify-write and the later,
+        SHALLOWER downshift would raise the ceiling back over the
+        deeper one (a GL010/GL011 lost update). Returns the new
+        ceiling."""
+        with self._cond:
+            self._ceiling = max(min(self._ceiling, int(rows)),
+                                self.ladder[0])
+            obs.gauge("serve.bucket_ceiling", self._ceiling,
+                      index=self.name)
+            return self._ceiling
 
     def depth_rows(self) -> int:
         with self._lock:
@@ -286,7 +306,7 @@ class MicroBatcher:
                 head = self._q[0]
                 deadline = head.t_enqueue + self.max_wait_s
                 while (not self._closed and self._q
-                       and self._head_run_rows() < self._ceiling):
+                       and self._head_run_rows_locked() < self._ceiling):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
@@ -295,9 +315,10 @@ class MicroBatcher:
                     continue
                 return self._drain_locked()
 
-    def _head_run_rows(self) -> int:
+    def _head_run_rows_locked(self) -> int:
         """Rows in the longest filter-homogeneous run at the queue head
-        (only those can coalesce into one batch)."""
+        (only those can coalesce into one batch); caller holds
+        ``_cond``."""
         if not self._q:
             return 0
         key = id(self._q[0].prefilter) if self._q[0].prefilter is not None \

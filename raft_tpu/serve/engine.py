@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu import obs, tuning
+from raft_tpu.analysis import lockwatch
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.distance.types import is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -263,8 +264,10 @@ class _IndexServing:
         # on their first growing upsert
         self.warmup_enabled = self.params.warmup
         # non-blocking acquire = atomic test-and-set: exactly one
-        # compaction runs per index (released by the background thread)
-        self.compacting = threading.Lock()
+        # compaction runs per index (released by the background thread).
+        # A handoff FLAG, not a critical-section lock — see
+        # lockwatch.make_flag_lock for why the sanitizer exempts it
+        self.compacting = lockwatch.make_flag_lock("serve.compacting")
         self.batcher = MicroBatcher(
             self._dispatch,
             max_batch_rows=self.params.max_batch_rows,
@@ -325,7 +328,9 @@ class _IndexServing:
 
     def _downshift(self, new_ceiling: int) -> None:
         new_ceiling = max(int(new_ceiling), self.batcher.ladder[0])
-        self.batcher.set_ceiling(min(self.batcher.ceiling, new_ceiling))
+        # atomic monotone clamp: two concurrent OOM downshifts used to
+        # race the ceiling read and the shallower one could win
+        self.batcher.lower_ceiling(new_ceiling)
         tuning.record_budget("serve_batch_rows", new_ceiling)
         obs.counter("oom_ladder_downshifts", path="serve")
         obs.event("serve_downshift", index=self.name, ceiling=new_ceiling)
@@ -528,7 +533,8 @@ class Server:
         self.params = params or ServeParams()
         self.registry = Registry()
         self._servings: Dict[str, _IndexServing] = {}
-        self._lock = threading.Lock()
+        # graft-race sanitizer node "serve.engine"
+        self._lock = lockwatch.make_lock("serve.engine")
         self._closed = False
 
     # -- index lifecycle ---------------------------------------------------
@@ -633,7 +639,14 @@ class Server:
             serving = self._serving(index)
             gen = self.registry.get(index)
             handle = gen.handle if gen is not None else None
-            if handle is None and not self._closed:
+            # closed is read AFTER the registry lookup: a close() that
+            # drained the registry between the two must surface as the
+            # fatal `closed` rejection (batcher-side), never as a
+            # transient not_ready a well-behaved client would retry
+            # against a permanently closed server
+            with self._lock:
+                closed = self._closed
+            if handle is None and not closed:
                 # create_index/add_index registers the serving BEFORE its
                 # first publish, and warmup can hold that window open for
                 # minutes — a request admitted now would skip the k/dim
@@ -952,7 +965,9 @@ class Server:
         try:
             return self.registry.pin(name)
         except KeyError:
-            if self._closed:
+            with self._lock:
+                closed = self._closed
+            if closed:
                 raise RuntimeError("server is closed") from None
             raise
 

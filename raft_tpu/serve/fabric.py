@@ -70,6 +70,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu import obs, tuning
+from raft_tpu.analysis import lockwatch
 from raft_tpu.comms.procgroup import LocalGroup, ProcGroup, is_no_gen
 from raft_tpu.resilience import ShardDropoutError
 from raft_tpu.resilience import errors as _rerrors
@@ -140,14 +141,15 @@ class WorkerHealth:
         self.rank = int(rank)
         self.fail_threshold = int(fail_threshold)
         self.halfopen_after_s = float(halfopen_after_s)
-        self.lock = threading.Lock()
+        # graft-race sanitizer node "fabric.health"
+        self.lock = lockwatch.make_lock("fabric.health")
         self.state = CLOSED
         self.failures = 0
         self.opened_at = 0.0
         obs.gauge("fabric.worker_health", 1.0, worker=self.rank)
 
-    def _transition(self, to: str) -> None:
-        # caller holds self.lock
+    def _transition_locked(self, to: str) -> None:
+        # *_locked: caller holds self.lock (the GL010 contract suffix)
         self.state = to
         obs.counter("fabric.circuit_transitions", worker=self.rank,
                     to=to)
@@ -159,7 +161,7 @@ class WorkerHealth:
         with self.lock:
             self.failures = 0
             if self.state != CLOSED:
-                self._transition(CLOSED)
+                self._transition_locked(CLOSED)
 
     def record_failure(self, kind: str) -> None:
         with self.lock:
@@ -169,7 +171,7 @@ class WorkerHealth:
                     or self.failures >= self.fail_threshold)
             if trip:
                 if self.state != OPEN:
-                    self._transition(OPEN)
+                    self._transition_locked(OPEN)
                 self.opened_at = time.monotonic()
 
     def routable(self) -> bool:
@@ -184,7 +186,7 @@ class WorkerHealth:
     def to_half_open(self) -> None:
         with self.lock:
             if self.state == OPEN:
-                self._transition(HALF_OPEN)
+                self._transition_locked(HALF_OPEN)
 
     def force_open(self) -> None:
         """Used by restart: a respawned worker is not routable until a
@@ -192,7 +194,7 @@ class WorkerHealth:
         the probe is due immediately)."""
         with self.lock:
             if self.state != OPEN:
-                self._transition(OPEN)
+                self._transition_locked(OPEN)
             self.opened_at = 0.0
 
 
@@ -295,10 +297,11 @@ class Fabric:
             for r in range(p.n_workers)
         ]
         self._counters: collections.Counter = collections.Counter()
-        self._stats_lock = threading.Lock()
+        # graft-race sanitizer nodes "fabric.stats" / "fabric.swap"
+        self._stats_lock = lockwatch.make_lock("fabric.stats")
         self._lat_ms: collections.deque = collections.deque(maxlen=256)
         self._gen_counter = 0
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockwatch.make_lock("fabric.swap")
         self._closed = False
         self._dataset = dataset
         if isinstance(group, str):

@@ -25,11 +25,11 @@ skipped entirely.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu.analysis import lockwatch
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.utils.math import next_pow2
 
@@ -75,7 +75,10 @@ class MutableState:
     def __init__(self, n_rows: int, dim: int, dtype,
                  ext_ids: Optional[np.ndarray] = None,
                  side_capacity: int = 256):
-        self.lock = threading.RLock()
+        # constructed through the graft-race sanitizer: under
+        # RAFT_TPU_THREADSAN=1 every acquisition feeds the lock-order
+        # graph as node "serve.mutation" (docs/serving.md lock hierarchy)
+        self.lock = lockwatch.make_rlock("serve.mutation")
         self.dim = int(dim)
         self.dtype = np.dtype(dtype)
         self.base_ids = int(n_rows)          # internal ids [0, base_ids)
@@ -113,16 +116,20 @@ class MutableState:
     # -- id translation ----------------------------------------------------
 
     def _install_translation(self, ext_ids: Optional[np.ndarray] = None):
-        if self._ext2int is not None:
-            return
-        if ext_ids is None:
-            ext_ids = np.arange(self.next_int, dtype=np.int64)
-        self._int2ext = ext_ids.copy()
-        # only LIVE rows get a forward mapping: ids deleted back in
-        # identity mode must stay deleted (to_internal → None), not be
-        # resurrected by the switch to explicit translation
-        self._ext2int = {int(e): i for i, e in enumerate(ext_ids)
-                         if i >= self._keep.shape[0] or self._keep[i]}
+        # takes the (reentrant) mutation lock itself: __init__ calls
+        # this pre-publication, upsert under its own hold — both nest
+        # cleanly, and the map writes are never unlocked (GL010)
+        with self.lock:
+            if self._ext2int is not None:
+                return
+            if ext_ids is None:
+                ext_ids = np.arange(self.next_int, dtype=np.int64)
+            self._int2ext = ext_ids.copy()
+            # only LIVE rows get a forward mapping: ids deleted back in
+            # identity mode must stay deleted (to_internal → None), not
+            # be resurrected by the switch to explicit translation
+            self._ext2int = {int(e): i for i, e in enumerate(ext_ids)
+                             if i >= self._keep.shape[0] or self._keep[i]}
 
     @property
     def has_translation(self) -> bool:
@@ -223,12 +230,14 @@ class MutableState:
                         [self._int2ext, np.full(extra, -1, np.int64)])
                 self._int2ext[i] = int(e)
                 self._ext2int[int(e)] = i
-                grew |= self._side_append(v, i)
+                grew |= self._side_append_locked(v, i)
             grew |= self._filter_capacity_locked() != cap0
             self.seq += 1
             return self.side_used, grew
 
-    def _side_append(self, vec: np.ndarray, internal_id: int) -> bool:
+    def _side_append_locked(self, vec: np.ndarray, internal_id: int) -> bool:
+        # caller (upsert) holds self.lock — the *_locked contract GL010
+        # checks
         grew = False
         if self.side_vecs is None or self.side_used >= self.side_cap:
             new_cap = next_pow2(max(self.side_capacity_hint,
@@ -262,13 +271,28 @@ class MutableState:
         the entry lives, CPython cannot reuse that address for a new
         filter, so identity keying is safe. Bounded: stale-seq entries
         are evicted first, then oldest-inserted, so per-request filters
-        cannot grow device memory without bound."""
+        cannot grow device memory without bound.
+
+        ``build()`` runs OUTSIDE the lock (the GL012
+        device-work-under-lock class): the dispatcher calls this while
+        already holding the reentrant mutation lock for its consistency
+        pin — there the build still runs under that outer hold, seq
+        cannot advance, and behavior is unchanged — but a lock-free
+        caller (warmup) no longer stalls concurrent delete/upsert for
+        the device lowering. The entry is stored under the seq read
+        BEFORE the build, so a mutation landing mid-build leaves a
+        stale-keyed entry the next call rebuilds instead of serving."""
         with self.lock:
             hit = self._dev_cache.get(key)
             if hit is not None and hit[0] == self.seq:
                 return hit[1]
-            val = build()
-            self._dev_cache[key] = (self.seq, val, pin)
+            seq0 = self.seq
+        val = build()
+        with self.lock:
+            hit = self._dev_cache.get(key)
+            if hit is not None and hit[0] == self.seq:
+                return hit[1]          # a racer built it first — it wins
+            self._dev_cache[key] = (seq0, val, pin)
             if len(self._dev_cache) > self._DEV_CACHE_MAX:
                 stale = [k for k, v in self._dev_cache.items()
                          if v[0] != self.seq]

@@ -19,6 +19,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from raft_tpu import obs
+from raft_tpu.analysis import lockwatch
 
 
 class Generation:
@@ -32,7 +33,7 @@ class Generation:
     """
 
     __slots__ = ("name", "version", "handle", "drained", "_refs",
-                 "_retired", "_lock", "_on_drain")
+                 "_retired", "_draining", "_lock", "_on_drain")
 
     def __init__(self, name: str, version: int, handle):
         self.name = name
@@ -41,7 +42,9 @@ class Generation:
         self.drained = threading.Event()
         self._refs = 0
         self._retired = False
-        self._lock = threading.Lock()
+        self._draining = False
+        # graft-race sanitizer node "serve.generation"
+        self._lock = lockwatch.make_lock("serve.generation")
         self._on_drain: List[Callable[["Generation"], None]] = []
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
@@ -82,21 +85,37 @@ class Generation:
 
     def add_on_drain(self, cb: Callable[["Generation"], None]) -> None:
         with self._lock:
-            if not self.drained.is_set():
+            # _draining, not drained: _drain captures the list ONCE
+            # (under this lock) and only sets the event after the
+            # callbacks ran — a cb appended in that window would sit in
+            # _on_drain forever (for the fabric: _retire_cluster never
+            # fires and every worker pins the retired shards)
+            if not self._draining:
                 self._on_drain.append(cb)
                 return
-        # already drained: invoke OUTSIDE the lock, matching _drain's
-        # contract — a callback touching release()/retire() would
-        # deadlock on the non-reentrant lock otherwise
+        # drain already in flight (or done): invoke OUTSIDE the lock,
+        # matching _drain's contract — a callback touching
+        # release()/retire() would deadlock on the non-reentrant lock
+        # otherwise
         cb(self)
 
     def _drain(self) -> None:
         obs.counter("serve.generations_drained", index=self.name)
         obs.event("generation_drained", index=self.name,
                   version=self.version)
-        for cb in list(self._on_drain):
+        # capture-and-clear under the lock (GL010: _on_drain is
+        # lock-guarded state — a concurrent add_on_drain racing an
+        # unlocked clear() could drop its callback); _draining flips in
+        # the SAME hold, so a late add_on_drain self-invokes instead of
+        # appending to a list nobody will read again. The callbacks
+        # themselves still run outside the lock, per add_on_drain's
+        # contract.
+        with self._lock:
+            self._draining = True
+            cbs = list(self._on_drain)
+            self._on_drain.clear()
+        for cb in cbs:
             cb(self)
-        self._on_drain.clear()
         # the handle holds the device arrays; dropping the reference here
         # is what actually returns the old generation's HBM once callers
         # holding pins are gone
@@ -115,7 +134,8 @@ class Registry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # graft-race sanitizer node "serve.registry"
+        self._lock = lockwatch.make_lock("serve.registry")
         self._current: Dict[str, Generation] = {}
         self._versions: Dict[str, int] = {}
         self._live: List[Generation] = []   # published, not yet drained

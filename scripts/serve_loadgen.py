@@ -243,10 +243,15 @@ def main() -> int:
         f.write("\n")
     if args.obs_snapshot:
         obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
-    print(json.dumps({k: report[k] for k in
-                      ("throughput_qps", "completed", "rejected",
-                       "latency_ms")}), flush=True)
-    print(f"wrote {args.out}", flush=True)
+    # every printed number names its artifact + capture date (the GL005
+    # stale-claim contract: a QPS quoted from this output is citable as
+    # "<qps> QPS (<date>, <artifact>)" without further archaeology)
+    print(json.dumps({**{k: report[k] for k in
+                         ("throughput_qps", "completed", "rejected",
+                          "latency_ms")},
+                      "artifact": args.out, "date": report["date"]}),
+          flush=True)
+    print(f"wrote {args.out} (measured {report['date']})", flush=True)
     return 0
 
 
@@ -366,10 +371,14 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
         f.write("\n")
     if args.obs_snapshot:
         obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
-    print(json.dumps({k: report[k] for k in
-                      ("throughput_qps", "completed", "coverage",
-                       "hedges", "dropouts", "latency_ms")}), flush=True)
-    print(f"wrote {args.out}", flush=True)
+    # artifact + date ride the summary line (GL005 contract — see the
+    # single-process leg)
+    print(json.dumps({**{k: report[k] for k in
+                         ("throughput_qps", "completed", "coverage",
+                          "hedges", "dropouts", "latency_ms")},
+                      "artifact": args.out, "date": report["date"]}),
+          flush=True)
+    print(f"wrote {args.out} (measured {report['date']})", flush=True)
     return 0
 
 

@@ -22,15 +22,20 @@ import numpy as np
 import pytest
 
 from raft_tpu import serve, tuning
+from raft_tpu.analysis import lockwatch
 from raft_tpu.comms import procgroup
 from raft_tpu.resilience import ShardDropoutError, faultinject
 from raft_tpu.serve import fabric as fabmod
 
-pytestmark = pytest.mark.multihost
+pytestmark = [pytest.mark.multihost, pytest.mark.threadsan]
 
 
 @pytest.fixture(autouse=True)
-def _clean():
+def _clean(monkeypatch):
+    # ISSUE 7: the fabric suite runs with SANITIZED locks (router,
+    # health breakers, worker groups) — every run doubles as the
+    # zero-inversion / zero-hold-budget-breach acceptance
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
     faultinject.clear()
     tuning.reload()
     yield
@@ -366,3 +371,82 @@ def test_fabric_chaos_acceptance_multiprocess():
     assert counters.get("swaps", 0) == 2      # initial load + mid-run
     assert counters.get("swap_aborts", 0) == 0
     assert health == {0: "closed", 1: "closed", 2: "closed"}
+
+
+# ---------------------------------------------------------------------------
+# graft-race regressions (ISSUE 7): the call-vs-kill lost-future race
+# ---------------------------------------------------------------------------
+
+
+class _KillingCounter:
+    """Deterministic interleave seam: ``call()`` draws its request id
+    from this counter BETWEEN its aliveness decision and the future's
+    registration in the old code — firing ``kill()`` here reproduces
+    exactly the window where the drain ran before the registration and
+    the future was never resolved (its caller hung to the deadline)."""
+
+    def __init__(self, group, rank):
+        self.group = group
+        self.rank = rank
+        self.fired = False
+        self.n = 1000
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.fired:
+            self.fired = True
+            self.group.kill(self.rank)
+        self.n += 1
+        return self.n
+
+
+def test_localgroup_call_racing_kill_never_hangs_future():
+    """Register-or-reject must be atomic against the kill drain: a
+    future created by a call that razor-raced kill() is either rejected
+    immediately or drained by _fail_pending — never left forever
+    pending (the pre-fix behavior, which hung the router to its RPC
+    deadline)."""
+    group = procgroup.LocalGroup(2)
+    try:
+        group._req_ids = _KillingCounter(group, 0)
+        fut = group.call(0, "ping", {})
+        # resolved IMMEDIATELY — no waiting on a worker that will never
+        # answer
+        assert fut.done()
+        with pytest.raises(Exception, match="not alive|killed"):
+            fut.result(timeout=0)
+        # the untouched worker still answers
+        assert group.call(1, "ping", {}).result(timeout=10)["rank"] == 1
+    finally:
+        group.close()
+
+
+def test_procgroup_fail_pending_blocks_later_calls():
+    """The _ProcWorker.dead_reason seam: once a worker's futures were
+    drained, a racing call() must see the verdict under the same lock
+    and fail fast instead of registering into the void. (LocalGroup's
+    spawn-free twin exercises the same contract above; here we pin the
+    parent-side bookkeeping without paying a process spawn.)"""
+    from concurrent.futures import Future
+
+    w = procgroup._ProcWorker(0, None, None, None)
+    assert w.dead_reason is None
+    f1: Future = Future()
+    with w.lock:
+        w.pending[1] = f1
+    # the drain marks the worker dead and fails everything registered
+    pg = procgroup.ProcGroup.__new__(procgroup.ProcGroup)
+    pg._fail_pending(w, "worker 0 killed")
+    assert w.dead_reason == "worker 0 killed"
+    assert f1.done() and f1.exception() is not None
+    assert w.pending == {}
+
+
+def test_fabric_threadsan_suite_verdict_zzz():
+    """Suite-level ISSUE-7 acceptance (runs last in file order): the
+    fabric tier's observed lock order stayed acyclic under sanitized
+    locks, with zero hold-budget breaches."""
+    s = lockwatch.stats()
+    assert s["inversions"] == 0 and s["budget_breaches"] == 0, s

@@ -12,6 +12,8 @@ import pytest
 
 from raft_tpu.analysis.cli import main as cli_main
 from raft_tpu.analysis.lint import lint_paths, lint_source
+from raft_tpu.analysis.races import lint_paths as race_lint_paths
+from raft_tpu.analysis.races import lint_source as race_lint_source
 from raft_tpu.analysis.rules import RULES
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -541,6 +543,443 @@ def test_gl009_serve_module_function_positive():
             return rebuild(name, dataset)
     """)
     assert "GL009" in rules
+
+
+# ---------------------------------------------------------------------------
+# graft-race engine: GL010-GL014 (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _race_rules(src, only=None):
+    findings = race_lint_source(textwrap.dedent(src), "fixture.py")
+    open_f = [f for f in findings if not f.suppressed]
+    if only:
+        open_f = [f for f in open_f if f.rule == only]
+    return [f.rule for f in open_f], open_f
+
+
+def test_gl010_thread_reachable_read_positive():
+    rules, fs = _race_rules("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self._t = threading.Thread(target=self._loop, daemon=True)
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def _loop(self):
+                while True:
+                    if self._items:
+                        return self._items
+    """, only="GL010")
+    assert rules, fs
+
+
+def test_gl010_unlocked_write_positive():
+    rules, _ = _race_rules("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                self._n = 0
+    """, only="GL010")
+    assert rules == ["GL010"]
+
+
+def test_gl010_guarded_by_annotation_positive():
+    """An explicit annotation marks the attr guarded even when no
+    locked write site exists for the inference to see."""
+    rules, _ = _race_rules("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0          #: guarded-by(_lock)
+
+            def reset(self):
+                self._n = 0
+    """, only="GL010")
+    assert rules == ["GL010"]
+
+
+def test_gl010_negatives():
+    """Under the lock, in __init__, in a *_locked caller-holds method,
+    or via a Condition aliased to the lock: all clean."""
+    rules, fs = _race_rules("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._n = 0
+                self._t = threading.Thread(target=self._loop, daemon=True)
+
+            def _loop(self):
+                with self._cond:
+                    self._n += 1
+                    self._drain_locked()
+
+            def _drain_locked(self):
+                self._n = 0
+    """, only="GL010")
+    assert rules == [], fs
+
+
+def test_gl010_receiver_helper_object_positive():
+    """The w.pending-under-w.lock inference: an access to a helper
+    object's guarded attr outside its lock is flagged module-wide."""
+    rules, _ = _race_rules("""
+        import threading
+
+        class _W:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.pending = {}
+
+        class Group:
+            def __init__(self):
+                self._t = threading.Thread(target=self._recv, daemon=True)
+
+            def _recv(self):
+                w = self._w
+                with w.lock:
+                    w.pending.pop(1, None)
+
+            def fail(self):
+                w = self._w
+                w.pending.clear()
+    """, only="GL010")
+    assert rules == ["GL010"]
+
+
+def test_gl010_suppressed_with_reason():
+    rules, _ = _race_rules("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                self._n = 0  # graft-lint: allow-unguarded-shared-state single-writer init path by construction
+    """, only="GL010")
+    assert rules == []
+
+
+def test_gl011_event_check_then_act_positive():
+    """The PR-5 compact() single-flight class: Event is_set then set
+    with no lock at all."""
+    rules, _ = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._busy = threading.Event()
+
+            def compact(self):
+                if not self._busy.is_set():
+                    self._busy.set()
+                    return True
+                return False
+    """, only="GL011")
+    assert rules == ["GL011"]
+
+
+def test_gl011_cross_region_positive():
+    rules, _ = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+
+            def claim(self, k):
+                with self._lock:
+                    free = k not in self._jobs
+                if free:
+                    with self._lock:
+                        self._jobs[k] = 1
+    """, only="GL011")
+    assert rules == ["GL011"]
+
+
+def test_gl011_negatives():
+    """Same critical section, a real test-and-set, and the
+    double-checked idiom (fresh re-check in the act's region) are all
+    clean."""
+    rules, fs = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.compacting = threading.Lock()
+                self._jobs = {}
+                self._cache = {}
+
+            def same_region(self, k):
+                with self._lock:
+                    if k not in self._jobs:
+                        self._jobs[k] = 1
+
+            def test_and_set(self):
+                if not self.compacting.acquire(blocking=False):
+                    return None
+                return 1
+
+            def double_checked(self, k, build):
+                with self._lock:
+                    if k in self._cache:
+                        return self._cache[k]
+                val = build()
+                with self._lock:
+                    if k in self._cache:
+                        return self._cache[k]
+                    self._cache[k] = val
+                return val
+    """, only="GL011")
+    assert rules == [], fs
+
+
+def test_gl012_device_work_under_lock_positive():
+    rules, _ = _race_rules("""
+        import threading, jax
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self, x):
+                with self._lock:
+                    self._dev = jax.device_put(x)
+    """, only="GL012")
+    assert rules == ["GL012"]
+
+
+def test_gl012_build_helper_and_sync_positive():
+    rules, _ = _race_rules("""
+        import threading
+        from raft_tpu.neighbors import brute_force
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def rebuild(self, rows, out):
+                with self._lock:
+                    self._idx = brute_force.build(rows)
+                    out.block_until_ready()
+    """, only="GL012")
+    assert rules.count("GL012") == 2
+
+
+def test_gl012_snapshot_then_compute_negative():
+    rules, fs = _race_rules("""
+        import threading, jax
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self, x):
+                with self._lock:
+                    snap = self._rows
+                dev = jax.device_put(snap)
+                with self._lock:
+                    self._dev = dev
+    """, only="GL012")
+    assert rules == [], fs
+
+
+def test_gl013_opposite_nesting_positive_names_cycle():
+    rules, fs = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, only="GL013")
+    assert rules == ["GL013"]
+    assert "C._a" in fs[0].message and "C._b" in fs[0].message
+
+
+def test_gl013_one_hop_call_positive():
+    """`with a:` calling a method that takes b, vs `with b:` nested a."""
+    rules, _ = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, only="GL013")
+    assert rules == ["GL013"]
+
+
+def test_gl013_consistent_order_negative():
+    rules, _ = _race_rules("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a, self._b:
+                    pass
+    """, only="GL013")
+    assert rules == []
+
+
+def test_gl014_fire_and_forget_positive():
+    rules, _ = _race_rules("""
+        import threading
+
+        def go(fn):
+            threading.Thread(target=fn).start()
+    """, only="GL014")
+    assert rules == ["GL014"]
+
+
+def test_gl014_daemon_and_joined_negative():
+    rules, _ = _race_rules("""
+        import threading
+
+        def ok(fn):
+            threading.Thread(target=fn, daemon=True).start()
+
+        def ok2(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """, only="GL014")
+    assert rules == []
+
+
+# races engine CLI: the ISSUE-7 planted-bug acceptance seeds
+
+
+@pytest.mark.parametrize("seed, rule", [
+    # planted unguarded write
+    ("import threading\n\n\nclass Q:\n"
+     "    def __init__(self):\n"
+     "        self._lock = threading.Lock()\n"
+     "        self._n = 0\n\n"
+     "    def bump(self):\n"
+     "        with self._lock:\n"
+     "            self._n += 1\n\n"
+     "    def reset(self):\n"
+     "        self._n = 0\n", "GL010"),
+    # planted check-then-act
+    ("import threading\n\n\nclass C:\n"
+     "    def __init__(self):\n"
+     "        self._lock = threading.Lock()\n"
+     "        self._busy = threading.Event()\n\n"
+     "    def compact(self):\n"
+     "        if not self._busy.is_set():\n"
+     "            self._busy.set()\n", "GL011"),
+    # planted device work under lock
+    ("import threading, jax\n\n\nclass C:\n"
+     "    def __init__(self):\n"
+     "        self._lock = threading.Lock()\n\n"
+     "    def refresh(self, x):\n"
+     "        with self._lock:\n"
+     "            self._dev = jax.device_put(x)\n", "GL012"),
+])
+def test_cli_races_acceptance_seeds(tmp_path, capsys, seed, rule):
+    """ISSUE 7 acceptance: each planted concurrency hazard exits 1
+    naming its rule under --engine=races."""
+    (tmp_path / "seeded.py").write_text(seed)
+    rc = cli_main(["--engine=races", "--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == rule for f in out["findings"]), out
+
+
+def test_cli_engine_comma_list(tmp_path, capsys):
+    """--engine=both,races runs all three engines; unknown tokens are a
+    usage error (rc 2)."""
+    good = tmp_path / "good.py"
+    good.write_text("X = 1\n")
+    rc = cli_main(["--engine=ast,races", "--format=json", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 0
+    assert cli_main(["--engine=nope", str(tmp_path)]) == 2
+
+
+# the tier-1 gate, races half (the ~7s full-tree pass is shared by the
+# two gate assertions instead of run twice)
+
+
+@pytest.fixture(scope="module")
+def race_gate_findings():
+    return race_lint_paths([PKG])
+
+
+@pytest.mark.static_analysis
+def test_gate_tree_is_race_lint_clean(race_gate_findings):
+    open_f = [f for f in race_gate_findings if not f.suppressed]
+    assert not open_f, "unsuppressed graft-race findings:\n" + "\n".join(
+        f.render() for f in open_f)
+
+
+@pytest.mark.static_analysis
+def test_gate_race_suppressions_all_have_reasons(race_gate_findings):
+    for f in race_gate_findings:
+        if f.suppressed:
+            assert f.reason and f.reason != "(no reason given)", f.render()
 
 
 # ---------------------------------------------------------------------------

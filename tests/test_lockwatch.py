@@ -1,0 +1,232 @@
+"""graft-race engine 2 (dynamic) tests: the RAFT_TPU_THREADSAN lock
+sanitizer (ISSUE 7).
+
+Covers: the planted lock-order inversion (raises with the cycle path
+named — the ISSUE acceptance), hold-time budget breaches, RLock
+reentrancy (no self-edge, outermost-hold timing), Condition integration
+over both wrapper kinds, cross-thread release (the compacting-flag
+handoff shape), the off-mode plain-primitive fast path, and the
+failure dump through graft-scope."""
+
+import threading
+import time
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.analysis import lockwatch
+
+pytestmark = pytest.mark.threadsan
+
+
+@pytest.fixture(autouse=True)
+def _sanitized(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    monkeypatch.delenv(lockwatch.BUDGET_ENV_VAR, raising=False)
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+def test_off_mode_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_VAR, raising=False)
+    assert not isinstance(lockwatch.make_lock("x"), lockwatch.SanLock)
+    assert not isinstance(lockwatch.make_rlock("x"), lockwatch.SanRLock)
+
+
+def test_planted_inversion_raises_with_cycle_path():
+    """The ISSUE acceptance: an observed order inversion raises, and
+    the error names the full cycle path."""
+    a = lockwatch.make_lock("hier.A")
+    b = lockwatch.make_lock("hier.B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockwatch.LockOrderInversion) as ei:
+        with b:
+            with a:
+                pass
+    assert ei.value.cycle == ["hier.A", "hier.B", "hier.A"]
+    assert "hier.A -> hier.B -> hier.A" in str(ei.value)
+    assert lockwatch.stats()["inversions"] == 1
+    # the failing acquisition was unwound: both locks acquirable again
+    with a:
+        with b:
+            pass
+
+
+def test_three_lock_cycle_detected():
+    a = lockwatch.make_lock("tri.A")
+    b = lockwatch.make_lock("tri.B")
+    c = lockwatch.make_lock("tri.C")
+    with a, b:
+        pass
+    with b, c:
+        pass
+    with pytest.raises(lockwatch.LockOrderInversion) as ei:
+        with c, a:
+            pass
+    assert ei.value.cycle[0] == ei.value.cycle[-1]
+    assert set(ei.value.cycle) == {"tri.A", "tri.B", "tri.C"}
+
+
+def test_same_name_distinct_instances_flagged():
+    """Two same-named locks nested (two MutableStates) have no
+    intra-class tiebreak: AB/BA-prone, flagged immediately."""
+    a1 = lockwatch.make_lock("same.X")
+    a2 = lockwatch.make_lock("same.X")
+    with pytest.raises(lockwatch.LockOrderInversion):
+        with a1:
+            with a2:
+                pass
+
+
+def test_consistent_order_is_silent():
+    a = lockwatch.make_lock("ok.A")
+    b = lockwatch.make_lock("ok.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockwatch.stats()["inversions"] == 0
+    assert lockwatch.order_graph()["ok.A"].keys() == {"ok.B"}
+
+
+def test_rlock_reentrancy_no_self_edge():
+    r = lockwatch.make_rlock("re.R")
+    with r:
+        with r:
+            with r:
+                pass
+    assert lockwatch.stats()["inversions"] == 0
+    # one logical acquisition recorded, not three
+    assert lockwatch.stats()["acquires"] == 1
+
+
+def test_hold_budget_breach_raises(monkeypatch):
+    monkeypatch.setenv(lockwatch.BUDGET_ENV_VAR, "10")
+    lk = lockwatch.make_lock("budget.L")
+    with pytest.raises(lockwatch.HoldBudgetExceeded) as ei:
+        with lk:
+            time.sleep(0.05)
+    assert ei.value.lock_name == "budget.L"
+    assert ei.value.held_ms > 10
+    assert lockwatch.stats()["budget_breaches"] == 1
+    # the lock itself was released before the raise
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_rlock_budget_spans_outermost_hold(monkeypatch):
+    monkeypatch.setenv(lockwatch.BUDGET_ENV_VAR, "10")
+    r = lockwatch.make_rlock("budget.R")
+    with pytest.raises(lockwatch.HoldBudgetExceeded):
+        with r:
+            with r:        # inner release must NOT end the hold window
+                pass
+            time.sleep(0.05)
+
+
+def test_condition_over_sanitized_lock_roundtrip():
+    lk = lockwatch.make_lock("cond.L")
+    cond = lockwatch.make_condition(lk)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5)
+    assert hits == [1]
+    assert lockwatch.stats()["inversions"] == 0
+
+
+def test_condition_over_sanitized_rlock_roundtrip():
+    r = lockwatch.make_rlock("cond.R")
+    cond = threading.Condition(r)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5)
+    assert hits == [1]
+
+
+def test_cross_thread_release_clears_acquirer_held_set():
+    """The compacting-flag handoff: thread A try-acquires, thread B
+    releases. A's held-set must not keep a phantom entry that turns
+    A's next acquisition into a false inversion."""
+    flag = lockwatch.SanLock("handoff.flag")
+    other = lockwatch.make_lock("handoff.other")
+    assert flag.acquire(blocking=False)
+
+    t = threading.Thread(target=flag.release, daemon=True)
+    t.start()
+    t.join(timeout=5)
+
+    # were the phantom still held, this would record handoff.flag ->
+    # handoff.other and a later reverse nesting would invert; more
+    # directly, the held-set must be empty now:
+    with other:
+        pass
+    g = lockwatch.order_graph()
+    assert "handoff.flag" not in g
+
+
+def test_flag_lock_is_exempt():
+    """make_flag_lock returns a plain Lock even when sanitizing: a
+    try-acquire-only handoff flag cannot deadlock."""
+    flag = lockwatch.make_flag_lock("serve.compacting")
+    assert isinstance(flag, type(threading.Lock()))
+
+
+def test_failure_dump_reaches_obs(monkeypatch, tmp_path):
+    """On inversion the acquisition graph rides through graft-scope:
+    lockwatch.failures counter, the lockwatch_failure breadcrumb WITH
+    the graph attached, and (in flight mode) an automatic ring dump.
+    The breadcrumb content is asserted explicitly — an exception inside
+    the best-effort dump path is swallowed by design, so only a
+    content check proves the plumbing actually ran."""
+    import json
+
+    monkeypatch.setenv(obs.DIR_VAR, str(tmp_path))
+    obs.set_mode("flight")
+    try:
+        obs.reset()
+        a = lockwatch.make_lock("dump.A")
+        b = lockwatch.make_lock("dump.B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockwatch.LockOrderInversion):
+            with b:
+                with a:
+                    pass
+        snap = obs.snapshot(runtime_gauges=False)
+        pts = snap["metrics"]["lockwatch.failures"]["points"]
+        assert any(p["labels"].get("kind") == "inversion" for p in pts)
+        dump = obs.last_dump_path()
+        assert dump is not None, "flight mode must auto-dump the ring"
+        lines = [json.loads(line) for line in open(dump)]
+        evt = [e for e in lines if e.get("event") == "lockwatch_failure"]
+        assert evt, lines
+        assert evt[0]["failure"] == "inversion"
+        assert evt[0]["cycle"] == "dump.A -> dump.B -> dump.A"
+        assert "dump.A" in evt[0]["order_graph"]
+    finally:
+        obs.reset()
+        obs.set_mode("off")

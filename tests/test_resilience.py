@@ -875,3 +875,49 @@ def test_batch_iterator_live_shrink_and_start_row():
     it2 = BatchLoadIterator(x, 8, start_row=8)
     assert [off for off, _ in it2] == [8, 16]
     assert len(it2) == 2
+
+
+# ---------------------------------------------------------------------------
+# graft-race regression (ISSUE 7): one-shot spec consumption discipline
+# ---------------------------------------------------------------------------
+
+
+def test_faultinject_one_shot_exact_under_concurrency():
+    """A `*K` spec fires exactly K times across racing consumers: plan
+    resolution and the `remaining` decrement share ONE critical
+    section (the old fetch-then-relock consumed off a detached list)."""
+    from raft_tpu.resilience import faultinject
+
+    faultinject.install("slow@proc:0*5")
+    try:
+        hits = []
+        barrier = threading.Barrier(8)
+
+        def consume():
+            barrier.wait()
+            for _ in range(4):
+                if faultinject.proc_action(0) == "slow":
+                    hits.append(1)
+
+        ts = [threading.Thread(target=consume, daemon=True)
+              for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(hits) == 5, len(hits)
+    finally:
+        faultinject.clear()
+
+
+def test_faultinject_clear_wins_over_stale_plan():
+    """After clear(), a consumer must see the LIVE (empty) plan — not a
+    list it fetched before the swap."""
+    from raft_tpu.resilience import faultinject
+
+    faultinject.install("dead@proc:0*1")
+    faultinject.clear()
+    assert faultinject.proc_action(0) is None
+    faultinject.install("drop@rpc:search*1")
+    faultinject.install(None)
+    assert not faultinject.rpc_dropped("search")
