@@ -80,6 +80,23 @@ def _sift_like(n, d, seed=0, intrinsic=16):
 from raft_tpu.bench.harness import scan_qps_time  # noqa: E402
 
 
+def _emit_roofline(results, stub, *, bytes_moved, flops, seconds,
+                   rows=None):
+    """Roofline columns next to each QPS number (ROADMAP item 1): the
+    op's cost model (ideal HBM bytes + FLOPs as implemented) against
+    the measured seconds, scored vs the backend peak spec
+    (raft_tpu.bench.harness.PEAK_SPECS; methodology docs/kernels.md).
+    ``rows`` = dataset rows scanned per timed iteration, for the
+    bytes_per_row column (the quantization ladder's figure of merit)."""
+    from raft_tpu.bench.harness import roofline
+
+    r = roofline(bytes_moved, flops, seconds)
+    results[f"{stub}_roofline"] = r
+    results[f"{stub}_peak_fraction"] = r["peak_fraction"]
+    if rows:
+        results[f"{stub}_bytes_per_row"] = round(bytes_moved / rows, 2)
+
+
 def _median_s(results, key_stub, timer, n_draws=5):
     """Variance-honest timing: run ``timer()`` (one scan-chained
     two-point measurement = one draw) ``n_draws`` times, record EVERY
@@ -103,6 +120,16 @@ def bench_bruteforce_sift10k(results):
     s = _median_s(results, "bruteforce_sift10k", lambda: scan_qps_time(
         lambda qq, ix: brute_force.search(ix, qq, k), q, operands=index))
     results["bruteforce_sift10k_qps"] = round(nq / s, 1)
+    from raft_tpu.distance.types import DistanceType, pair_flops
+
+    # cost model: one full dataset stream + query/output traffic per
+    # batch; the fused kernel's whole point is that the [nq, n] distance
+    # matrix is NOT in this byte count (it never reaches HBM)
+    _emit_roofline(
+        results, "bruteforce_sift10k",
+        bytes_moved=n * d * 4 + nq * d * 4 + nq * k * 8,
+        flops=nq * n * pair_flops(DistanceType.L2Expanded, d),
+        seconds=s, rows=n)
 
 
 def bench_pairwise(results):
@@ -119,6 +146,10 @@ def bench_pairwise(results):
     bytes_moved = n * d * 4 * 2 + n * n * 4
     results["pairwise_l2_gbps"] = round(bytes_moved / s / 1e9, 1)
     results["pairwise_l2_gflops"] = round(2 * n * n * d / s / 1e9, 1)
+    # pairwise MATERIALIZES its output, so the n*n*4 write dominates the
+    # byte model — the bandwidth-bound contrast to the fused search ops
+    _emit_roofline(results, "pairwise_l2", bytes_moved=bytes_moved,
+                   flops=2 * n * n * d, seconds=s, rows=n)
 
 
 def bench_ivfflat_sift1m(results):
@@ -144,6 +175,18 @@ def bench_ivfflat_sift1m(results):
         lambda qq, ix: ivf_flat.search(sp, ix, qq, k), q, operands=index))
     results["ivfflat_sift1m_qps"] = round(nq / s, 1)
     results["ivfflat_recall"] = round(float(recall), 3)
+    from raft_tpu.distance.types import DistanceType, pair_flops
+
+    # cost model: coarse centers GEMM + probed-list block streams
+    # (storage row f32 + stored id + precomputed norm per row)
+    cap = int(index.storage.shape[1])
+    rows = nq * sp.n_probes * cap
+    pf = pair_flops(DistanceType.L2Expanded, d)
+    _emit_roofline(
+        results, "ivfflat_sift1m",
+        bytes_moved=rows * (d * 4 + 4 + 4) + nq * d * 4,
+        flops=rows * pf + nq * index.n_lists * pf,
+        seconds=s, rows=rows)
 
 
 def bench_cagra_sift1m(results):
@@ -171,6 +214,18 @@ def bench_cagra_sift1m(results):
         lambda qq, ix: cagra.search(sp, ix, qq, k), q, operands=index))
     results["cagra_sift1m_qps"] = round(nq / s, 1)
     results["cagra_recall"] = round(float(recall), 3)
+    from raft_tpu.distance.types import DistanceType, pair_flops
+
+    # cost model: seeds + per-iteration beam expansion (graph row of 32
+    # neighbor ids + each neighbor's vector) — a graph walk's traffic is
+    # gather-shaped, so this is the IDEAL byte floor, not a stream
+    deg = int(index.graph.shape[1])
+    visited = nq * (sp.n_seeds + 15 * deg)
+    _emit_roofline(
+        results, "cagra_sift1m",
+        bytes_moved=visited * (d * 4 + 4) + nq * 15 * deg * 4,
+        flops=visited * pair_flops(DistanceType.L2Expanded, d),
+        seconds=s, rows=visited)
 
 
 def bench_ivfpq_deep10m(results):
@@ -222,6 +277,17 @@ def bench_ivfpq_deep10m(results):
         n1=n1, n2=n2, operands=index), n_draws=3)
     results["ivfpq_deep10m_qps"] = round(nq / s, 1)
     results["ivfpq_recall"] = round(float(recall), 3)
+    # cost model: probed lists stream pq codes (pq_dim * pq_bits/8
+    # bytes) + stored id per row, plus the coarse GEMM — the
+    # rows-per-HBM-byte ceiling the quantization ladder multiplies
+    cap_pq = int(index.indices.shape[1])
+    rows_pq = nq * sp.n_probes * cap_pq
+    code_bytes = 48 * 8 // 8            # pq48x8
+    _emit_roofline(
+        results, "ivfpq_deep10m",
+        bytes_moved=rows_pq * (code_bytes + 4) + nq * d * 4,
+        flops=rows_pq * 2 * int(index.rot_dim),
+        seconds=s, rows=rows_pq)
 
     # + exact refine (the reference's standard recall lever: its bench
     # runs IVF-PQ with refine_ratio, raft_ivf_pq_wrapper.h) — recall

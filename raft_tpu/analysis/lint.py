@@ -638,7 +638,10 @@ class FileLinter:
 
     def _check_unspanned_entries(self) -> None:
         """Public module-level ``search*``/``build*`` functions in
-        ``neighbors/`` modules — and, in ``serve/`` modules, public
+        ``neighbors/`` modules, public ``fused_*`` kernel entry points
+        in ``ops/`` modules (the Pallas hot paths — an unobserved
+        kernel dispatch is a blind spot exactly where compile/variant
+        attribution matters most) — and, in ``serve/`` modules, public
         functions AND class methods on the serving surface
         (:data:`_SERVE_ENTRY_PREFIXES`) — must open a graft-scope span
         (``obs.span`` / ``obs.entry_span`` — any call whose final dotted
@@ -647,10 +650,12 @@ class FileLinter:
         documents. Param-computation helpers suppress with a reason."""
         parts = Path(self.path).parts
         in_serve = "serve" in parts
-        if "neighbors" not in parts and not in_serve:
+        in_ops = "ops" in parts
+        if "neighbors" not in parts and not in_serve and not in_ops:
             return
-        prefixes = self._SERVE_ENTRY_PREFIXES if in_serve \
-            else ("search", "build")
+        prefixes = (self._SERVE_ENTRY_PREFIXES if in_serve
+                    else ("fused",) if in_ops
+                    else ("search", "build"))
         candidates = [n for n in self.tree.body
                       if isinstance(n, ast.FunctionDef)]
         if in_serve:
@@ -707,45 +712,61 @@ class FileLinter:
                            "dtype 'float64' requested: silently downcast on "
                            "device under disabled x64")
 
-    # -- GL006 BlockSpec ---------------------------------------------------
+    # -- GL006 BlockSpec / VMEM scratch ------------------------------------
+
+    _BLOCKSPEC_NAMES = ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec")
+    # VMEM scratch allocations are block-shaped too: an off-lane literal
+    # scratch forces the same relayout a bad BlockSpec does, and its
+    # bytes spend the same per-core budget (the fused kernels allocate
+    # decode scratch this way — ops/ivf_scan.py packed paths)
+    _VMEM_SCRATCH_NAMES = ("pltpu.VMEM", "tpu.VMEM")
 
     def _check_blockspec(self, node: ast.Call) -> None:
         fname = _dotted(node.func)
-        if fname not in ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec"):
+        if fname in self._BLOCKSPEC_NAMES:
+            kind = "BlockSpec"
+        elif fname in self._VMEM_SCRATCH_NAMES:
+            kind = "VMEM scratch"
+        else:
             return
         if not node.args:
             return
         dims = _const_int_tuple(node.args[0])
         if dims is None:
-            return  # symbolic block shape — the static screen cannot judge
+            return  # symbolic/expression-derived shape — the required
+            # form for tile budgets (docs/kernels.md §tile-geometry);
+            # the static screen cannot and need not judge it
         lits = [d for d in dims if d is not None]
         if not lits or len(dims) < 1:
             return
         last = dims[-1]
         if last is not None and last != 1 and last % _LANE_MULTIPLE != 0:
             self._emit("GL006", node,
-                       f"BlockSpec trailing dim {last} is not a multiple of "
+                       f"{kind} trailing dim {last} is not a multiple of "
                        f"{_LANE_MULTIPLE} (TPU lane width): forces relayout")
         if len(dims) >= 2:
             sub = dims[-2]
             if sub is not None and sub != 1 and sub % _SUBLANE_MULTIPLE != 0:
                 self._emit("GL006", node,
-                           f"BlockSpec sublane dim {sub} is not a multiple of "
+                           f"{kind} sublane dim {sub} is not a multiple of "
                            f"{_SUBLANE_MULTIPLE} (f32 tile; bf16 needs 16, "
                            "int8 32): forces relayout")
 
     def _check_vmem_budget(self, fn: ast.FunctionDef) -> None:
-        """Static VMEM estimate: sum of fully-literal BlockSpec blocks used
-        in this function, at 4 B/elem (f32 upper bound for this codebase's
-        kernels)."""
+        """Static VMEM estimate: sum of fully-literal BlockSpec blocks
+        AND literal pltpu.VMEM scratch shapes used in this function, at
+        4 B/elem (f32 upper bound for this codebase's kernels).
+        Expression-derived shapes (the fused kernels' tile budgets,
+        docs/kernels.md) are invisible to this screen by design — that
+        is the required idiom; only literal geometry is audited."""
         total = 0
         count = 0
         for sub in ast.walk(fn):
             dims = None
             if isinstance(sub, ast.Call):
                 fname = _dotted(sub.func)
-                if fname in ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec") \
-                        and sub.args:
+                if fname in (self._BLOCKSPEC_NAMES
+                             + self._VMEM_SCRATCH_NAMES) and sub.args:
                     dims = _const_int_tuple(sub.args[0])
             if not dims or any(d is None for d in dims):
                 continue
@@ -756,8 +777,9 @@ class FileLinter:
             count += 1
         if count and total > _VMEM_BUDGET_BYTES:
             self._emit("GL006", fn,
-                       f"{count} literal BlockSpecs in {fn.name}() total "
-                       f"~{total / 2**20:.1f} MiB of blocks, over the "
+                       f"{count} literal BlockSpec/VMEM blocks in "
+                       f"{fn.name}() total "
+                       f"~{total / 2**20:.1f} MiB, over the "
                        f"~{_VMEM_BUDGET_BYTES // 2**20} MiB VMEM budget")
 
     # -- GL005 undated perf claims ----------------------------------------

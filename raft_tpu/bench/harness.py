@@ -144,6 +144,69 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13,
     return per_iter
 
 
+# ---------------------------------------------------------------------------
+# Roofline (ROADMAP item 1: "fast as the hardware allows" as a NUMBER)
+# ---------------------------------------------------------------------------
+
+# Peak-throughput specs per dispatch backend (tuning.backend_name()),
+# captured 2026-08-04 (r6):
+# - tpu: TPU v5e (v5 lite, the axon chip) — 197 TFLOP/s bf16 MXU peak
+#   and 819 GB/s HBM per chip (public v5e spec sheet). f32-carried
+#   matmuls run multi-pass on the MXU, so bf16 peak is the honest
+#   denominator for the bf16-operand hot paths this repo ships.
+# - cpu: placeholder spec for the CI container (no public number for a
+#   fractional-socket slice). On CPU the roofline column DOCUMENTS THE
+#   HARNESS — the fractions are only meaningful relative to each other,
+#   never as a hardware claim (BENCH artifacts carry the backend name).
+PEAK_SPECS = {
+    "tpu": {"flops_peak": 197.0e12, "hbm_gbps": 819.0,
+            "source": "TPU v5e public spec, recorded 2026-08-04"},
+    "cpu": {"flops_peak": 1.0e11, "hbm_gbps": 25.0,
+            "source": "CI-host placeholder (harness documentation only),"
+                      " recorded 2026-08-04"},
+}
+
+
+def roofline(bytes_moved: float, flops: float, seconds: float,
+             backend: Optional[str] = None) -> dict:
+    """One roofline row: achieved GB/s + GFLOP/s against the backend's
+    peak spec, which ceiling binds, and the achieved fraction of that
+    ceiling (docs/kernels.md §roofline).
+
+    ``bytes_moved``/``flops`` are the op's COST MODEL (ideal HBM traffic
+    and arithmetic of the algorithm as implemented); ``seconds`` the
+    measured wall time. ``peak_fraction`` is achieved/peak on the
+    BINDING axis: ops whose arithmetic intensity (flops/byte) clears
+    the ridge point are scored against the FLOP/s peak, the rest
+    against HBM bandwidth — so 1.0 always means "the hardware can do no
+    better", which is exactly the ROADMAP's finish line."""
+    if backend is None:
+        from raft_tpu import tuning
+
+        backend = tuning.backend_name()
+    spec = PEAK_SPECS.get(backend, PEAK_SPECS["cpu"])
+    seconds = max(float(seconds), 1e-12)
+    gbps = bytes_moved / seconds / 1e9
+    gflops = flops / seconds / 1e9
+    intensity = flops / max(bytes_moved, 1.0)
+    ridge = spec["flops_peak"] / (spec["hbm_gbps"] * 1e9)
+    bound = "compute" if intensity >= ridge else "memory"
+    frac = (gflops * 1e9 / spec["flops_peak"] if bound == "compute"
+            else gbps / spec["hbm_gbps"])
+    return {
+        "backend": backend,
+        "bytes": int(bytes_moved),
+        "flops": int(flops),
+        "gbps": round(gbps, 2),
+        "gflops": round(gflops, 2),
+        "intensity_flops_per_byte": round(intensity, 3),
+        "ridge_flops_per_byte": round(ridge, 3),
+        "bound": bound,
+        "peak_fraction": round(frac, 4),
+        "peak_source": spec["source"],
+    }
+
+
 def probe_tpu(timeout_s: float = 120.0):
     """Subprocess probe for a live TPU-class backend (platform 'tpu' or
     'axon'). Returns (ok, detail). A subprocess because the known outage

@@ -85,6 +85,25 @@ def is_min_close(metric: DistanceType) -> bool:
     return metric != DistanceType.InnerProduct
 
 
+def pair_flops(metric: DistanceType, d: int) -> int:
+    """FLOPs to score ONE (query, row) pair at dimension ``d`` — the
+    numerator of the roofline column (docs/kernels.md §roofline). The
+    expanded metrics are one length-d MXU dot (2d) plus an O(1)
+    epilogue; the direct (non-expanded) forms pay the elementwise
+    difference on top. Used by bench.py's per-op roofline rows, so the
+    model is deliberately the ACHIEVED-algorithm count (expanded form
+    with precomputed norms), not the naive 3d subtraction form."""
+    d = int(d)
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        return 2 * d + 4          # dot + (qn + xn - 2ab, clamp)
+    if metric == DistanceType.InnerProduct:
+        return 2 * d
+    if metric == DistanceType.CosineExpanded:
+        return 2 * d + 5          # dot + norm product, divide, 1 - r
+    # direct forms (L2Unexpanded, L1, ...): diff + accumulate per dim
+    return 3 * d
+
+
 class KernelType(enum.IntEnum):
     LINEAR = 0
     POLYNOMIAL = 1

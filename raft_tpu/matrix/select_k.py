@@ -4,18 +4,27 @@ TPU-native analog of the reference's ``raft::matrix::select_k``
 (cpp/include/raft/matrix/select_k.cuh:81) whose CUDA backends are a radix
 11-bit histogram select and warp-level bitonic priority queues chosen by a
 learned heuristic (matrix/detail/select_k-inl.cuh:51-79). The dispatch
-here has two arms: XLA's ``lax.top_k`` (hardware sort unit — near-optimal
-for small k) and the exact tournament network ``_tournament_topk`` for
-large k at n >> k — the compacting radix-select analog, built on the
-reshape-bitonic networks with no gathers. Like the reference, the arm is
-chosen from MEASUREMENTS: ``dispatch_select_impl`` consults the
-per-backend dispatch table (``raft_tpu.tuning``) and falls back to the
-analytic crossover projection only where the table has no entry. The entry point also (a) maps
+here has three arms: XLA's ``lax.top_k`` (hardware sort unit —
+near-optimal for small k), the exact tournament network
+``_tournament_topk`` for large k at n >> k — the compacting radix-select
+analog, built on the reshape-bitonic networks with no gathers — and the
+hierarchical ``_hierarchical_topk`` (per-tile local top-K through the
+hardware sort unit, then a keep-smallest-K pair-merge tree; the in-VMEM
+reduction shape RAFT's warpsort runs per-warp before its cross-warp
+merge, matrix/detail/select_k-inl.cuh dispatch + select_warpsort.cuh).
+Like the reference, the arm is chosen from MEASUREMENTS:
+``dispatch_select_impl`` consults the per-backend dispatch table
+(``raft_tpu.tuning``) and falls back to the analytic crossover
+projection only where the table has no entry. The entry point also (a) maps
 select-min onto top_k by negation and (b) carries pass-through source
 indices (the reference's ``in_idx``). A two-pass histogram-threshold
 variant is kept as ``select_k_threshold`` for callers wanting that
 structure; the tournament supersedes it for dispatch (the histogram
 variant never compacts, so it cannot beat the hardware top_k).
+
+Design sheet for the hierarchical rung (tile sizing, merge-tree shape,
+tie/NaN contracts) and the roofline the selection work is measured
+against: docs/kernels.md.
 """
 
 from __future__ import annotations
@@ -43,7 +52,8 @@ def select_k(
     in_idx : optional [batch, n] source indices carried with the values
         (defaults to 0..n-1 per row).
     select_min : True → smallest-k (the reference's SelectMinK).
-    impl : "auto" (measured dispatch, below) | "top_k" | "tournament".
+    impl : "auto" (measured dispatch, below) | "top_k" | "tournament"
+        | "hierarchical".
 
     Returns (out_val [batch, k], out_idx [batch, k]).
     """
@@ -54,14 +64,16 @@ def select_k(
     batch, n = in_val.shape
     if not 0 < k <= n:
         raise ValueError(f"k={k} out of range for row length {n}")
-    if impl not in ("auto", "top_k", "tournament"):
+    if impl not in ("auto", "top_k", "tournament", "hierarchical"):
         raise ValueError(
-            f"impl must be 'auto' | 'top_k' | 'tournament', got {impl!r}")
+            "impl must be 'auto' | 'top_k' | 'tournament' | "
+            f"'hierarchical', got {impl!r}")
     if impl == "tournament" and not jnp.issubdtype(in_val.dtype,
                                                   jnp.floating):
         # the tournament's merge space is f32 — forcing it onto integers
         # would reintroduce the >2^24 ordering collapse the integer
-        # top_k path exists to avoid
+        # top_k path exists to avoid (the hierarchical rung carries
+        # integer keys in the integer domain and IS eligible)
         raise ValueError(
             f"impl='tournament' is float-only, got {in_val.dtype}")
     if impl == "auto":
@@ -73,6 +85,9 @@ def select_k(
     with obs.span("select_k", impl=impl, n=n, k=int(k), batch=batch):
         if impl == "tournament":
             vals, idxs = _tournament_topk(in_val, int(k), bool(select_min))
+        elif impl == "hierarchical":
+            vals, idxs = _hierarchical_topk(in_val, int(k),
+                                            bool(select_min))
         else:
             vals, idxs = _select_k(in_val, int(k), bool(select_min))
     if in_idx is not None:
@@ -103,7 +118,11 @@ def dispatch_select_impl(batch: int, n: int, k: int, dtype,
     merges, each round HALVING the data — the compaction the reference
     buys with multi-pass radix select, select_radix.cuh:231,546) once
     k > 256 and n >= 8K. The tournament is float-only (its pad/merge
-    space is f32).
+    space is f32); the hierarchical rung (per-tile hardware top-K +
+    keep-smallest-K merge tree, docs/kernels.md §hierarchical) is
+    eligible at every dtype — integer keys stay in the integer domain —
+    and is the analytic answer for large-k integer selects the
+    tournament cannot take.
 
     ``op`` lets callers with their own shape regime (merge_topk's
     wide-batch candidate pools) look up a dedicated table section with
@@ -114,9 +133,15 @@ def dispatch_select_impl(batch: int, n: int, k: int, dtype,
 
     floating = jnp.issubdtype(dtype, jnp.floating)
     candidates = ["top_k"] + (["tournament"] if floating else [])
+    K = 1 << (int(k) - 1).bit_length()
+    if n >= 4 * K:
+        # below 4 tiles of 2K the "tree" degenerates to one local top_k
+        # plus overhead — never a candidate there
+        candidates.append("hierarchical")
     if fallback is None:
-        K = 1 << (int(k) - 1).bit_length()
         fallback = ("tournament" if k > 256 and n >= 8 * K and floating
+                    else "hierarchical"
+                    if k > 256 and n >= 8 * K and "hierarchical" in candidates
                     else "top_k")
     return tuning.choose(
         op,
@@ -161,7 +186,10 @@ def _tournament_topk(in_val, k: int, select_min: bool):
 
     Output contract matches the top_k arm: values are returned in the
     input dtype, and in-data non-finite entries keep their real column
-    index (exactly like lax.top_k). The one divergence: STRUCTURAL pad
+    index (exactly like lax.top_k). NaN inputs are NOT supported (NaN
+    poisons the merge comparisons and surfaces first instead of last;
+    the library's sentinel-masking convention is ±inf, which behaves) —
+    the NaN-tolerant arms are top_k and hierarchical. The one divergence: STRUCTURAL pad
     slots (from rounding n up to the power-of-two block grid) carry
     index -1 — they can only reach the output when a row has fewer than
     k finite entries, where they tie with the row's own +/-inf entries
@@ -207,6 +235,109 @@ def _tournament_topk(in_val, k: int, select_min: bool):
     if not select_min:
         vals = -vals
     return vals.astype(in_val.dtype), idxs
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _hierarchical_topk(in_val, k: int, select_min: bool):
+    """Hierarchical in-fast-memory selection: per-tile local top-K
+    through the hardware sort unit, then a keep-smallest-K pair-merge
+    tree — the third dispatch rung (RAFT's warpsort shape: each warp
+    reduces its slice in registers, cross-warp merge finishes,
+    select_warpsort.cuh:100; here a tile is the "warp" and the merge is
+    the reshape-bitonic keep-smallest-K round).
+
+    Differs from the tournament on both ends: the LOCAL stage is
+    ``lax.top_k`` over an L-wide tile (L >> 2K — the hardware sort unit
+    compacts L -> K in one pass where the tournament pays a full
+    bitonic sort of every 2K block), and the MERGE tree works K-wide
+    blocks (half the tournament's 2K merge width). Costs one
+    take_along_axis gather per payload at the local stage — a
+    [m*B, K]-from-[m*B, L] row gather, which is exactly the trade the
+    dispatch table measures against the gather-free tournament.
+
+    Dtype-complete: integer keys stay in the integer domain (bitwise-NOT
+    order reversal — exact above 2^24 where an f32 cast collapses,
+    including INT_MIN), and the ORIGINAL values ride the merge as a
+    payload so no inverse mapping is ever applied to the output. NaNs
+    are quarantined to the worst KEY CLASS (+inf in min-space): selected
+    after every finite entry, tied with genuine worst-infinity entries
+    (column order breaks the tie), reported as NaN. Structural pad slots
+    carry index -1 — the library-wide no-candidate convention (same as
+    the tournament).
+    """
+    from raft_tpu.matrix.bitonic import merge_bitonic
+
+    m, n = in_val.shape
+    K = 1 << (int(k) - 1).bit_length()
+    # tile length: power of two, >= 2K so every tile can source a full
+    # output block, ~1K lanes so the local stage stays VMEM-resident
+    L = max(2 * K, 1024)
+    nt = -(-n // L)
+    B = 1 << (int(nt) - 1).bit_length()
+    floating = jnp.issubdtype(in_val.dtype, jnp.floating)
+    ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (m, n))
+    if floating:
+        keys = in_val.astype(jnp.float32)
+        keys = keys if select_min else -keys
+        keys = jnp.where(jnp.isnan(keys), jnp.inf, keys)
+        pad_key = jnp.inf
+        pad_val = jnp.asarray(
+            jnp.inf if select_min else -jnp.inf, in_val.dtype)
+        orig = in_val
+    else:
+        work = (in_val.astype(jnp.int32) if in_val.dtype == jnp.bool_
+                else in_val)
+        keys = work if select_min else ~work
+        # typed scalar: a bare python UINT_MAX overflows the weak int32
+        # promotion inside jnp.pad
+        pad_key = jnp.asarray(jnp.iinfo(keys.dtype).max, keys.dtype)
+        info = (None if in_val.dtype == jnp.bool_
+                else jnp.iinfo(in_val.dtype))
+        pad_val = jnp.asarray(
+            True if info is None and select_min
+            else False if info is None
+            else info.max if select_min else info.min, in_val.dtype)
+        orig = in_val
+    pad = B * L - n
+    if pad:
+        keys = jnp.pad(keys, ((0, 0), (0, pad)), constant_values=pad_key)
+        ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+        orig = jnp.pad(orig, ((0, 0), (0, pad)),
+                       constant_values=pad_val)
+    kb = keys.reshape(m * B, L)
+    # local top-K in min-key space: top_k selects LARGEST, so reverse
+    # the order inside the key domain (float negation is exact on the
+    # sanitized keys; integer bitwise-NOT is the exact reversing map)
+    if floating:
+        neg, pos = jax.lax.top_k(-kb, K)
+        kb = -neg
+    else:
+        inv, pos = jax.lax.top_k(~kb, K)
+        kb = ~inv
+    pos = pos.astype(jnp.int32)
+    ib = jnp.take_along_axis(ids.reshape(m * B, L), pos, axis=1)
+    vb = jnp.take_along_axis(orig.reshape(m * B, L), pos, axis=1)
+    kb = kb.reshape(m, B, K)
+    ib = ib.reshape(m, B, K)
+    vb = vb.reshape(m, B, K)
+    while B > 1:
+        B //= 2
+        u = kb[:, 0::2]
+        v = jnp.flip(kb[:, 1::2], axis=-1)           # descending partner
+        take_u = u <= v                              # ties keep the
+        lo = jnp.where(take_u, u, v)                 # earlier block (stable)
+        li = jnp.where(take_u, ib[:, 0::2],
+                       jnp.flip(ib[:, 1::2], axis=-1))
+        lv = jnp.where(take_u, vb[:, 0::2],
+                       jnp.flip(vb[:, 1::2], axis=-1))
+        lo, (li, lv) = merge_bitonic(
+            lo.reshape(m * B, K), li.reshape(m * B, K),
+            lv.reshape(m * B, K),
+        )
+        kb = lo.reshape(m, B, K)
+        ib = li.reshape(m, B, K)
+        vb = lv.reshape(m, B, K)
+    return vb[:, 0, :k], ib[:, 0, :k]
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))

@@ -84,6 +84,7 @@ def search(
     prefilter=None,
     tile_n: Optional[int] = None,
     fast: bool = False,
+    impl: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact k-NN search (reference brute_force-inl.cuh:156 ``knn``).
 
@@ -95,6 +96,15 @@ def search(
     PAPERS.md): candidate generation with bf16 MXU matmuls at ~4× the
     candidates, then exact fp32 re-ranking — recovers exact-search recall
     at bf16 throughput. Only affects L2/IP/cosine expanded metrics.
+
+    ``impl``: "auto" (measured dispatch through the ``fused_topk_tile``
+    table, docs/dispatch_tuning.md) | "scan" (the XLA lax.scan tiling)
+    | "fused_exact[:tile_n]" / "fused_fold[:tile_n]" (the fused Pallas
+    distance+partial-top-k kernel, ops/fused_topk.py; append
+    ":interpret" to run the kernel in interpret mode — the CPU parity
+    path). The fold variant is approximate per-tile (bounded loss,
+    docs/kernels.md) so "auto" only offers it to the ``fast`` two-phase
+    path, which already opted into approximate candidate generation.
     """
     queries = jnp.asarray(queries)
     n = index.size
@@ -135,6 +145,10 @@ def search(
                 float(index.metric_arg),
                 int(min(tile_n, n)),
                 oor,
+                _resolve_bf_impl(
+                    impl, int(queries.shape[0]), n, int(index.dim),
+                    int(k_cand), index.metric,
+                    filtered=filter_bits is not None, approx_ok=True),
             )
             # candidates at the sentinel distance are padding or
             # prefiltered-out rows; mark them invalid so refine (which runs
@@ -154,12 +168,65 @@ def search(
             float(index.metric_arg),
             int(min(tile_n, n)),
             oor,
+            _resolve_bf_impl(
+                impl, int(queries.shape[0]), n, int(index.dim), int(k),
+                index.metric, filtered=filter_bits is not None,
+                approx_ok=False),
         )
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9))
+def _resolve_bf_impl(requested: str, m: int, n: int, d: int, k: int,
+                     metric: DistanceType, filtered: bool,
+                     approx_ok: bool) -> str:
+    """Pick the brute-force scan backend through the per-backend
+    dispatch table (``tuning.choose("fused_topk_tile", ...)``,
+    docs/dispatch_tuning.md). The fused Pallas kernel is only a
+    candidate on TPU, unfiltered, for the expanded metrics, and within
+    its extraction budgets (exact k <= 128, fold k <= 256); the fold
+    arm additionally requires the caller to have opted into approximate
+    candidate generation (``approx_ok`` — the ``fast`` path). Candidate
+    names carry the row-tile so a live-chip capture run picks the tile
+    geometry too; the analytic fallback tiles from
+    :func:`raft_tpu.ops.fused_topk.tile_geometry`'s VMEM budget math."""
+    if requested != "auto":
+        return requested
+    from raft_tpu import tuning
+    from raft_tpu.ops.fused_topk import tile_geometry
+
+    fused_metric = metric in (
+        DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+        DistanceType.CosineExpanded, DistanceType.InnerProduct,
+    )
+    on_tpu = tuning.backend_name() == "tpu"
+    fused_ok = on_tpu and fused_metric and not filtered
+    candidates = ["scan"]
+    if fused_ok:
+        tiles = (512, 1024, 2048)
+        if k <= 128:
+            candidates += [f"fused_exact:{t}" for t in tiles]
+        if approx_ok and k <= 256:
+            candidates += [f"fused_fold:{t}" for t in tiles]
+    if len(candidates) == 1:
+        return "scan"
+    variant = "fold" if approx_ok and k <= 256 else "exact"
+    # operand itemsize matches the caller: the fast path (approx_ok)
+    # searches bf16 operands, the exact path f32 — sizing the analytic
+    # tile for bf16 on an f32 search would undercount VMEM by 2x
+    geo_tn = tile_geometry(m, n, d, k, variant,
+                           itemsize=2 if approx_ok else 4)["tile_n"]
+    analytic = f"fused_{variant}:{geo_tn}"
+    if analytic not in candidates:
+        analytic = "scan"
+    return tuning.choose(
+        "fused_topk_tile",
+        {"m": int(m), "n": int(n), "d": int(d), "k": int(k)},
+        candidates, analytic,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p, tile_n,
-            out_of_range="drop"):
+            out_of_range="drop", impl="scan"):
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
     if queries.dtype == jnp.bfloat16:
@@ -173,6 +240,52 @@ def _search(queries, dataset, norms, filter_bits, filter_nbits, k, metric_val, p
     n, d = dataset.shape
     m = q.shape[0]
     sentinel = sentinel_for(metric, acc)
+
+    if impl.startswith("fused"):
+        # fused Pallas distance+partial-top-k (ops/fused_topk.py): the
+        # distance matrix never reaches HBM — per-tile candidates are
+        # reduced in-register off the MXU, then one hierarchical merge.
+        # The auto resolver only offers fused where these hold, but an
+        # EXPLICIT impl= request reaches here unvetted — re-check, or a
+        # forced fused search would silently drop its prefilter
+        from raft_tpu.ops.fused_topk import (
+            COSINE as _FT_COS,
+            IP as _FT_IP,
+            L2 as _FT_L2,
+            fused_topk as _fused_topk,
+        )
+
+        if filter_bits is not None:
+            raise ValueError(
+                "the fused brute-force kernel has no prefilter support; "
+                "use impl='scan' (or 'auto') for filtered searches")
+        _fused_mks = {DistanceType.L2Expanded: _FT_L2,
+                      DistanceType.L2SqrtExpanded: _FT_L2,
+                      DistanceType.CosineExpanded: _FT_COS,
+                      DistanceType.InnerProduct: _FT_IP}
+        if metric not in _fused_mks:
+            raise ValueError(
+                f"impl={impl!r} supports only the expanded "
+                f"L2/IP/cosine metrics, got {metric.name}")
+        parts = impl.split(":")
+        variant = parts[0][len("fused_"):]
+        ftile = next((int(t) for t in parts[1:] if t.isdigit()), None)
+        interpret = "interpret" in parts
+        mk = _fused_mks[metric]
+        xn = norms
+        if mk != _FT_IP and xn is None:
+            ds32 = dataset.astype(jnp.float32)
+            xn = jnp.sum(ds32 * ds32, axis=1)
+        out_d, out_i = _fused_topk(
+            q, dataset.astype(mm), k, metric_kind=mk, norms=xn,
+            variant=variant, tile_n=ftile, interpret=interpret,
+        )
+        if metric == DistanceType.InnerProduct:
+            out_d = -out_d                        # min-space -> score
+        elif metric == DistanceType.L2SqrtExpanded:
+            out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+        # rows short of k candidates: (+inf, -1) -> library sentinel
+        return jnp.where(out_i < 0, sentinel, out_d.astype(acc)), out_i
 
     if tile_n >= n:
         dists = _dist_block(q, dataset.astype(mm), metric, p, norms).astype(acc)

@@ -574,9 +574,11 @@ def _ivf_search(
         else:
             cand_d = out_d
         cand_d = jnp.where(jnp.isinf(out_d), sentinel, cand_d)
+        # candidate width comes off the kernel's output: the fold
+        # extraction arm emits its R*128 lane-stack buffer instead of kl
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
-            n_probes, kl, k, select_min, sentinel,
+            n_probes, int(cand_d.shape[2]), k, select_min, sentinel,
             approx=merge_recall_target < 1.0,
             recall_target=merge_recall_target,
         )

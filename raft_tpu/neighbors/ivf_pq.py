@@ -1833,9 +1833,10 @@ def _pq_search(
         else:
             cand_d = out_d
         cand_d = jnp.where(jnp.isinf(out_d), sentinel, cand_d)
+        # candidate width off the kernel output (fold arm emits R*128)
         out_d, out_i = unbucketize_merge(
             cand_d, cand_i, pair_bucket, pair_pos, order, total, m,
-            n_probes, kl, k, select_min, sentinel,
+            n_probes, int(cand_d.shape[2]), k, select_min, sentinel,
             approx=merge_recall_target < 1.0,
             recall_target=merge_recall_target,
         )

@@ -7,6 +7,7 @@ contractions with on-chip epilogues and k-selection so distances never
 round-trip through HBM.
 """
 
+from raft_tpu.ops.fused_topk import fused_topk
 from raft_tpu.ops.ivf_scan import fused_list_scan_topk
 
-__all__ = ["fused_list_scan_topk"]
+__all__ = ["fused_list_scan_topk", "fused_topk"]

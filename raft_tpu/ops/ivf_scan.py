@@ -140,6 +140,35 @@ def _extract_topk_binned_deep(dist, ids_row, k: int, cap: int,
         outi_ref[0, :, j] = jnp.where(jnp.isinf(m), _INVALID, idv)
 
 
+def _extract_fold(dist, ids_row, cap: int, outd_ref, outi_ref, R: int):
+    """Fused-reduction variant (TPU-KNN's PartialReduce): R-deep
+    per-lane stacks like ``_extract_topk_binned_deep``'s fold phase, but
+    the R*128 survivors are emitted UNEXTRACTED — no k-pass loop at all;
+    the final selection happens in the caller's exact cross-probe merge
+    (the hierarchical select_k rung's home turf). The fold core and the
+    R sizing live in ops.fused_topk (one home for both kernels). Loss
+    profile matches binned_deep's fold: a true top-k entry is lost only
+    when > R of the list's top-k share a lane."""
+    from raft_tpu.ops.fused_topk import fold_lane_stacks
+
+    G = dist.shape[0]
+    ids = jnp.broadcast_to(ids_row[None, :], (G, cap))
+    stack_d, stack_i = fold_lane_stacks(dist, ids, R)
+    for r in range(R):
+        outd_ref[0, :, r * 128:(r + 1) * 128] = stack_d[r]
+        outi_ref[0, :, r * 128:(r + 1) * 128] = jnp.where(
+            jnp.isinf(stack_d[r]), _INVALID, stack_i[r])
+
+
+def _fold_depth(k: int) -> int:
+    """Lane-stack depth R for the fold arm — delegates to the single
+    sizing rule in ops.fused_topk.fold_depth (R = ceil(k/64), floor 2;
+    rationale there)."""
+    from raft_tpu.ops.fused_topk import fold_depth
+
+    return fold_depth(k)
+
+
 def _scan_kernel(
     bl_ref, ls_ref, *refs,
     k: int, metric_kind: int, extract: str, has_norms: bool,
@@ -247,6 +276,9 @@ def _scan_kernel(
         _extract_topk_binned(dist, ids_row, k, cap, outd_ref, outi_ref)
     elif extract == "binned_deep":
         _extract_topk_binned_deep(dist, ids_row, k, cap, outd_ref, outi_ref)
+    elif extract == "fold":
+        _extract_fold(dist, ids_row, cap, outd_ref, outi_ref,
+                      _fold_depth(k))
     else:
         _extract_topk(dist, ids_row, k, outd_ref, outi_ref)
 
@@ -272,8 +304,13 @@ def fused_list_scan_topk(
     """Scan each bucket's list block against its query group and return the
     per-pair top-k in min-space.
 
-    Returns (out_d [nb, G, k] f32, out_i [nb, G, k] int32) where out_i
-    holds the stored *global ids* (resolved in-kernel). For IP the
+    Returns (out_d [nb, G, kc] f32, out_i [nb, G, kc] int32) where out_i
+    holds the stored *global ids* (resolved in-kernel). ``kc == k`` for
+    the extracting arms; the ``fold`` arm (fused partial reduction —
+    per-lane R-deep stacks emitted unextracted, TPU-KNN's PartialReduce)
+    returns the WIDER ``kc = R*128`` candidate buffer and defers
+    selection to the caller's exact cross-probe merge — callers must
+    read the candidate width off the returned shape. For IP the
     distances are negated scores — negate back after the merge. Invalid
     tail entries (list shorter than k after filtering) come back as
     (+inf, -1) — mask on either.
@@ -296,7 +333,9 @@ def fused_list_scan_topk(
     exactly (same codes, same codebook).
     """
     # Extraction variant: the exact k-pass min sweep vs the lane-binned
-    # approximations (k <= 64 single-slot, k <= 256 R-deep). Eligibility
+    # approximations (k <= 64 single-slot, k <= 256 R-deep) vs the fold
+    # arm (k <= 256, no in-kernel extraction at all — the R*128-wide
+    # candidate buffer goes to the caller's merge). Eligibility
     # is structural (approx opt-in, lane-aligned cap); within the
     # eligible set the winner comes from the per-backend dispatch table
     # ("ivf_scan_extract", captured by microbench.bench_scan_extract),
@@ -305,7 +344,7 @@ def fused_list_scan_topk(
     # the jit boundary, so the choice participates in the jit cache key
     # and mode/table changes take effect per call. An explicit
     # ``extract`` bypasses the table (the microbench forcing each arm).
-    from raft_tpu import tuning
+    from raft_tpu import obs, tuning
 
     cap = (storage.shape[2] if (packed_i4 or lut_weights is not None)
            else storage.shape[1])
@@ -315,6 +354,7 @@ def fused_list_scan_topk(
         eligible.append("binned")
     if binned_ok and k <= 256:
         eligible.append("binned_deep")
+        eligible.append("fold")
     if extract is None:
         analytic = ("binned" if binned_ok and k <= 64
                     else "binned_deep" if binned_ok and k <= 256
@@ -327,11 +367,15 @@ def fused_list_scan_topk(
     elif extract not in eligible:
         raise ValueError(
             f"extract={extract!r} not eligible here (allowed: {eligible})")
-    return _fused_list_scan_topk(
-        storage, indices, list_sizes, bucket_list, qv, qaux, norms, keep,
-        lut_weights, k=k, metric_kind=metric_kind, interpret=interpret,
-        packed_i4=packed_i4, extract=extract,
-    )
+    # trace-time span (the kernel runs under the callers' jits):
+    # attributes compile cost per extraction arm, silent when cached
+    with obs.span("fused_list_scan_topk", extract=extract, cap=int(cap),
+                  k=int(k), nb=int(bucket_list.shape[0])):
+        return _fused_list_scan_topk(
+            storage, indices, list_sizes, bucket_list, qv, qaux, norms,
+            keep, lut_weights, k=k, metric_kind=metric_kind,
+            interpret=interpret, packed_i4=packed_i4, extract=extract,
+        )
 
 
 @functools.partial(
@@ -406,6 +450,9 @@ def _fused_list_scan_topk(
         has_norms=has_norms, has_filter=has_filter, packed_i4=packed_i4,
         packed_pq4=packed_pq4,
     )
+    # candidate width: the extracting arms emit k columns; the fold arm
+    # emits its full R*128 lane-stack buffer (selection deferred)
+    kc = 128 * _fold_depth(k) if extract == "fold" else k
     out_d, out_i = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -413,8 +460,8 @@ def _fused_list_scan_topk(
             grid=(nb,),
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
-                pl.BlockSpec((1, G, k), lambda i, bl, ls: (i, 0, 0)),
+                pl.BlockSpec((1, G, kc), lambda i, bl, ls: (i, 0, 0)),
+                pl.BlockSpec((1, G, kc), lambda i, bl, ls: (i, 0, 0)),
             ],
             scratch_shapes=(
                 [pltpu.VMEM((d, cap), qv.dtype)] if packed_i4
@@ -423,8 +470,8 @@ def _fused_list_scan_topk(
             ),
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((nb, G, k), jnp.float32),
-            jax.ShapeDtypeStruct((nb, G, k), jnp.int32),
+            jax.ShapeDtypeStruct((nb, G, kc), jnp.float32),
+            jax.ShapeDtypeStruct((nb, G, kc), jnp.int32),
         ],
         interpret=interpret,
     )(bucket_list, list_sizes, *inputs)

@@ -7,18 +7,25 @@ grid of static shape keys and records the winners into a
 cpp/scripts/heuristics/select_k). Ops:
 
 ``select_k`` / ``merge_topk``
-    ``lax.top_k`` (hardware sort) vs the compacting tournament network,
-    at selection shapes (large n, moderate k) and merge shapes
-    (n = n_probes x kl candidate pools) respectively. Cheap — also run
-    inline by ``RAFT_TPU_TUNING=measure``.
+    ``lax.top_k`` (hardware sort) vs the compacting tournament network
+    vs the hierarchical tile/merge-tree rung, at selection shapes
+    (large n, moderate k) and merge shapes (n = n_probes x kl candidate
+    pools) respectively. Cheap — also run inline by
+    ``RAFT_TPU_TUNING=measure``.
 ``ivf_scan``
     end-to-end IVF-Flat search with the fused Pallas list-scan kernel vs
     the XLA bucketized scan (key: cap, k, approx).
 ``ivf_scan_extract``
     the kernel's in-kernel extraction arms raced head-to-head (exact
-    k-pass sweep vs lane-binned vs R-deep binned) by forcing each via
+    k-pass sweep vs lane-binned vs R-deep binned vs the unextracted
+    fold, charged with its deferred merge) by forcing each via
     ``fused_list_scan_topk(extract=...)``; TPU-only by default (the
     kernel's compile target).
+``fused_topk_tile``
+    brute-force backends end-to-end: XLA lax.scan tiling vs the fused
+    Pallas distance+partial-top-k kernel per (variant, row-tile) —
+    winners are brute_force impl strings, so tile geometry is adopted
+    from measurement with no code change.
 ``pq_scan``
     end-to-end IVF-PQ search per cache kind — i8 decoded residuals
     (1 MXU pass), packed-i4 raw residuals (1 pass, in-kernel nibble
@@ -72,12 +79,17 @@ def _rand(shape, dtype, seed=0):
 
 def select_candidates(key: Dict) -> List[str]:
     """Eligible select_k implementations at ``key`` (mirrors the
-    constraints in matrix/select_k.py): the tournament is float-only and
-    needs k <= n."""
+    constraints in matrix/select_k.py): the tournament is float-only
+    and needs k <= n; the hierarchical rung (every dtype) needs at
+    least 4 local tiles' worth of data to be a tree at all."""
     cands = ["top_k"]
     dtype = str(key.get("dtype", "float32"))
     if dtype.startswith(("float", "bfloat")):
         cands.append("tournament")
+    n, k = int(key.get("n", 0)), int(key.get("k", 1))
+    K = 1 << (max(k, 1) - 1).bit_length()
+    if n >= 4 * K:
+        cands.append("hierarchical")
     return cands
 
 
@@ -87,7 +99,11 @@ def bench_select(key: Dict, candidates: Optional[List[str]] = None,
     ({n, k, batch, dtype}); returns {candidate: median_ms}."""
     import jax.numpy as jnp
 
-    from raft_tpu.matrix.select_k import _select_k, _tournament_topk
+    from raft_tpu.matrix.select_k import (
+        _hierarchical_topk,
+        _select_k,
+        _tournament_topk,
+    )
 
     n = int(key["n"])
     k = int(key["k"])
@@ -102,6 +118,10 @@ def bench_select(key: Dict, candidates: Optional[List[str]] = None,
     if "tournament" in candidates:
         times["tournament"] = _median_ms(
             lambda: _tournament_topk(x, k, True), reps
+        )
+    if "hierarchical" in candidates:
+        times["hierarchical"] = _median_ms(
+            lambda: _hierarchical_topk(x, k, True), reps
         )
     return times
 
@@ -183,6 +203,7 @@ def bench_scan_extract(key: Dict, candidates: Optional[List[str]] = None,
                 candidates.append("binned")
             if k <= 256:
                 candidates.append("binned_deep")
+                candidates.append("fold")
     storage = _rand((C, cap, d), jnp.float32, seed=1)
     qv = _rand((nb, G, d), jnp.bfloat16, seed=2)
     import jax
@@ -195,16 +216,75 @@ def bench_scan_extract(key: Dict, candidates: Optional[List[str]] = None,
     norms = jnp.sum(storage.astype(jnp.float32) ** 2, axis=2)
     jax.block_until_ready((indices, qaux, norms))
     times: Dict[str, float] = {}
+    n_probes = int(key.get("n_probes", 8))
+
+    def run(arm):
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors.common import merge_topk
+
+        out_d, out_i = ivf_scan.fused_list_scan_topk(
+            storage, indices, sizes, buckets, qv, qaux, norms,
+            None, k=k, metric_kind=ivf_scan.L2,
+            approx=arm != "exact", interpret=interpret,
+            extract=arm,
+        )
+        # charge EVERY arm its downstream cross-probe merge at the real
+        # pool width (n_probes x candidate-width): fold's whole trade is
+        # a wider merge for zero extraction passes, so the race is only
+        # end-to-end honest when both sides pay their merge
+        kc = int(out_d.shape[2])
+        pool_d = jnp.tile(out_d.reshape(-1, kc), (1, n_probes))
+        pool_i = jnp.tile(out_i.reshape(-1, kc), (1, n_probes))
+        return merge_topk(pool_d, pool_i, k, True)
+
     for arm in candidates:
         try:
-            times[arm] = _median_ms(
-                lambda arm=arm: ivf_scan.fused_list_scan_topk(
-                    storage, indices, sizes, buckets, qv, qaux, norms,
-                    None, k=k, metric_kind=ivf_scan.L2,
-                    approx=arm != "exact", interpret=interpret,
-                    extract=arm,
-                ), reps)
+            times[arm] = _median_ms(lambda arm=arm: run(arm), reps)
         except Exception:  # noqa: BLE001 - arm unavailable on backend
+            continue
+    return times
+
+
+def bench_fused_topk(key: Dict, candidates: Optional[List[str]] = None,
+                     reps: int = _DEF_REPS,
+                     interpret: bool = False) -> Dict[str, float]:
+    """Race the brute-force scan backends at ``key`` ({m, n, d, k}):
+    the XLA lax.scan tiling ("scan") vs the fused Pallas
+    distance+partial-top-k kernel per (variant, row-tile) — candidate
+    names are brute_force's impl strings ("fused_exact:1024",
+    "fused_fold:2048", ...), so the captured winner IS the dispatch
+    answer and a live-chip capture adopts new tile geometry with no
+    code change. ``interpret`` appends ":interpret" to the fused
+    candidates (CPU debug-only numbers)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force
+
+    m = int(key.get("m", 512))
+    n = int(key.get("n", _SCAN_N))
+    d = int(key.get("d", _SCAN_D))
+    k = int(key.get("k", 10))
+    if candidates is None:
+        candidates = ["scan"]
+        tiles = (512, 1024, 2048)
+        if k <= 128:
+            candidates += [f"fused_exact:{t}" for t in tiles]
+        if k <= 256:
+            candidates += [f"fused_fold:{t}" for t in tiles]
+    data, queries = _scan_dataset(n=n, d=d, m=m)
+    index = brute_force.build(data, "sqeuclidean")
+    q = jnp.asarray(queries)
+    times: Dict[str, float] = {}
+    for impl in candidates:
+        arm = impl
+        if interpret and impl.startswith("fused"):
+            arm = impl + ":interpret"
+        try:
+            times[impl] = _median_ms(
+                lambda arm=arm: brute_force.search(index, q, k, impl=arm),
+                reps)
+        except Exception:  # noqa: BLE001 - impl unavailable on backend
             continue
     return times
 
@@ -326,6 +406,19 @@ def extract_grid(quick: bool = True) -> List[Dict]:
              "nb": 16} for k in ks]
 
 
+def fused_topk_grid(quick: bool = True) -> List[Dict]:
+    """(m, n, d, k) grid for the brute-force backend race — the
+    north-star bruteforce_sift10k shape's neighborhood plus the large-k
+    regime where the exact arm ages out."""
+    if quick:
+        return [{"m": 512, "n": 20_000, "d": 64, "k": 10},
+                {"m": 512, "n": 20_000, "d": 64, "k": 100}]
+    return [{"m": m, "n": n, "d": d, "k": k}
+            for n in (20_000, 100_000)
+            for (m, d) in ((512, 64), (2048, 128))
+            for k in (10, 100, 256)]
+
+
 def default_budgets() -> Dict[str, int]:
     """Measured-environment byte budgets. The CAGRA inline budget tracks
     the device HBM actually present (packed table + dataset + transients
@@ -371,7 +464,8 @@ def capture(backend: Optional[str] = None, quick: bool = True,
             print(msg, flush=True)
 
     want = set(ops) if ops else {"select_k", "merge_topk", "ivf_scan",
-                                 "pq_scan", "ivf_scan_extract"}
+                                 "pq_scan", "ivf_scan_extract",
+                                 "fused_topk_tile"}
     if "select_k" in want:
         for key in select_grid(quick):
             times = bench_select(key, reps=reps)
@@ -408,6 +502,17 @@ def capture(backend: Optional[str] = None, quick: bool = True,
             if times:
                 log(f"ivf_scan_extract {key} -> "
                     f"{t.record('ivf_scan_extract', key, times)} {times}")
+    # brute-force backend race (scan vs fused kernel per variant/tile):
+    # same TPU-only rule — fused candidates need the compile target, the
+    # CPU capture times only the scan arm unless --interpret
+    if "fused_topk_tile" in want:
+        for key in fused_topk_grid(quick):
+            cands = (None if on_tpu or include_interpret else ["scan"])
+            times = bench_fused_topk(key, cands, reps=reps,
+                                     interpret=not on_tpu)
+            if times:
+                log(f"fused_topk_tile {key} -> "
+                    f"{t.record('fused_topk_tile', key, times)} {times}")
     for name, val in default_budgets().items():
         t.set_budget(name, val)
     return t

@@ -4,10 +4,13 @@ reference generates with cpp/scripts/heuristics/select_k and bakes into
 matrix/detail/select_k-inl.cuh:51-79).
 
 Times the competing implementations behind every tuned hot-path
-dispatch — select_k / merge_topk (lax.top_k vs tournament), ivf_scan
-(fused Pallas kernel vs XLA bucketized scan), pq_scan (i8/i4/pq4 cache
-kinds) — over a shape grid, plus the environment byte budgets, and
-writes ``raft_tpu/tuning/tables/<backend>.json``. Consumers pick these
+dispatch — select_k / merge_topk (lax.top_k vs tournament vs
+hierarchical), ivf_scan (fused Pallas kernel vs XLA bucketized scan),
+ivf_scan_extract (in-kernel extraction arms incl. the unextracted
+fold), fused_topk_tile (brute-force scan vs fused kernel per
+variant/row-tile), pq_scan (i8/i4/pq4 cache kinds) — over a shape
+grid, plus the environment byte budgets, and writes
+``raft_tpu/tuning/tables/<backend>.json``. Consumers pick these
 winners up automatically through ``raft_tpu.tuning.choose`` (knob:
 ``RAFT_TPU_TUNING``; docs/dispatch_tuning.md).
 
@@ -41,8 +44,9 @@ def main(argv=None):
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--ops", default=None,
                     help="comma list: select_k,merge_topk,ivf_scan,"
-                         "pq_scan,ivf_scan_extract (extract arms need a "
-                         "TPU, or --interpret on CPU)")
+                         "pq_scan,ivf_scan_extract,fused_topk_tile "
+                         "(kernel arms need a TPU, or --interpret on "
+                         "CPU)")
     ap.add_argument("--interpret", action="store_true",
                     help="on CPU, also time the Pallas kernels in "
                          "interpret mode (debug-only numbers)")

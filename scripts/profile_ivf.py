@@ -106,9 +106,11 @@ def profile_ivf_flat(x, q, n_lists=1024, n_probes=64, k=10):
 
     @jax.jit
     def unb(out_d, out_i):
+        # candidate width off the kernel output — the fold extraction
+        # arm returns R*128-wide buffers instead of k
         return unbucketize_merge(
             out_d, out_i, pair_bucket, pair_pos, order, total, nq,
-            n_probes, k, k, True, sentinel)[1]
+            n_probes, int(out_d.shape[2]), k, True, sentinel)[1]
 
     t = timeit(lambda: unb(out_d, out_i), iters=5)
     print(f"[flat] unbucketize+merge: {t*1e3:.1f} ms", flush=True)

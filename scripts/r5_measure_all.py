@@ -155,13 +155,28 @@ def main():
                 "rc": r.returncode, "s": round(time.time() - t0, 1),
                 "tail": (out + err)[-2000:],
             }
-            # bench.py prints its JSON line to stdout — persist it
+            # bench.py prints its JSON line to stdout — persist it, and
+            # thread its roofline columns (peak_fraction / bytes_per_row
+            # per op, docs/kernels.md §roofline) into the stage summary
+            # so the battery's status file answers "how close to the
+            # hardware ceiling" without opening the artifact
             if name == "bench" and r.returncode == 0:
                 last = [ln for ln in out.splitlines() if ln.startswith("{")]
                 if last:
                     with open(os.path.join(ROOT, "BENCH_r05_local.json"),
                               "w") as f:
                         f.write(last[-1] + "\n")
+                    try:
+                        extra = json.loads(last[-1]).get("extra", {})
+                        status["stages"][name]["roofline"] = {
+                            kk: vv.get("value", vv)
+                            if isinstance(vv, dict) else vv
+                            for kk, vv in extra.items()
+                            if kk.endswith(("_peak_fraction",
+                                            "_bytes_per_row"))
+                        }
+                    except (ValueError, KeyError):
+                        pass
             print(f"--- {name}: rc={r.returncode} "
                   f"{round(time.time() - t0, 1)}s", flush=True)
             print((out + err)[-1500:], flush=True)

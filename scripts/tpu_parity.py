@@ -7,11 +7,16 @@ CI exercises the Pallas kernels in interpret mode on CPU
 closes the remaining gap by running the SAME parity assertions against
 the real Mosaic-compiled kernels on the TPU:
 
-* ivf_scan.fused_list_scan_topk (exact + binned + binned-deep) vs the
-  XLA bucketized scan on identical inputs,
+* ivf_scan.fused_list_scan_topk (exact + binned + binned-deep + fold)
+  vs the XLA bucketized scan on identical inputs,
+* fused_topk.fused_topk (exact + fold brute-force kernel) vs the
+  hardware-top_k oracle (ids bitwise on the exact arm),
 * beam_step.beam_merge_step (scored + packed variants) vs the numpy
   merge oracle from tests/test_beam_step.py,
 * cagra pallas search vs the scattered XLA search (recall agreement).
+
+The CPU shadow of these assertions rides tier-1 as
+tests/test_pallas_parity.py (marker pallas_parity, interpret mode).
 
 Usage: python scripts/tpu_parity.py [out.json]
 """
@@ -89,6 +94,34 @@ def check_ivf_pq_scan(results):
     }
 
 
+def check_fused_topk(results):
+    from raft_tpu.ops.fused_topk import L2, fused_topk
+    from tests.oracles import naive_knn, eval_recall
+
+    rng = np.random.default_rng(9)
+    m, n, d, k = 512, 20_000, 64, 10
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    qn = (q ** 2).sum(1)
+    xn = (x ** 2).sum(1)
+    dist = np.maximum(qn[:, None] + xn[None, :] - 2.0 * (q @ x.T),
+                      0.0).astype(np.float32)
+    _, oracle = jax.lax.top_k(-jnp.asarray(dist), k)
+    oracle = np.asarray(oracle)
+    _, want = naive_knn(q, x, k)
+    out = {}
+    for variant in ("exact", "fold"):
+        _, oi = fused_topk(jnp.asarray(q), jnp.asarray(x), k,
+                           metric_kind=L2, variant=variant)
+        oi = np.asarray(oi)
+        out[f"id_agreement_{variant}"] = round(
+            float((oi == oracle).mean()), 4)
+        out[f"recall_{variant}"] = round(eval_recall(oi, want), 4)
+    out["ok"] = bool(out["id_agreement_exact"] > 0.999
+                     and out["recall_fold"] > 0.98)
+    results["fused_topk"] = out
+
+
 def check_beam_step(results):
     from tests.test_beam_step import _np_merge_oracle
     from raft_tpu.ops.beam_step import beam_merge_step
@@ -157,8 +190,8 @@ def main():
     t0 = time.time()
     results = {"platform": jax.devices()[0].platform,
                "device": str(jax.devices()[0])}
-    for fn in (check_ivf_scan, check_ivf_pq_scan, check_beam_step,
-               check_cagra):
+    for fn in (check_ivf_scan, check_ivf_pq_scan, check_fused_topk,
+               check_beam_step, check_cagra):
         try:
             fn(results)
         except Exception as e:  # noqa: BLE001 - record, keep going

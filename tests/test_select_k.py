@@ -153,6 +153,143 @@ def test_merge_topk_routes_large_k_through_tournament(monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+# ---------------------------------------------------------------------------
+# edge cases across all three dispatch rungs (ISSUE 9 satellite): the
+# hierarchical rung must honor every contract the top_k arm set
+# ---------------------------------------------------------------------------
+
+_IMPLS = ("top_k", "tournament", "hierarchical")
+
+
+def test_select_k_k_out_of_range(rng):
+    x = rng.standard_normal((2, 64)).astype(np.float32)
+    for bad_k in (0, -1, 65):
+        with pytest.raises(ValueError, match="out of range"):
+            select_k(x, bad_k)
+
+
+@pytest.mark.parametrize("impl", _IMPLS)
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_k_equals_n(rng, impl, select_min):
+    """k == n: every rung returns the full row, sorted best-first."""
+    x = rng.standard_normal((3, 96)).astype(np.float32)
+    v, i = select_k(x, 96, select_min=select_min, impl=impl)
+    want = np.sort(x, axis=1)
+    want = want if select_min else want[:, ::-1]
+    np.testing.assert_array_equal(np.asarray(v), want)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, np.asarray(i), axis=1), want)
+
+
+@pytest.mark.parametrize("impl", _IMPLS)
+def test_select_k_all_equal_ties_stable(impl):
+    """All-equal rows: ids come back as 0..k-1 in order (stable tie
+    break) on every rung — the compare-exchange networks must not swap
+    on equal keys and the merges must prefer the earlier block."""
+    x = np.zeros((2, 4096), np.float32)
+    v, i = select_k(x, 100, impl=impl)
+    np.testing.assert_array_equal(
+        np.asarray(i),
+        np.broadcast_to(np.arange(100, dtype=np.int32), (2, 100)))
+    assert (np.asarray(v) == 0).all()
+
+
+@pytest.mark.parametrize("impl", _IMPLS)
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_inf_rows(rng, impl, select_min):
+    """±inf entries are real candidates: best-infinity first, worst
+    last, real column ids kept (the sentinel-masking convention every
+    scan path relies on)."""
+    x = rng.standard_normal((2, 2048)).astype(np.float32)
+    x[:, 7] = -np.inf
+    x[:, 13] = np.inf
+    v, i = select_k(x, 2048, select_min=select_min, impl=impl)
+    v, i = np.asarray(v), np.asarray(i)
+    best, worst = (-np.inf, np.inf) if select_min else (np.inf, -np.inf)
+    best_col, worst_col = (7, 13) if select_min else (13, 7)
+    assert v[:, 0].tolist() == [best, best]
+    assert i[:, 0].tolist() == [best_col, best_col]
+    assert v[:, -1].tolist() == [worst, worst]
+    assert i[:, -1].tolist() == [worst_col, worst_col]
+
+
+@pytest.mark.parametrize("impl", ["top_k", "hierarchical"])
+def test_select_k_nan_rows_quarantined(rng, impl):
+    """NaN entries on the NaN-tolerant rungs (top_k, hierarchical —
+    the tournament documents NaN as unsupported): never selected before
+    a finite value, reported as NaN with their real column id."""
+    x = rng.standard_normal((2, 1024)).astype(np.float32)
+    x[:, 5] = np.nan
+    v, i = select_k(x, 1024, impl=impl)
+    v, i = np.asarray(v), np.asarray(i)
+    # every finite value precedes the NaN slot
+    nan_pos = np.argmax(np.isnan(v), axis=1)
+    assert (nan_pos >= 1023 - 1).all()        # last or tied with +inf
+    assert (i[np.isnan(v)] == 5).all()
+    # the finite prefix is exactly the sorted finite values
+    np.testing.assert_array_equal(
+        v[0, :1023], np.sort(x[0][~np.isnan(x[0])]))
+
+
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k_integer_hierarchical_exact_above_2p24(select_min):
+    """The PR-1 integer-domain contract survives the hierarchical rung:
+    values adjacent above 2^24 (and INT32_MIN) select exactly, in the
+    input dtype — the rung's keys and payloads never leave the integer
+    domain."""
+    base = 1 << 24
+    x = np.array(
+        [[base + 3, base + 1, base + 2, base, -base - 1, -base - 2,
+          -(2**31), 2**31 - 1, 0]], np.int32,
+    )
+    k = 4
+    v, i = select_k(jnp.asarray(x), k, select_min=select_min,
+                    impl="hierarchical")
+    v, i = np.asarray(v), np.asarray(i)
+    srt = np.sort(x, axis=1)
+    want = srt[:, :k] if select_min else srt[:, ::-1][:, :k]
+    np.testing.assert_array_equal(v, want)
+    np.testing.assert_array_equal(np.take_along_axis(x, i, axis=1), v)
+    assert v.dtype == x.dtype
+
+
+def test_select_k_unsigned_and_bool_hierarchical():
+    xu = np.array([[2**32 - 1, (1 << 24) + 1, (1 << 24) + 2, 7, 0]],
+                  np.uint32)
+    v, i = select_k(jnp.asarray(xu), 3, select_min=True,
+                    impl="hierarchical")
+    np.testing.assert_array_equal(np.asarray(v), [[0, 7, (1 << 24) + 1]])
+    assert np.asarray(v).dtype == xu.dtype
+    xb = np.array([[True, False, True, False]])
+    v, i = select_k(jnp.asarray(xb), 2, select_min=True,
+                    impl="hierarchical")
+    assert not np.asarray(v).any()
+
+
+def test_select_k_tournament_rejects_integers_still():
+    """The float-only guard on the tournament must survive the new
+    dispatch candidates (integers route to top_k/hierarchical)."""
+    x = np.arange(64, dtype=np.int32)[None]
+    with pytest.raises(ValueError, match="float-only"):
+        select_k(x, 4, impl="tournament")
+
+
+def test_dispatch_candidates_include_hierarchical(monkeypatch):
+    """dispatch_select_impl offers the hierarchical rung wherever the
+    tree has >= 4 tiles (floats AND integers), and the analytic
+    fallback routes large-k INTEGER selects — which the float-only
+    tournament cannot take — onto it."""
+    from raft_tpu import tuning
+    from raft_tpu.matrix.select_k import dispatch_select_impl
+
+    monkeypatch.setattr(tuning, "_mode_override", "off")
+    impl = dispatch_select_impl(4, 65536, 1024, np.dtype(np.int32))
+    assert impl == "hierarchical"
+    # float large-k keeps its measured/projected tournament route
+    impl = dispatch_select_impl(4, 65536, 1024, np.dtype(np.float32))
+    assert impl == "tournament"
+
+
 def test_select_k_in_idx_pad_slots_never_wrap():
     """Tournament pad slots (structural -1 positions from the
     power-of-two padding) must map to -1 through an in_idx mapping — an

@@ -54,7 +54,8 @@ def test_measure_persist_load_choose_round_trip(tmp_path):
 
     key = {"n": 2048, "k": 300, "batch": 4, "dtype": "float32"}
     times = microbench.bench_select(key, reps=2)
-    assert set(times) == {"top_k", "tournament"}
+    # all three rungs compete at this shape (n >= 4K tiles)
+    assert set(times) == {"top_k", "tournament", "hierarchical"}
     assert all(t > 0 for t in times.values())
 
     t = DispatchTable()
@@ -67,7 +68,8 @@ def test_measure_persist_load_choose_round_trip(tmp_path):
     assert loaded.lookup("select_k", key) == winner
 
     tuning.set_table_path(str(path))
-    got = tuning.choose("select_k", key, ["top_k", "tournament"],
+    got = tuning.choose("select_k", key,
+                        ["top_k", "tournament", "hierarchical"],
                         "analytic-fallback")
     assert got == winner
 
@@ -221,6 +223,47 @@ def test_resolve_scan_impl_consults_table(tmp_path):
     assert _resolve_scan_impl("xla", 512, 10) == "xla"
 
 
+def test_resolve_bf_impl_consults_table(tmp_path, monkeypatch):
+    """brute_force backend resolution (op fused_topk_tile): a measured
+    fused winner is honored only where the fused kernel is a candidate
+    (TPU, unfiltered, expanded metric) — on CPU the scan arm answers no
+    matter what the table says; on a (faked) TPU backend the winner's
+    variant:tile string comes straight through."""
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.neighbors.brute_force import _resolve_bf_impl
+
+    path = tmp_path / "t.json"
+    _write_table(path, "fused_topk_tile",
+                 [({"m": 512, "n": 20000, "d": 64, "k": 10},
+                   {"scan": 9.0, "fused_exact:1024": 1.0})])
+    tuning.set_table_path(str(path))
+    args = (512, 20000, 64, 10, DistanceType.L2Expanded)
+    # CPU: fused never a candidate
+    assert _resolve_bf_impl("auto", *args, filtered=False,
+                            approx_ok=False) == "scan"
+    # TPU: measured fused winner adopted, tile included
+    monkeypatch.setattr(tuning, "backend_name", lambda: "tpu")
+    assert _resolve_bf_impl("auto", *args, filtered=False,
+                            approx_ok=False) == "fused_exact:1024"
+    # filtered searches stay on the scan path (kernel has no filter)
+    assert _resolve_bf_impl("auto", *args, filtered=True,
+                            approx_ok=False) == "scan"
+    # explicit request always wins
+    assert _resolve_bf_impl("scan", *args, filtered=False,
+                            approx_ok=True) == "scan"
+
+
+def test_bench_fused_topk_scan_arm_runs_on_cpu():
+    """The fused_topk_tile microbench's scan arm runs end to end on CPU
+    (the arm the committed cpu.json captures); fused candidates are
+    interpret-gated and excluded here for time."""
+    from raft_tpu.tuning.microbench import bench_fused_topk
+
+    times = bench_fused_topk({"m": 16, "n": 512, "d": 16, "k": 5},
+                             ["scan"], reps=1)
+    assert set(times) == {"scan"} and times["scan"] > 0
+
+
 def test_pq_cache_kind_auto_consults_table(tmp_path):
     """cache_dtype='auto' stays fidelity-first (i8 whenever it fits —
     the table must NOT flip a recall-affecting rung), and consults the
@@ -353,5 +396,5 @@ def test_capture_emits_valid_loadable_table(tmp_path, monkeypatch):
     tuning.set_table_path(str(path))
     w = tuning.choose("select_k",
                       {"n": 1024, "k": 16, "batch": 2, "dtype": "float32"},
-                      ["top_k", "tournament"], "FB")
-    assert w in ("top_k", "tournament")
+                      ["top_k", "tournament", "hierarchical"], "FB")
+    assert w in ("top_k", "tournament", "hierarchical")
