@@ -1,10 +1,11 @@
 """raft_tpu.analysis — static + dynamic analysis for correctness hazards.
 
-Three engines, one rule set (see ``docs/static_analysis.md``):
+Four engines, one rule set (see ``docs/static_analysis.md``):
 
 * :mod:`raft_tpu.analysis.lint` — AST lint over package source
-  (GL001-GL006: host syncs, tracer branches, int->float ordering
-  casts, f64, undated perf claims, off-tile BlockSpecs).
+  (GL001-GL005, GL008-GL009: host syncs, tracer branches, int->float
+  ordering casts, f64, undated perf claims, unclassified swallows,
+  unspanned entries).
 * :mod:`raft_tpu.analysis.jaxpr_audit` — traces the registered public
   entry points on CPU and walks the jaxprs (GL001/GL003/GL004 with
   real dataflow, plus the GL007 recompile audit).
@@ -14,9 +15,17 @@ Three engines, one rule set (see ``docs/static_analysis.md``):
   threads); its dynamic complement is the ``RAFT_TPU_THREADSAN=1``
   lock-order sanitizer (:mod:`raft_tpu.analysis.lockwatch`) the
   serve/fabric/comms/core tiers construct their locks through.
+* :mod:`raft_tpu.analysis.kernels` — graft-kern: the Pallas kernel
+  verifier (GL006, GL015-GL018: computed VMEM accounting, index-map
+  bounds/tail masks, tile alignment, grid-revisit hazards, MXU dtype
+  audit) by abstract interpretation of every ``pl.pallas_call`` site
+  under the shape bindings its :mod:`~raft_tpu.analysis.contracts`
+  declare; its dynamic complement is the kernel-contract adversarial
+  sweep (``tests/test_kernel_contracts.py`` on CPU,
+  ``scripts/tpu_parity.py`` on chip).
 
 CLI: ``graft-lint`` (console script) or ``python scripts/graft_lint.py``;
-``--engine=both,races`` is the full static gate. The tier-1 gate tests
+``--engine=all`` is the full static gate. The tier-1 gate tests
 (``tests/test_graft_lint.py``) run every engine over ``raft_tpu/`` and
 fail on any unsuppressed finding — the JAX-port analog of the reference
 failing the build on an unvetted template instantiation
@@ -32,7 +41,13 @@ from raft_tpu.analysis.jaxpr_audit import (  # noqa: F401
     audit_select_k_recompiles,
     run_audit,
 )
+from raft_tpu.analysis import contracts  # noqa: F401
 from raft_tpu.analysis import lockwatch  # noqa: F401
+from raft_tpu.analysis.kernels import (  # noqa: F401
+    lint_file as kern_lint_file,
+    lint_paths as kern_lint_paths,
+    lint_source as kern_lint_source,
+)
 from raft_tpu.analysis.races import (  # noqa: F401
     lint_file as race_lint_file,
     lint_paths as race_lint_paths,

@@ -9,9 +9,9 @@
     graft-lint --format=json raft_tpu/    # machine-readable
     graft-lint --list-rules
 
-``--engine`` takes a comma list of ``ast`` / ``jaxpr`` / ``races``;
-``both`` keeps meaning ``ast,jaxpr`` (its pre-races spelling) and
-``all`` is every engine.
+``--engine`` takes a comma list of ``ast`` / ``jaxpr`` / ``races`` /
+``kern``; ``both`` keeps meaning ``ast,jaxpr`` (its pre-races spelling)
+and ``all`` is every engine.
 
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
 findings, 2 internal/usage error.
@@ -35,9 +35,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="files/directories to lint (default: raft_tpu/)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--engine", default="ast",
-                    help="comma list of ast|jaxpr|races (ast = source "
-                         "lint, fast; jaxpr = trace the entry-point "
-                         "registry; races = lock-discipline lint); "
+                    help="comma list of ast|jaxpr|races|kern (ast = "
+                         "source lint, fast; jaxpr = trace the "
+                         "entry-point registry; races = lock-discipline "
+                         "lint; kern = Pallas kernel verifier); "
                          "'both' = ast,jaxpr; 'all' = every engine")
     ap.add_argument("--rules", default=None,
                     help="comma list of rule ids to run (AST engine), "
@@ -65,12 +66,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if tok == "both":
             engines |= {"ast", "jaxpr"}
         elif tok == "all":
-            engines |= {"ast", "jaxpr", "races"}
-        elif tok in ("ast", "jaxpr", "races"):
+            engines |= {"ast", "jaxpr", "races", "kern"}
+        elif tok in ("ast", "jaxpr", "races", "kern"):
             engines.add(tok)
         elif tok:
-            print(f"unknown engine {tok!r} (want ast|jaxpr|races|both|"
-                  f"all, comma-separable)", file=sys.stderr)
+            print(f"unknown engine {tok!r} (want ast|jaxpr|races|kern|"
+                  f"both|all, comma-separable)", file=sys.stderr)
             return 2
     if not engines:
         engines = {"ast"}
@@ -106,6 +107,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             from raft_tpu.analysis.races import lint_paths as race_paths
 
             findings.extend(race_paths(paths, rules))
+        if "kern" in engines:
+            from raft_tpu.analysis.kernels import lint_paths as kern_paths
+
+            findings.extend(kern_paths(paths, rules))
         if "jaxpr" in engines:
             from raft_tpu.analysis.jaxpr_audit import run_audit
 

@@ -1,0 +1,425 @@
+"""Dynamic drivers for the kernel contracts (graft-kern's second half).
+
+Each driver materializes one adversarial case from a
+:class:`~raft_tpu.analysis.contracts.KernelContract` sweep, runs the
+kernel (interpret mode on CPU for tier-1; ``interpret=False`` for the
+on-chip rerun in ``scripts/tpu_parity.py``), and judges it against an
+XLA oracle built from the SAME arithmetic the kernel runs (dot_general
+with f32 accumulation — a BLAS matmul would sum in a different order
+and flip near-ties; learned in PR 8). Exact arms must match bitwise on
+ids; partial-reduction arms must stay inside the contract's recall
+band; every arm must honor the library-wide invalid-slot convention
+((+inf, -1) pairs, no id at or past the live row count).
+
+Cases marked ``static_only`` exist for the static engine's geometry
+bindings (e.g. the packed i4/pq4 scan storage layouts) and are skipped
+here — their dynamics are pinned by the dedicated ivf_pq / beam-step
+suites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class CaseReport:
+    ok: bool
+    kind: str          # "bitwise" | "recall" | "skipped" | "error"
+    detail: str = ""
+    recall: Optional[float] = None
+
+
+_SEED = 0xC0FFEE
+
+
+def _rng(case: dict):
+    import zlib
+
+    import numpy as np
+
+    # deterministic per-case seed so failures reproduce standalone —
+    # crc32 over the sorted repr, NOT hash(): str hashing is salted per
+    # process (PYTHONHASHSEED), which would regenerate different data
+    # on every rerun and make a CI/on-chip failure unreproducible
+    blob = repr(sorted((k, str(v)) for k, v in case.items())).encode()
+    return np.random.default_rng(_SEED + zlib.crc32(blob))
+
+
+def _recall(got_ids, want_ids) -> float:
+    import numpy as np
+
+    got = np.asarray(got_ids)
+    want = np.asarray(want_ids)
+    rows = got.reshape(-1, got.shape[-1])
+    wrows = want.reshape(-1, want.shape[-1])
+    hits = []
+    for g, w in zip(rows, wrows):
+        w = w[w >= 0]
+        if len(w) == 0:
+            continue
+        hits.append(len(np.intersect1d(g, w)) / len(w))
+    return float(sum(hits) / max(len(hits), 1))
+
+
+def _invalid_slots_ok(od, oi) -> Optional[str]:
+    """(+inf, -1) must pair up exactly (the library-wide convention)."""
+    import numpy as np
+
+    od = np.asarray(od)
+    oi = np.asarray(oi)
+    if not ((oi == -1) == np.isinf(od)).all():
+        return "invalid-slot contract broken: -1 ids and +inf distances " \
+               "do not pair up"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# fused_topk (brute-force distance + partial top-k)
+# ---------------------------------------------------------------------------
+
+
+def _bf_oracle(qj, xj, metric_kind, k):
+    """The kernel's own expanded-form arithmetic through XLA ops."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused_topk import COSINE, IP, L2
+
+    dots = jax.lax.dot_general(
+        qj, xj, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if metric_kind == IP:
+        dist = -dots
+    else:
+        q32 = qj.astype(jnp.float32)
+        x32 = xj.astype(jnp.float32)
+        xn = jnp.sum(x32 * x32, axis=1)
+        if metric_kind == L2:
+            qn = jnp.sum(q32 * q32, axis=1)
+            dist = jnp.maximum(qn[:, None] + xn[None, :] - 2.0 * dots, 0.0)
+        else:
+            assert metric_kind == COSINE
+            qa = jnp.linalg.norm(q32, axis=1)
+            xlen = jnp.sqrt(jnp.maximum(xn, 1e-30))
+            dist = 1.0 - dots / jnp.maximum(qa[:, None] * xlen[None, :],
+                                            1e-30)
+    negd, idx = jax.lax.top_k(-dist, k)
+    return -negd, idx
+
+
+def drive_fused_topk(contract, case: dict, interpret: bool = True
+                     ) -> CaseReport:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused_topk import fused_topk
+
+    if case.get("static_only"):
+        return CaseReport(True, "skipped", "static-only geometry case")
+    rng = _rng(case)
+    m, n, d, k = case["m"], case["n"], case["d"], case["k"]
+    variant = case["variant"]
+    mk = case.get("metric_kind", 0)
+    dtype = jnp.dtype(case.get("dtype", "float32"))
+    q = jnp.asarray(rng.standard_normal((m, d)).astype(np.float32), dtype)
+    x = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32), dtype)
+    want_d, want = _bf_oracle(q, x, mk, k)
+    want_d, want = np.asarray(want_d), np.asarray(want)
+    od, oi = fused_topk(q, x, k, metric_kind=mk, variant=variant,
+                        interpret=interpret)
+    od_np, oi_np = np.asarray(od), np.asarray(oi)
+    bad = _invalid_slots_ok(od, oi)
+    if bad:
+        return CaseReport(False, "error", bad)
+    if oi_np.max() >= n:
+        return CaseReport(False, "error",
+                          f"id {oi_np.max()} at or past row count {n} "
+                          "escaped the pad mask")
+    if variant == "exact":
+        if mk == 0 and dtype == jnp.float32:
+            # tie-free continuous keys: ids must agree bitwise (the
+            # pallas_parity contract). Distances are NOT compared
+            # bitwise — XLA vectorizes the padded-tile dot and the
+            # raw-oracle dot differently, so dots differ at ulp scale
+            # without any selection consequence.
+            if not (oi_np == want).all():
+                frac = float((oi_np != want).mean())
+                return CaseReport(False, "bitwise",
+                                  f"{frac:.1%} of ids differ from the "
+                                  "XLA oracle")
+            return CaseReport(True, "bitwise")
+        # bf16 / division-based metrics: ulp-scale epilogue differences
+        # can flip genuine near-ties, so judge distances numerically
+        # and ids as recall
+        valid = np.isfinite(want_d)
+        if not np.allclose(od_np[valid], want_d[valid],
+                           rtol=1e-4, atol=1e-5):
+            return CaseReport(False, "error",
+                              "top-k distances diverge from the oracle "
+                              "beyond ulp tolerance")
+        r = _recall(oi_np, want)
+        return CaseReport(r >= 0.99, "recall",
+                          f"recall {r:.4f} vs floor 0.99", recall=r)
+    r = _recall(oi_np, want)
+    floor = contract.recall_floor
+    return CaseReport(r >= floor, "recall",
+                      f"recall {r:.4f} vs floor {floor}", recall=r)
+
+
+# ---------------------------------------------------------------------------
+# ivf list scan
+# ---------------------------------------------------------------------------
+
+
+def drive_list_scan(contract, case: dict, interpret: bool = True
+                    ) -> CaseReport:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.common import merge_topk
+    from raft_tpu.ops import ivf_scan
+
+    if case.get("static_only"):
+        return CaseReport(True, "skipped", "static-only geometry case")
+    rng = _rng(case)
+    C, cap, d = case["C"], case["cap"], case["d"]
+    G, nb, k = case["G"], case["nb"], case["k"]
+    extract = case["extract"]
+    dtype = jnp.dtype(case.get("dtype", "float32"))
+    storage = rng.standard_normal((C, cap, d)).astype(np.float32)
+    ids = np.arange(C * cap, dtype=np.int32).reshape(C, cap)
+    buckets = (np.arange(nb, dtype=np.int32) % C)
+    qv = jnp.asarray(rng.standard_normal((nb, G, d)).astype(np.float32),
+                     dtype)
+    # two passes over the SAME shapes: full lists, then short lists
+    # (the live-size tail the extraction must mask) — no extra trace
+    for size in (cap, max(1, min(cap, k) if k < cap else cap // 2 + 1)):
+        sizes = np.full((C,), size, np.int32)
+        q32 = qv.astype(jnp.float32)
+        qaux = jnp.sum(q32 * q32, axis=2)
+        norms = jnp.asarray((storage ** 2).sum(2).astype(np.float32))
+        od, oi = ivf_scan.fused_list_scan_topk(
+            jnp.asarray(storage), jnp.asarray(ids), jnp.asarray(sizes),
+            jnp.asarray(buckets), qv, qaux, norms, None,
+            k=k, metric_kind=ivf_scan.L2,
+            approx=extract != "exact", interpret=interpret,
+            extract=extract)
+        if extract == "fold":
+            nb_, G_, kc = oi.shape
+            od2, oi2 = merge_topk(
+                jnp.asarray(od).reshape(nb_ * G_, kc),
+                jnp.asarray(oi).reshape(nb_ * G_, kc), min(k, kc), True)
+            od = np.asarray(od2).reshape(nb_, G_, -1)
+            oi = np.asarray(oi2).reshape(nb_, G_, -1)
+        od, oi = np.asarray(od), np.asarray(oi)
+        bad = _invalid_slots_ok(od, oi)
+        if bad:
+            return CaseReport(False, "error", f"size={size}: {bad}")
+        # oracle: the kernel's expanded arithmetic over the live rows
+        want = np.full((nb, G, k), -1, np.int64)
+        for b in range(nb):
+            blk = jnp.asarray(storage[buckets[b]], dtype)
+            dots = jax.lax.dot_general(
+                qv[b], blk, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            qn = np.asarray(qaux[b])
+            xn = np.asarray(norms[buckets[b]])
+            dist = np.maximum(qn[:, None] + xn[None, :]
+                              - 2.0 * np.asarray(dots), 0.0)
+            dist[:, size:] = np.inf
+            order = np.argsort(dist, axis=1, kind="stable")[:, :k]
+            w = ids[buckets[b]][order]
+            w[np.take_along_axis(dist, order, axis=1) == np.inf] = -1
+            want[b, :, :] = w
+        live = oi[oi >= 0]
+        if live.size and (live % cap >= size).any():
+            return CaseReport(
+                False, "error",
+                f"size={size}: a tombstoned/tail row id escaped the "
+                "live-size mask")
+        if extract == "exact" and dtype == jnp.float32:
+            if not (oi == want).all():
+                frac = float((oi != want).mean())
+                return CaseReport(False, "bitwise",
+                                  f"size={size}: {frac:.1%} of ids differ "
+                                  "from the XLA oracle")
+        else:
+            r = _recall(oi, want)
+            if r < contract.recall_floor:
+                return CaseReport(False, "recall",
+                                  f"size={size}: recall {r:.4f} under "
+                                  f"floor {contract.recall_floor}",
+                                  recall=r)
+    return CaseReport(True,
+                      "bitwise" if extract == "exact" else "recall")
+
+
+# ---------------------------------------------------------------------------
+# beam merge step
+# ---------------------------------------------------------------------------
+
+
+def drive_beam_step(contract, case: dict, interpret: bool = True
+                    ) -> CaseReport:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.beam_step import beam_merge_step
+
+    if case.get("static_only") or not case.get("scored", True):
+        return CaseReport(True, "skipped",
+                          "packed arm: static geometry here; dynamics "
+                          "pinned by test_beam_step/test_cagra")
+    rng = _rng(case)
+    L, C, m, width = case["L"], case["C"], case["m"], case["width"]
+    window = case.get("window", 2)
+    # distance == id: globally unique keys, ties only between duplicate
+    # ids (the windowed-dedup invariant, as in test_beam_step)
+    bi = rng.permutation(np.arange(0, 4 * (L + C) * m))[: L * m] \
+        .reshape(L, m).astype(np.int32)
+    be = (rng.random((L, m)) < 0.5).astype(np.int32)
+    ci = rng.permutation(
+        np.arange(4 * (L + C) * m, 8 * (L + C) * m))[: C * m] \
+        .reshape(C, m).astype(np.int32)
+    for c in range(m):
+        ndup = max(1, C // 4)
+        slots = rng.choice(C, size=ndup, replace=False)
+        rows = rng.choice(L, size=ndup, replace=False)
+        ci[slots, c] = bi[rows, c]
+    bd = bi.astype(np.float32)
+    cd = ci.astype(np.float32)
+    order = np.argsort(bd, axis=0, kind="stable")
+    bd = np.take_along_axis(bd, order, axis=0)
+    bi = np.take_along_axis(bi, order, axis=0)
+    be = np.take_along_axis(be, order, axis=0)
+
+    od, oi, oe, par = jax.jit(
+        lambda a, b, c, e, f: beam_merge_step(
+            a, b, c, cand_d=e, cand_i=f, width=width, window=window,
+            g=case.get("g", 128), interpret=interpret)
+    )(jnp.asarray(bd), jnp.asarray(bi), jnp.asarray(be),
+      jnp.asarray(cd), jnp.asarray(ci))
+
+    wd, wi, we, wpar = _np_beam_oracle(bd, bi, be, cd, ci, L, width,
+                                       window)
+    if not (np.asarray(oi) == wi).all():
+        return CaseReport(False, "bitwise", "merged ids differ from the "
+                                            "numpy oracle")
+    if not np.allclose(np.asarray(od), wd, rtol=1e-6):
+        return CaseReport(False, "bitwise", "merged distances differ")
+    if not (np.asarray(par) == wpar).all():
+        return CaseReport(False, "bitwise", "picked parents differ")
+    return CaseReport(True, "bitwise")
+
+
+def _np_beam_oracle(bd, bi, be, cd, ci, L, width, window=2):
+    """Numpy mirror of one beam merge step — THE single oracle home:
+    tests/test_beam_step.py imports it (as its ``_np_merge_oracle``)
+    and the contract sweep + tpu_parity's compiled rerun use it here,
+    so every beam assertion judges against the same semantics."""
+    import numpy as np
+
+    m = bd.shape[1]
+    LL = 1 << (L + cd.shape[0] - 1).bit_length()
+    od = np.full((L, m), np.inf, np.float32)
+    oi = np.full((L, m), -1, np.int32)
+    oe = np.ones((L, m), np.int32)
+    parents = np.full((width, m), -1, np.int32)
+    for c in range(m):
+        rows = list(zip(bd[:, c], bi[:, c], be[:, c])) + [
+            (cd[j, c], ci[j, c], 0) for j in range(cd.shape[0])
+        ]
+        rows += [(np.inf, -1, 1)] * (LL - len(rows))
+        rows.sort(key=lambda t: t[0])
+        dist = np.array([r[0] for r in rows], np.float32)
+        ids = np.array([r[1] for r in rows], np.int32)
+        expl = np.array([r[2] for r in rows], np.int32)
+        dup = np.zeros(LL, bool)
+        e = expl.copy()
+        for s in range(1, window + 1):
+            eq = (ids[s:] == ids[:-s]) & (ids[s:] >= 0)
+            dup[s:] |= eq
+            e[:-s] |= eq & (expl[s:] > 0)
+        dist = np.where(dup, np.inf, dist)
+        ids = np.where(dup, -1, ids)
+        e = np.where(dup, 1, e)
+        got = 0
+        for t in range(L):
+            od[t, c], oi[t, c], oe[t, c] = dist[t], ids[t], e[t]
+            if not e[t] and ids[t] >= 0 and np.isfinite(dist[t]) \
+                    and got < width:
+                parents[got, c] = ids[t]
+                oe[t, c] = 1
+                got += 1
+    return od, oi, oe, parents
+
+
+# ---------------------------------------------------------------------------
+# select_k rungs (hierarchical / tournament)
+# ---------------------------------------------------------------------------
+
+
+def drive_select_k(contract, case: dict, interpret: bool = True
+                   ) -> CaseReport:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu.matrix.select_k import select_k
+
+    if case.get("static_only"):
+        return CaseReport(True, "skipped", "static-only geometry case")
+    rng = _rng(case)
+    batch, n, k = case["batch"], case["n"], case["k"]
+    impl = case["impl"]
+    dtype = jnp.dtype(case.get("dtype", "float32"))
+    distinct = True
+    if dtype == jnp.bool_:
+        x = rng.random((batch, n)) < 0.5
+        distinct = False
+    elif jnp.issubdtype(dtype, jnp.integer):
+        # offset past 2^24: pins the integer-domain exactness the f32
+        # cast collapses (the ADVICE-r5 class)
+        base = np.stack([rng.permutation(n) for _ in range(batch)])
+        x = (base + (2**25 if jnp.dtype(dtype).itemsize >= 4 else 7)
+             ).astype(dtype)
+    else:
+        x = np.stack([rng.permutation(n) for _ in range(batch)]) \
+            .astype(np.float32)
+        if case.get("nan"):
+            x[x % 7 == 3] = np.nan
+            distinct = False
+        # graft-lint: allow-host-sync oracle harness materializes the dtype-rounded keys on host by design
+        x = np.asarray(jnp.asarray(x, dtype))
+        distinct = distinct and dtype == jnp.float32
+    xj = jnp.asarray(x, dtype)
+    for select_min in (True, False):
+        vals, idxs = select_k(xj, k, select_min=select_min, impl=impl)
+        # graft-lint: allow-f64 host-side numpy oracle comparison space (never reaches a device)
+        vals = np.asarray(vals).astype(np.float64)
+        idxs = np.asarray(idxs)
+        # graft-lint: allow-f64 host-side numpy oracle comparison space (never reaches a device)
+        xs = np.asarray(xj).astype(np.float64)
+        if case.get("nan"):
+            xs = np.where(np.isnan(xs), np.inf if select_min else -np.inf,
+                          xs)
+        order = np.argsort(xs if select_min else -xs, axis=1,
+                           kind="stable")[:, :k]
+        want_vals = np.take_along_axis(xs, order, axis=1)
+        got_vals = np.where(np.isnan(vals),
+                            np.inf if select_min else -np.inf, vals)
+        if not (np.sort(got_vals, axis=1)
+                == np.sort(want_vals, axis=1)).all():
+            return CaseReport(
+                False, "bitwise",
+                f"select_min={select_min}: selected value multiset "
+                "differs from the sort oracle")
+        if distinct and not (idxs == order).all():
+            return CaseReport(
+                False, "bitwise",
+                f"select_min={select_min}: ids differ from the stable "
+                "sort oracle on distinct keys")
+    return CaseReport(True, "bitwise")

@@ -1,0 +1,220 @@
+"""graft-kern kernel contracts: declared invariants + adversarial sweeps.
+
+Every hand-written Pallas kernel in ``ops/`` (and the kernel-shaped
+selection rungs of ``matrix/select_k.py``) registers a
+:class:`KernelContract` at import time declaring the invariants its
+padding/masking logic promises — which dims may carry a non-divisible
+tail (``tail_rows="masked"``), the supported ``k_range``, the dtypes it
+is exact (or recall-banded) over, and the symbolic shapes of its array
+arguments. The contract is consumed from BOTH sides of the gate, so the
+static engine and the dynamic sweep cross-check each other
+(docs/static_analysis.md §engine-4):
+
+* **statically** — :mod:`raft_tpu.analysis.kernels` evaluates each
+  ``pl.pallas_call`` site's block geometry/index maps/VMEM under the
+  contract's shape cases (GL006/GL015-GL018);
+* **dynamically** — ``tests/test_kernel_contracts.py`` (marker
+  ``kernel_contract``, tier-1) drives every registered kernel in
+  interpret mode over :func:`adversarial_cases` — non-divisible rows,
+  ``k == n``, ``k == 1``, single-row batches, sublane-boundary ±1
+  shapes, lane-boundary k, each declared dtype — against XLA oracles;
+  ``scripts/tpu_parity.py`` reruns the same cases compiled on a chip.
+
+This module is deliberately dependency-light (no jax import) so kernel
+modules can register contracts at import time with zero cost; the
+drivers that actually run kernels live in
+:mod:`raft_tpu.analysis.contract_drivers` and are resolved lazily from
+the contract's ``driver`` dotted name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+# minimum sublane multiple per dtype itemsize (the Mosaic tile rule:
+# f32 (8, 128), bf16 (16, 128), int8 (32, 128) — pallas guide)
+SUBLANE_BY_ITEMSIZE = {8: 8, 4: 8, 2: 16, 1: 32}
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+}
+LANE = 128
+
+
+def dtype_itemsize(name: str) -> int:
+    return _ITEMSIZE.get(str(name), 4)
+
+
+def dtype_sublane(name: str) -> int:
+    return SUBLANE_BY_ITEMSIZE[dtype_itemsize(name)]
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelContract:
+    """Declared invariants for one kernel entry point.
+
+    ``base`` is the canonical small case the sweep perturbs; keys are
+    the kernel's own shape-parameter names (the sweep and the static
+    engine bind them into the enclosing function by NAME, so they must
+    match the source). ``arms`` are static-variant overlays (e.g.
+    ``{"variant": "fold"}``) each of which gets its own shape sweep;
+    ``k_max`` inside an arm caps ``k_range`` for that arm. ``arrays``
+    maps array-argument names to symbolic shapes (dim names from the
+    case, or literal ints) — the static engine uses them to apply the
+    real Mosaic block rule (a block dim equal to the array dim is
+    legal at any size) and the drivers use them to materialize inputs.
+    """
+
+    name: str
+    module: str                     # defining module (static-engine key)
+    entry: str                      # public entry-point attribute
+    driver: str                     # "pkg.mod:fn" resolved lazily
+    tail_rows: str                  # "masked" | "padded" | "rejected"
+    k_range: Tuple[int, int]
+    dtypes: Tuple[str, ...]
+    exactness: str                  # "bitwise" | "recall"
+    base: Mapping[str, object]
+    rows_key: Optional[str] = None  # the dim k selects over
+    batch_key: Optional[str] = None  # the query-batch dim
+    k_key: Optional[str] = "k"
+    recall_floor: float = 0.99
+    arms: Tuple[Mapping[str, object], ...] = ({},)
+    arrays: Mapping[str, Tuple[object, ...]] = dataclasses.field(
+        default_factory=dict)
+    dims: Mapping[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict)     # extra static-engine candidates
+    derive: Optional[Callable[[dict], dict]] = None
+    case_filter: Optional[Callable[[dict], bool]] = None
+    extra_cases: Tuple[Mapping[str, object], ...] = ()
+    notes: str = ""
+
+    def resolve_driver(self) -> Callable:
+        mod, _, fn = self.driver.partition(":")
+        return getattr(importlib.import_module(mod), fn)
+
+
+_REGISTRY: Dict[str, KernelContract] = {}
+
+
+def kernel_contract(name: str, **kw) -> KernelContract:
+    """Register (or re-register on module reload) a kernel contract."""
+    c = KernelContract(name=name, **kw)
+    _REGISTRY[name] = c
+    return c
+
+
+def contracts() -> Dict[str, KernelContract]:
+    """All registered contracts. Importing :mod:`raft_tpu.ops` and
+    :mod:`raft_tpu.matrix.select_k` populates the registry; call
+    :func:`load_all` first when running standalone."""
+    return dict(_REGISTRY)
+
+
+def contracts_for_module(module: str) -> List[KernelContract]:
+    return [c for c in _REGISTRY.values() if c.module == module]
+
+
+def load_all() -> Dict[str, KernelContract]:
+    """Import every module known to declare contracts, then return
+    the registry (the harness/static-engine entry point)."""
+    for mod in (
+        "raft_tpu.ops.fused_topk",
+        "raft_tpu.ops.ivf_scan",
+        "raft_tpu.ops.beam_step",
+        "raft_tpu.matrix.select_k",
+    ):
+        importlib.import_module(mod)
+    return contracts()
+
+
+# ---------------------------------------------------------------------------
+# adversarial sweep generation
+# ---------------------------------------------------------------------------
+
+
+def _finish(c: KernelContract, case: dict) -> Optional[dict]:
+    if c.derive is not None:
+        case = c.derive(dict(case)) or case
+    if c.case_filter is not None and not c.case_filter(case):
+        return None
+    return case
+
+
+def adversarial_cases(c: KernelContract,
+                      dtypes: Optional[Sequence[str]] = None,
+                      ) -> List[dict]:
+    """The contract's adversarial shape sweep.
+
+    Per (arm, dtype): ``k == 1``, ``k == k_max``, ``k == rows`` (the
+    whole-row edge), non-divisible rows, a single-row batch,
+    sublane-boundary ±1 row counts for the dtype's tile, and
+    lane-boundary k (63/64/65/129 clipped to the arm's range). Non-
+    primary dtypes run a reduced spot set (k=1 / k_max) so the sweep
+    stays tier-1-sized; ``extra_cases`` are appended verbatim per
+    dtype-0. Cases are deduplicated preserving order.
+    """
+    out: List[dict] = []
+    seen = set()
+    use_dtypes = tuple(dtypes) if dtypes is not None else c.dtypes
+
+    def emit(case: dict) -> None:
+        case = _finish(c, case)
+        if case is None:
+            return
+        key = tuple(sorted((k, repr(v)) for k, v in case.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(case)
+
+    for arm in (c.arms or ({},)):
+        for di, dtype in enumerate(use_dtypes):
+            base = dict(c.base)
+            base.update(arm)
+            base.pop("k_max", None)
+            base["dtype"] = dtype
+            lo, hi = c.k_range
+            hi = min(hi, int(arm.get("k_max", hi)))
+            spot_only = di > 0
+            if c.k_key is None:
+                emit(dict(base))
+                continue
+            ks = [lo, hi] if spot_only else [lo, hi, 1]
+            for k in ks:
+                if lo <= k <= hi:
+                    emit({**base, c.k_key: int(k)})
+            if spot_only:
+                continue
+            rows = int(base.get(c.rows_key, 0)) if c.rows_key else 0
+            if c.rows_key and rows:
+                # k == rows: every slot must fill, none past the end
+                kr = min(hi, rows)
+                emit({**base, c.k_key: kr, c.rows_key: kr})
+                # non-divisible rows (tail tile reachable): an odd
+                # prime-ish count defeats every pow2 tile size
+                emit({**base, c.k_key: min(hi, 10), c.rows_key: rows + 13})
+                # sublane-boundary ±1 for this dtype's tile
+                s = dtype_sublane(dtype)
+                for r in (s - 1, s, s + 1):
+                    if r >= lo:
+                        emit({**base, c.k_key: min(hi, max(lo, 1)),
+                              c.rows_key: int(r)})
+            if c.batch_key:
+                emit({**base, c.k_key: min(hi, 10), c.batch_key: 1})
+            # lane-boundary k: the fold/candidate-buffer overflow class
+            for k in (63, 64, 65, 129):
+                if lo <= k <= hi and (not c.rows_key or k <= rows):
+                    emit({**base, c.k_key: int(k)})
+    for extra in c.extra_cases:
+        emit(dict(extra))
+    return out
+
+
+def static_cases(c: KernelContract, cap: int = 48) -> List[dict]:
+    """The static engine's binding list: the adversarial sweep's cases
+    (first dtype only beyond the boundary set), capped."""
+    cases = adversarial_cases(c)
+    return cases[:cap]

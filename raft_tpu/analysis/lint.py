@@ -3,9 +3,11 @@
 Static screens for the TPU hazard classes in :mod:`raft_tpu.analysis.rules`.
 Everything here is a *heuristic over syntax* — the precise, shape-aware
 version of GL003/GL004 lives in :mod:`raft_tpu.analysis.jaxpr_audit`,
-which walks real jaxprs. The two engines overlap on purpose: the AST
-pass sees code the tracer never reaches (error branches, dead configs),
-the jaxpr pass sees through aliasing the AST cannot.
+which walks real jaxprs, and Pallas kernel geometry (GL006,
+GL015-GL018) lives in :mod:`raft_tpu.analysis.kernels`, which
+abstractly evaluates it. The engines overlap on purpose: the AST pass
+sees code the tracer never reaches (error branches, dead configs), the
+jaxpr pass sees through aliasing the AST cannot.
 
 Traced-scope detection: a function is considered traced when it is
 decorated with ``jax.jit`` (directly or via ``functools.partial``), is
@@ -128,13 +130,6 @@ _DATED_RE = re.compile(
     re.VERBOSE,
 )
 
-# GL006 ---------------------------------------------------------------------
-
-_SUBLANE_MULTIPLE = 8       # f32 floor; bf16 needs 16, int8 32 (message notes)
-_LANE_MULTIPLE = 128
-_VMEM_BUDGET_BYTES = 16 * 1024 * 1024   # ~VMEM per core (pallas guide)
-
-
 # ---------------------------------------------------------------------------
 # small AST helpers
 # ---------------------------------------------------------------------------
@@ -165,22 +160,6 @@ def _contains_device_expr(node: ast.AST) -> bool:
 
 def _names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
-
-
-def _const_int_tuple(node: ast.AST) -> Optional[List[Optional[int]]]:
-    """[8, 128] for a literal int tuple; None entries for non-literal dims;
-    None overall when not a tuple/list."""
-    if not isinstance(node, (ast.Tuple, ast.List)):
-        return None
-    out: List[Optional[int]] = []
-    for el in node.elts:
-        if isinstance(el, ast.Constant) and isinstance(el.value, int):
-            out.append(el.value)
-        elif isinstance(el, ast.Constant) and el.value is None:
-            out.append(None)      # pallas "whole axis" dim
-        else:
-            out.append(None)
-    return out
 
 
 @dataclasses.dataclass
@@ -368,7 +347,6 @@ class FileLinter:
         if isinstance(node, ast.Call):
             self._check_host_sync_call(node)
             self._check_f64_call(node)
-            self._check_blockspec(node)
         elif isinstance(node, ast.Attribute):
             self._check_f64_attr(node)
         elif isinstance(node, (ast.If, ast.While)):
@@ -377,8 +355,6 @@ class FileLinter:
             self._check_tracer_branch(node.iter, kind="iteration")
         elif isinstance(node, ast.Try):
             self._check_unclassified_swallow(node)
-        elif isinstance(node, ast.FunctionDef):
-            self._check_vmem_budget(node)
 
     # -- GL001 host-sync ---------------------------------------------------
 
@@ -712,75 +688,12 @@ class FileLinter:
                            "dtype 'float64' requested: silently downcast on "
                            "device under disabled x64")
 
-    # -- GL006 BlockSpec / VMEM scratch ------------------------------------
-
-    _BLOCKSPEC_NAMES = ("pl.BlockSpec", "pallas.BlockSpec", "BlockSpec")
-    # VMEM scratch allocations are block-shaped too: an off-lane literal
-    # scratch forces the same relayout a bad BlockSpec does, and its
-    # bytes spend the same per-core budget (the fused kernels allocate
-    # decode scratch this way — ops/ivf_scan.py packed paths)
-    _VMEM_SCRATCH_NAMES = ("pltpu.VMEM", "tpu.VMEM")
-
-    def _check_blockspec(self, node: ast.Call) -> None:
-        fname = _dotted(node.func)
-        if fname in self._BLOCKSPEC_NAMES:
-            kind = "BlockSpec"
-        elif fname in self._VMEM_SCRATCH_NAMES:
-            kind = "VMEM scratch"
-        else:
-            return
-        if not node.args:
-            return
-        dims = _const_int_tuple(node.args[0])
-        if dims is None:
-            return  # symbolic/expression-derived shape — the required
-            # form for tile budgets (docs/kernels.md §tile-geometry);
-            # the static screen cannot and need not judge it
-        lits = [d for d in dims if d is not None]
-        if not lits or len(dims) < 1:
-            return
-        last = dims[-1]
-        if last is not None and last != 1 and last % _LANE_MULTIPLE != 0:
-            self._emit("GL006", node,
-                       f"{kind} trailing dim {last} is not a multiple of "
-                       f"{_LANE_MULTIPLE} (TPU lane width): forces relayout")
-        if len(dims) >= 2:
-            sub = dims[-2]
-            if sub is not None and sub != 1 and sub % _SUBLANE_MULTIPLE != 0:
-                self._emit("GL006", node,
-                           f"{kind} sublane dim {sub} is not a multiple of "
-                           f"{_SUBLANE_MULTIPLE} (f32 tile; bf16 needs 16, "
-                           "int8 32): forces relayout")
-
-    def _check_vmem_budget(self, fn: ast.FunctionDef) -> None:
-        """Static VMEM estimate: sum of fully-literal BlockSpec blocks
-        AND literal pltpu.VMEM scratch shapes used in this function, at
-        4 B/elem (f32 upper bound for this codebase's kernels).
-        Expression-derived shapes (the fused kernels' tile budgets,
-        docs/kernels.md) are invisible to this screen by design — that
-        is the required idiom; only literal geometry is audited."""
-        total = 0
-        count = 0
-        for sub in ast.walk(fn):
-            dims = None
-            if isinstance(sub, ast.Call):
-                fname = _dotted(sub.func)
-                if fname in (self._BLOCKSPEC_NAMES
-                             + self._VMEM_SCRATCH_NAMES) and sub.args:
-                    dims = _const_int_tuple(sub.args[0])
-            if not dims or any(d is None for d in dims):
-                continue
-            n = 1
-            for d in dims:
-                n *= d
-            total += 4 * n
-            count += 1
-        if count and total > _VMEM_BUDGET_BYTES:
-            self._emit("GL006", fn,
-                       f"{count} literal BlockSpec/VMEM blocks in "
-                       f"{fn.name}() total "
-                       f"~{total / 2**20:.1f} MiB, over the "
-                       f"~{_VMEM_BUDGET_BYTES // 2**20} MiB VMEM budget")
+    # -- GL006 (retired here) ----------------------------------------------
+    # The literal BlockSpec/VMEM screen that lived here through r6 moved
+    # into the kern engine (analysis/kernels.py) as the FALLBACK for
+    # pallas_call sites whose geometry the abstract evaluator cannot
+    # resolve; resolved sites get exact computed accounting instead
+    # (GL006/GL015-GL018, docs/static_analysis.md §engine-4).
 
     # -- GL005 undated perf claims ----------------------------------------
 

@@ -179,11 +179,58 @@ register_rule(
 )
 register_rule(
     "GL006", "blockspec",
-    "Pallas BlockSpec off the (sublane, 128) tile grid, or block set over "
-    "the VMEM budget",
-    "TPU tiles are (8,128) f32 / (16,128) bf16 / (32,128) int8; off-grid "
-    "trailing dims force relayouts, and blocks past ~16 MB VMEM per core "
-    "fail to lower or thrash",
+    "pallas_call blocks + scratch over the per-core VMEM budget "
+    "(computed by the kern engine's abstract evaluation; literal-dim "
+    "screen kept as the fallback for unresolvable sites)",
+    "TPU tiles are (8,128) f32 / (16,128) bf16 / (32,128) int8; blocks "
+    "past ~16 MB VMEM per core fail to lower or thrash. The kern engine "
+    "(analysis/kernels.py) accounts real block/scratch bytes under every "
+    "shape binding a contract or dispatch-table winner can inject; the "
+    "pre-engine literal heuristic survives only for call sites the "
+    "evaluator cannot resolve",
+)
+register_rule(
+    "GL015", "kernel-oob",
+    "Pallas index map reaching past the array, a floor-divided grid "
+    "dropping remainder rows, or a reachable non-divisible tail tile "
+    "with no mask in the kernel (kern engine)",
+    "a BlockSpec index map that exceeds the padded array shape reads "
+    "(or writes) out of bounds; a ceil-divided grid whose divisor does "
+    "not divide the axis makes the tail tile's pad region reachable — "
+    "without an in-kernel mask (jnp.where/pl.when on a bound compare) "
+    "pad garbage can win the reduction, the tail-masking bug class every "
+    "fused kernel here has hit at least once",
+)
+register_rule(
+    "GL016", "tile-align",
+    "kernel block dim off the dtype's (sublane, 128) tile — computed "
+    "values included — with the offending dim named (kern engine)",
+    "Mosaic requires block dims divisible by the dtype tile ((8,128) "
+    "f32, (16,128) bf16, (32,128) int8), equal to the array dim, or 1; "
+    "anything else relayouts or fails to lower. GL006's literal screen "
+    "could not see computed geometry (tile variables, tuning winners, "
+    "helper-derived candidate widths) — this rule evaluates it",
+)
+register_rule(
+    "GL017", "grid-hazard",
+    "output ref revisited across grid steps without a revisiting-safe "
+    "write pattern (kern engine)",
+    "an output block whose index map ignores a grid dimension is "
+    "visited once per step of that dimension: a plain overwrite loses "
+    "every partial result but the last, and read-modify-write "
+    "accumulation without a first-step init (pl.when on program_id) "
+    "reads uninitialized VMEM — both are silent wrong-answer classes "
+    "invisible in interpret mode when the test grid is 1",
+)
+register_rule(
+    "GL018", "mxu-dtype",
+    "in-kernel dot with mismatched operand dtypes, or low-precision "
+    "operands without preferred_element_type (kern engine)",
+    "the MXU runs one native pass per operand dtype pair: mismatched "
+    "operands silently promote (multi-pass, off the fast path), and a "
+    "bf16/int8 contraction without preferred_element_type=f32 keeps the "
+    "accumulator low-precision — the 2^24 ordering-collapse class's "
+    "matmul cousin",
 )
 
 
@@ -198,7 +245,7 @@ class Finding:
     path: str
     line: int
     message: str
-    engine: str = "ast"        # "ast" | "jaxpr"
+    engine: str = "ast"        # "ast" | "jaxpr" | "races" | "kern"
     suppressed: bool = False
     reason: str = ""           # the suppression's reason when suppressed
 

@@ -158,12 +158,19 @@ def scan_qps_time(search_step, queries, n1: int = 3, n2: int = 13,
 #   fractional-socket slice). On CPU the roofline column DOCUMENTS THE
 #   HARNESS — the fractions are only meaningful relative to each other,
 #   never as a hardware claim (BENCH artifacts carry the backend name).
+# Every spec row carries machine-readable provenance (``source`` +
+# ``recorded``): GL005 (undated-perf) demands each number name its
+# origin, and the roofline output echoes ``peak_source`` into every
+# BENCH artifact so a stale spec is detectable from the artifact alone.
 PEAK_SPECS = {
     "tpu": {"flops_peak": 197.0e12, "hbm_gbps": 819.0,
-            "source": "TPU v5e public spec, recorded 2026-08-04"},
+            "recorded": "2026-08-04",
+            "source": "TPU v5e public spec sheet (bf16 MXU peak, "
+                      "per-chip HBM), recorded 2026-08-04 (r6)"},
     "cpu": {"flops_peak": 1.0e11, "hbm_gbps": 25.0,
+            "recorded": "2026-08-04",
             "source": "CI-host placeholder (harness documentation only),"
-                      " recorded 2026-08-04"},
+                      " recorded 2026-08-04 (r6)"},
 }
 
 

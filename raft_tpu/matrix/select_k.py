@@ -340,6 +340,60 @@ def _hierarchical_topk(in_val, k: int, select_min: bool):
     return vb[:, 0, :k], ib[:, 0, :k]
 
 
+# ---------------------------------------------------------------------------
+# kernel contracts (graft-kern; docs/static_analysis.md §engine-4).
+# select_k has no pallas_call — these rungs are the kernel-SHAPED
+# selection networks (in-VMEM tile reductions + merge trees), so they
+# register for the DYNAMIC adversarial sweep only: every dtype they
+# claim, k==n, k==1, single-row, sublane-boundary ±1 row counts, and
+# the >2^24 integer domain, against the stable-sort oracle.
+# ---------------------------------------------------------------------------
+
+from raft_tpu.analysis.contracts import kernel_contract  # noqa: E402
+
+
+def _sel_case_ok(case: dict) -> bool:
+    return 0 < case.get("k", 1) <= case.get("n", 1)
+
+
+kernel_contract(
+    "select_k_hierarchical",
+    module=__name__,
+    entry="select_k",
+    driver="raft_tpu.analysis.contract_drivers:drive_select_k",
+    tail_rows="padded",          # structural pads carry index -1
+    k_range=(1, 1024),
+    dtypes=("float32", "bfloat16", "int32", "uint32", "bool"),
+    exactness="bitwise",
+    base={"batch": 8, "n": 1000, "impl": "hierarchical"},
+    rows_key="n", batch_key="batch",
+    case_filter=_sel_case_ok,
+    extra_cases=(
+        {"impl": "hierarchical", "batch": 8, "n": 1000, "k": 100,
+         "dtype": "float32", "nan": True},
+    ),
+    notes="NaNs quarantined to the worst key class; integer keys stay "
+          "in the integer domain (bitwise-NOT reversal, exact > 2^24).",
+)
+
+kernel_contract(
+    "select_k_tournament",
+    module=__name__,
+    entry="select_k",
+    driver="raft_tpu.analysis.contract_drivers:drive_select_k",
+    tail_rows="padded",
+    k_range=(1, 1024),
+    dtypes=("float32",),         # float-only by contract (docstring)
+    exactness="bitwise",
+    base={"batch": 8, "n": 1000, "impl": "tournament"},
+    rows_key="n", batch_key="batch",
+    case_filter=_sel_case_ok,
+    notes="NaN inputs unsupported by design (±inf is the library "
+          "sentinel convention); the NaN-tolerant arms are top_k and "
+          "hierarchical.",
+)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def select_k_threshold(in_val, k: int, select_min: bool = True, n_bins: int = 4096):
     """Two-pass histogram threshold select for very large k.

@@ -201,11 +201,10 @@ def _resolve_bf_impl(requested: str, m: int, n: int, d: int, k: int,
     fused_ok = on_tpu and fused_metric and not filtered
     candidates = ["scan"]
     if fused_ok:
-        tiles = (512, 1024, 2048)
-        if k <= 128:
-            candidates += [f"fused_exact:{t}" for t in tiles]
-        if approx_ok and k <= 256:
-            candidates += [f"fused_fold:{t}" for t in tiles]
+        # the canonical (variant, tile) enumeration lives in tuning —
+        # the same set microbench races and the graft-kern verifier
+        # audits (tuning.kernel_shape_candidates)
+        candidates += tuning.fused_topk_candidate_impls(k, approx_ok)
     if len(candidates) == 1:
         return "scan"
     variant = "fold" if approx_ok and k <= 256 else "exact"

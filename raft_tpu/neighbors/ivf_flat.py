@@ -567,6 +567,7 @@ def _ivf_search(
         out_d, cand_i = ivf_scan.fused_list_scan_topk(
             storage, indices, list_sizes, bucket_list, qv, qaux, pn2, keep,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
+            recall_target=float(local_recall_target),
             interpret=scan_impl == "pallas_interpret",
         )                                                    # ids in-kernel
         if metric == DistanceType.InnerProduct:

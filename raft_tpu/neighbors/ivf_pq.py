@@ -1820,6 +1820,7 @@ def _pq_search(
             keep,
             lut_weights=lut_w,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
+            recall_target=float(local_recall_target),
             interpret=scan_impl == "pallas_interpret",
             packed_i4=cache_i4,
         )                                                    # ids in-kernel

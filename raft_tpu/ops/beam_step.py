@@ -353,7 +353,11 @@ def beam_merge_step(
         dwq = qrep.shape[2]
         inputs += [qrep, pack.reshape(m, width * W), parents]
         in_specs += [
-            # graft-lint: allow-blockspec 4-row byte-lane query replication; padded sublane measured a net win (r3)
+            # (g, 4, dwq): the 4-row byte-lane query replication. The
+            # old literal-GL006 screen needed a suppression here; the
+            # graft-kern computed audit proves the spec legal — sublane
+            # dim 4 EQUALS the array dim (the real Mosaic rule), so no
+            # relayout and no exception needed (r6)
             pl.BlockSpec((g, 4, dwq), lambda i: (i, 0, 0)),
             pl.BlockSpec((g, width * W), lambda i: (i, 0)),
             pl.BlockSpec((width, g), col),
@@ -401,3 +405,76 @@ def beam_merge_step(
         out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# kernel contract (graft-kern; docs/static_analysis.md §engine-4)
+# ---------------------------------------------------------------------------
+
+from raft_tpu.analysis.contracts import kernel_contract  # noqa: E402
+
+
+def _beam_case_derive(case: dict) -> dict:
+    case.setdefault("g", 128)
+    case.setdefault("m", case["g"])
+    case.setdefault("width", 4)
+    case.setdefault("window", 2)
+    case.setdefault("ip", False)
+    case.setdefault("emit_cands", False)
+    if case.get("scored", True):
+        case.setdefault("C", 32)
+        case["cand_d"] = case["cand_i"] = True
+        case["qrep"] = case["pack"] = case["parents"] = False
+        case.setdefault("deg", 0)
+        case.setdefault("d", 0)
+    else:
+        case.setdefault("deg", 16)
+        case.setdefault("d", 32)
+        case["C"] = case["width"] * case["deg"]
+        case["W"] = packed_row_layout(case["deg"], case["d"],
+                                      case["ip"])[3]
+        case["dwq"] = case["deg"] * (case["d"] // 4)
+        case["qrep"] = case["pack"] = case["parents"] = True
+        case["cand_d"] = case["cand_i"] = False
+        case["qrep_dtype"] = "bfloat16"
+        case["pack_dtype"] = "int32"
+    return case
+
+
+kernel_contract(
+    "beam_step",
+    module=__name__,
+    entry="beam_merge_step",
+    driver="raft_tpu.analysis.contract_drivers:drive_beam_step",
+    tail_rows="rejected",        # m % g and W % 128 raise at the door
+    k_range=(1, 1),
+    k_key=None,                  # no k: the buffer length L is static
+    dtypes=("float32",),
+    exactness="bitwise",
+    base={"L": 16, "m": 128, "g": 128},
+    arms=(),
+    arrays={"buf_d": ("L", "m"), "buf_i": ("L", "m"), "buf_e": ("L", "m"),
+            "cand_d": ("C", "m"), "cand_i": ("C", "m"),
+            "qrep": ("m", 4, "dwq"), "pack": ("m", "width", "W"),
+            "parents": ("width", "m")},
+    derive=_beam_case_derive,
+    extra_cases=(
+        # scored arm: the merge/dedup/pick pipeline vs the numpy oracle
+        {"scored": True, "L": 16, "C": 32, "m": 128, "width": 4},
+        {"scored": True, "L": 8, "C": 8, "m": 128, "width": 2},
+        {"scored": True, "L": 16, "C": 32, "m": 256, "width": 4,
+         "window": 3},
+        # non-pow2 buffer + candidate counts: LL pads internally
+        {"scored": True, "L": 12, "C": 20, "m": 128, "width": 3},
+        # packed-scoring arm: static geometry bindings (scratch, packed
+        # row blocks); dynamics pinned by test_beam_step/test_cagra
+        {"scored": False, "deg": 16, "d": 32, "L": 16, "m": 128,
+         "width": 4, "static_only": True},
+        {"scored": False, "deg": 16, "d": 32, "L": 16, "m": 128,
+         "width": 4, "emit_cands": True, "ip": True,
+         "static_only": True},
+    ),
+    notes="all per-query state rides TRANSPOSED [slots, m] so the sort "
+          "axis is the sublane axis; m must be a multiple of g (the "
+          "kernel raises otherwise — tail_rows='rejected').",
+)

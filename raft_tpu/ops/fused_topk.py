@@ -149,8 +149,16 @@ def tile_geometry(m: int, n: int, d: int, k: int, variant: str,
     budget math is docs/kernels.md §tile-geometry): block bytes =
     q[TQ, d] + x[TN, d] + f32 dist[TQ, TN] + candidate buffers must fit
     ~half of per-core VMEM. The analytic default; the dispatch table
-    overrides it per backend (op key ``fused_topk_tile``)."""
-    tile_q = 128 if m >= 128 else max(8, 1 << (max(m - 1, 1)).bit_length())
+    overrides it per backend (op key ``fused_topk_tile``).
+
+    The query-tile floor is the operand dtype's SUBLANE multiple (8 for
+    4-byte, 16 for 2-byte, 32 for 1-byte operands — the (s, 128) tile
+    rule analysis/contracts.py codifies): the old flat floor of 8 put
+    the bf16 fast path's q-block off the (16, 128) tile at m <= 8 —
+    found by graft-kern's computed alignment audit (GL016, r6)."""
+    floor = {1: 32, 2: 16}.get(int(itemsize), 8)
+    tile_q = 128 if m >= 128 else max(
+        floor, 1 << (max(m - 1, 1)).bit_length())
     cand = candidate_width(k, variant)
     budget = _VMEM_BYTES // 2
     tile_n = 2048
@@ -223,6 +231,16 @@ def fused_topk(
                         jnp.dtype(queries.dtype).itemsize)
     tq = int(tile_q or geo["tile_q"])
     tn = int(tile_n or geo["tile_n"])
+    if variant == "fold" and tn % 128:
+        # fold_lane_stacks folds T//128 lane chunks: a non-lane-multiple
+        # row tile would silently DROP the tail columns from the
+        # reduction (the tail-masking class the kernel contracts exist
+        # for) — tile_geometry and the dispatch candidates only produce
+        # lane multiples, so only an explicit tile_n can get here
+        raise ValueError(
+            f"variant='fold' needs tile_n % 128 == 0 (the per-lane "
+            f"fold covers tile_n//128 chunks; a remainder is silently "
+            f"dropped), got tile_n={tn}")
     # trace-time span: attributes compile cost per (variant, tiles);
     # steady-state cached dispatch is silent
     with obs.span("fused_topk", variant=variant, m=m, n=n, k=int(k),
@@ -288,7 +306,9 @@ def _fused_topk_tiles(queries, dataset, norms=None, qaux=None, *, k: int,
         grid=(mq, nt),
         in_specs=in_specs,
         out_specs=[
+            # graft-lint: allow-tile-align exact-arm candidate width C=k is deliberately narrow — lane-padding it to 128 would multiply the kernel's whole HBM output by 128/k, the very traffic the fusion removes (docs/kernels.md §candidate-buffers); accepted relayout, revalidate when a chip answers (r6)
             pl.BlockSpec((tile_q, C), lambda i, j: (i, j)),
+            # graft-lint: allow-tile-align same narrow candidate buffer as the distance output above
             pl.BlockSpec((tile_q, C), lambda i, j: (i, j)),
         ],
         out_shape=[
@@ -298,3 +318,72 @@ def _fused_topk_tiles(queries, dataset, norms=None, qaux=None, *, k: int,
         interpret=interpret,
     )(*inputs)
     return out_d, out_i
+
+
+# ---------------------------------------------------------------------------
+# kernel contract (graft-kern: static geometry bindings + the dynamic
+# adversarial interpret-mode sweep share these declarations —
+# docs/static_analysis.md §engine-4)
+# ---------------------------------------------------------------------------
+
+from raft_tpu.analysis.contracts import kernel_contract  # noqa: E402
+
+
+def _contract_case_ok(case: dict) -> bool:
+    k, n = case.get("k", 1), case.get("n", 1)
+    if not 0 < k <= n:
+        return False
+    if case.get("variant") == "exact" and k > 128:
+        return False
+    if case.get("variant") == "fold" and k > 256:
+        return False
+    return True
+
+
+def _contract_case_derive(case: dict) -> dict:
+    # tile_q is ALWAYS the analytic choice (dispatch winners carry only
+    # the row tile) — bind the real coupling so the static engine does
+    # not audit (m, tile_q) pairs the resolver can never produce
+    itemsize = 2 if case.get("dtype") == "bfloat16" else 4
+    case.setdefault(
+        "tile_q",
+        tile_geometry(case["m"], case["n"], case["d"], case.get("k", 1),
+                      case.get("variant", "exact"), itemsize)["tile_q"])
+    return case
+
+
+kernel_contract(
+    "fused_topk",
+    module=__name__,
+    entry="fused_topk",
+    driver="raft_tpu.analysis.contract_drivers:drive_fused_topk",
+    tail_rows="masked",           # pad rows masked to +inf in-kernel
+    k_range=(1, 256),
+    dtypes=("float32", "bfloat16"),
+    exactness="bitwise",          # exact arm; fold judged in its band
+    recall_floor=0.95,
+    base={"m": 16, "n": 403, "d": 32, "metric_kind": L2},
+    rows_key="n", batch_key="m",
+    arms=({"variant": "exact", "k_max": 128},
+          {"variant": "fold", "k_max": 256}),
+    arrays={"queries": ("m", "d"), "dataset": ("n", "d"),
+            "norms": ("n",), "qaux": ("m",)},
+    case_filter=_contract_case_ok,
+    derive=_contract_case_derive,
+    extra_cases=(
+        {"variant": "exact", "k": 10, "m": 16, "n": 403, "d": 32,
+         "metric_kind": IP, "dtype": "float32"},
+        {"variant": "exact", "k": 10, "m": 16, "n": 403, "d": 32,
+         "metric_kind": COSINE, "dtype": "float32"},
+        # multi-tile query grid (m >= 128: tile_q=128, mq > 1)
+        {"variant": "exact", "k": 10, "m": 256, "n": 403, "d": 32,
+         "metric_kind": L2, "dtype": "float32"},
+        # the bf16 fast path's smallest batch: the dtype-aware tile_q
+        # floor (16 for 2-byte operands) pinned by the GL016 audit
+        {"variant": "fold", "k": 10, "m": 4, "n": 403, "d": 32,
+         "metric_kind": L2, "dtype": "bfloat16"},
+    ),
+    notes="fold loses a true top-k entry only when > R share a lane "
+          "(R = ceil(k/64), docs/kernels.md §candidate-buffers); the "
+          "exact cross-tile merge recovers everything that survives.",
+)

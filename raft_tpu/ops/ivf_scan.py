@@ -40,6 +40,32 @@ COSINE = 2    # dist = 1 - q.x / (||q|| ||x||)     (needs norms=||x||^2, qaux=||
 # "-1 = no neighbor" contract
 _INVALID = -1
 
+# the per-list recall budget binned eligibility is judged against when
+# the caller does not say (ivf_flat/ivf_pq SearchParams default)
+DEFAULT_RECALL_TARGET = 0.95
+
+
+def binned_loss_fits(k: int,
+                     recall_target: float = DEFAULT_RECALL_TARGET) -> bool:
+    """THE single home for the single-slot binning loss model: one
+    candidate per lane-bin loses a true top-k entry whenever a better
+    one shares its bin — expected lost FRACTION ~ (k-1)/256
+    (C(k,2)/128 colliding pairs over k entries). Consumed by the entry
+    point's eligibility, the kernel contract's sweep filter, and the
+    microbench candidate set, so the three can never drift apart
+    (review fix, r6). ``recall_target <= 0`` always fits (forcing)."""
+    rt = float(recall_target)
+    return rt <= 0.0 or (k - 1) / 256.0 <= max(0.0, 1.0 - rt)
+
+
+def binned_k_cap(recall_target: float = DEFAULT_RECALL_TARGET) -> int:
+    """Largest k the loss model admits at ``recall_target`` (<= the
+    structural 64-candidate extraction cap)."""
+    k = 64
+    while k > 1 and not binned_loss_fits(k, recall_target):
+        k -= 1
+    return k
+
 
 def _extract_topk(dist, ids_row, k: int, outd_ref, outi_ref):
     """k-pass min extraction over [G, cap]; emits [G, k] dists + ids."""
@@ -297,6 +323,7 @@ def fused_list_scan_topk(
     k: int,
     metric_kind: int,
     approx: bool = True,
+    recall_target: float = 0.95,
     interpret: bool = False,
     packed_i4: bool = False,
     extract: str = None,
@@ -349,14 +376,19 @@ def fused_list_scan_topk(
     cap = (storage.shape[2] if (packed_i4 or lut_weights is not None)
            else storage.shape[1])
     binned_ok = approx and cap % 128 == 0 and cap > 128
+    # single-slot binning is only eligible when its collision-loss
+    # model fits the caller's per-list recall budget (binned_loss_fits
+    # above) — the old flat k <= 64 cap admitted ~25% loss at k=64,
+    # caught by the kernel-contract sweep's lane-boundary cases (r6,
+    # tests/test_kernel_contracts.py)
     eligible = ["exact"]
-    if binned_ok and k <= 64:
+    if binned_ok and k <= 64 and binned_loss_fits(k, recall_target):
         eligible.append("binned")
     if binned_ok and k <= 256:
         eligible.append("binned_deep")
         eligible.append("fold")
     if extract is None:
-        analytic = ("binned" if binned_ok and k <= 64
+        analytic = ("binned" if "binned" in eligible
                     else "binned_deep" if binned_ok and k <= 256
                     else "exact")
         extract = tuning.choose(
@@ -476,3 +508,101 @@ def _fused_list_scan_topk(
         interpret=interpret,
     )(bucket_list, list_sizes, *inputs)
     return out_d, out_i
+
+
+# ---------------------------------------------------------------------------
+# kernel contract (graft-kern; docs/static_analysis.md §engine-4)
+# ---------------------------------------------------------------------------
+
+from raft_tpu.analysis.contracts import kernel_contract  # noqa: E402
+
+
+def _scan_case_derive(case: dict) -> dict:
+    case.setdefault("C", 4)
+    case.setdefault("G", 8)
+    case.setdefault("nb", 4)
+    case.setdefault("d", 32)
+    case.setdefault("metric_kind", L2)
+    has_norms = case["metric_kind"] != IP
+    case.setdefault("norms", has_norms)
+    case.setdefault("qaux", has_norms)
+    case.setdefault("keep", False)
+    if case.get("packed_i4"):
+        case["nw_c"] = case["d"] // 8
+        case["storage_shape"] = ("C", "nw_c", "cap")
+        case["storage_dtype"] = "uint32"
+        case["lut_weights"] = False
+    elif case.get("pq4"):
+        case["nw_c"] = case.setdefault("p", case["d"] // 4) // 8 or 1
+        case.setdefault("rot", case["d"])
+        case["storage_shape"] = ("C", "nw_c", "cap")
+        case["storage_dtype"] = "uint32"
+        case["lut_weights"] = True
+    else:
+        case["storage_shape"] = ("C", "cap", "d")
+        case["lut_weights"] = False
+    return case
+
+
+def _scan_case_ok(case: dict) -> bool:
+    cap, k, ex = case.get("cap", 0), case.get("k", 1), case["extract"]
+    if not 0 < k:
+        return False
+    if ex == "exact":
+        # cap the unrolled k-pass sweep: the dispatch layer hands
+        # k > 64 to the binned/fold arms anyway, and a 200-pass unroll
+        # makes the interpret sweep minutes-long
+        return k <= 32
+    if cap % 128 != 0 or cap <= 128:
+        return False
+    if ex == "binned":
+        # the entry point's own loss model at the default target — no
+        # hand-mirrored constant to drift (review fix, r6)
+        return binned_loss_fits(k)
+    return k <= 256
+
+
+kernel_contract(
+    "ivf_scan",
+    module=__name__,
+    entry="fused_list_scan_topk",
+    driver="raft_tpu.analysis.contract_drivers:drive_list_scan",
+    tail_rows="masked",          # col >= size masked to +inf in-kernel
+    k_range=(1, 256),
+    dtypes=("float32", "bfloat16"),
+    exactness="bitwise",
+    recall_floor=0.93,           # the tpu_parity binned band
+    base={"cap": 256, "C": 4, "G": 8, "nb": 4, "d": 32,
+          "metric_kind": L2},
+    rows_key="cap", batch_key="G",
+    arms=({"extract": "exact", "k_max": 32},
+          {"extract": "binned", "k_max": binned_k_cap()},
+          {"extract": "binned_deep", "k_max": 65},
+          {"extract": "fold", "k_max": 256}),
+    arrays={"storage": ("C", "cap", "d"), "indices": ("C", "cap"),
+            "list_sizes": ("C",), "bucket_list": ("nb",),
+            "qv": ("nb", "G", "d"), "qaux": ("nb", "G"),
+            "norms": ("C", "cap"), "keep": ("C", "cap"),
+            "lut_weights": (16, "rot", "p")},
+    derive=_scan_case_derive,
+    case_filter=_scan_case_ok,
+    extra_cases=(
+        # metric spot checks on the exact arm
+        {"extract": "exact", "k": 10, "cap": 256, "metric_kind": IP,
+         "dtype": "float32"},
+        {"extract": "exact", "k": 10, "cap": 256, "metric_kind": COSINE,
+         "dtype": "float32"},
+        # filtered-scan geometry (keep-mask block rides the site)
+        {"extract": "exact", "k": 10, "cap": 256, "keep": True,
+         "dtype": "float32", "static_only": True},
+        # packed-storage geometry for the static engine; the packed
+        # dynamics are pinned by test_ivf_pq + pallas_parity
+        {"extract": "exact", "k": 10, "cap": 256, "packed_i4": True,
+         "dtype": "bfloat16", "static_only": True},
+        {"extract": "exact", "k": 10, "cap": 256, "pq4": True,
+         "dtype": "bfloat16", "static_only": True},
+    ),
+    notes="binned loses ~C(k,2)/128 per list, binned_deep/fold lose "
+          "only when > R of the list's top-k share a lane; the "
+          "cross-probe merge recovers survivors (docs/kernels.md).",
+)

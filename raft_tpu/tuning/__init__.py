@@ -39,6 +39,20 @@ from raft_tpu.tuning.table import DispatchTable
 
 _MODES = ("off", "table", "measure")
 
+# Canonical row-tile candidates for the fused brute-force kernel
+# (ops/fused_topk.py, op key ``fused_topk_tile``). ONE home on purpose:
+# brute_force._resolve_bf_impl builds its dispatch candidate strings
+# ("fused_<variant>:<tile>") from this set, microbench races exactly the
+# same set, and the graft-kern static verifier (analysis/kernels.py)
+# evaluates kernel geometry over every value that can flow in from a
+# table winner — a tile added here is automatically raced, dispatched,
+# and statically audited.
+FUSED_TOPK_TILES = (512, 1024, 2048)
+# tile_geometry's analytic fallback halves below the raced set down to
+# this floor; it is part of the reachable-value domain the verifier
+# must cover even though it is never raced by name
+FUSED_TOPK_TILE_FLOOR = 256
+
 # ops cheap enough to measure synchronously at first use in "measure"
 # mode; scan-path ops need an index built around them — capture those
 # with scripts/capture_dispatch_tables.py instead
@@ -206,6 +220,51 @@ def choose(op: str, key: Dict, candidates: List[str],
     return fallback
 
 
+def fused_topk_candidate_impls(k: int, approx_ok: bool) -> List[str]:
+    """The fused brute-force impl strings eligible at ``k`` —
+    ``fused_<variant>:<tile>`` over :data:`FUSED_TOPK_TILES` within
+    each variant's extraction budget (exact k <= 128, fold k <= 256;
+    fold only for approx-opted callers). The shared enumeration behind
+    brute_force's dispatch and microbench's race."""
+    out: List[str] = []
+    if k <= 128:
+        out += [f"fused_exact:{t}" for t in FUSED_TOPK_TILES]
+    if approx_ok and k <= 256:
+        out += [f"fused_fold:{t}" for t in FUSED_TOPK_TILES]
+    return out
+
+
+def kernel_shape_candidates() -> Dict[str, tuple]:
+    """Shape-parameter domains reachable through ``tuning.choose``
+    winners, keyed by kernel parameter NAME — consumed by the
+    graft-kern static verifier (docs/static_analysis.md §engine-4) so
+    table-dispatched tile geometry is audited at every value it can
+    take, not just the analytic default. Includes any extra tiles an
+    active site-captured table carries in its ``fused_topk_tile``
+    winner strings (``fused_<variant>:<tile>``)."""
+    tiles = set(FUSED_TOPK_TILES)
+    tiles.add(FUSED_TOPK_TILE_FLOOR)          # analytic halving floor
+    t = get_table()
+    if t is not None:
+        try:
+            for entry in t.data.get("ops", {}).get(
+                    "fused_topk_tile", {}).get("entries", []):
+                w = str(entry.get("winner", ""))
+                if w.startswith("fused_") and ":" in w:
+                    tail = w.split(":", 1)[1].split(":", 1)[0]
+                    if tail.isdigit():
+                        tiles.add(int(tail))
+        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow malformed table entries only shrink the audited domain to the canonical set
+            pass
+    return {
+        "tile_n": tuple(sorted(tiles)),
+        # tile_geometry rounds the query tile to a pow2 in [8, 128];
+        # the corners bound both the VMEM max and the alignment screen
+        "tile_q": (8, 128),
+        "variant": ("exact", "fold"),
+    }
+
+
 def record_budget(name: str, value: int) -> None:
     """Record a runtime budget CEILING for ``name`` (in-process only).
 
@@ -249,8 +308,9 @@ def budget(name: str, default: int) -> int:
 
 
 __all__ = [
-    "DispatchTable", "MEASURABLE_INLINE", "backend_name", "budget",
-    "choose", "get_table", "mode", "record_budget", "reload",
-    "runtime_budget", "set_mode", "set_table_path", "table_path",
-    "tables_dir",
+    "DispatchTable", "FUSED_TOPK_TILES", "FUSED_TOPK_TILE_FLOOR",
+    "MEASURABLE_INLINE", "backend_name", "budget", "choose",
+    "fused_topk_candidate_impls", "get_table", "kernel_shape_candidates",
+    "mode", "record_budget", "reload", "runtime_budget", "set_mode",
+    "set_table_path", "table_path", "tables_dir",
 ]

@@ -197,9 +197,16 @@ def bench_scan_extract(key: Dict, candidates: Optional[List[str]] = None,
     d = int(key.get("d", 64))
     nb = int(key.get("nb", 16))
     if candidates is None:
+        from raft_tpu.ops.ivf_scan import binned_loss_fits
+
+        # race only arms a DEFAULT-target serve call can actually pick:
+        # the table key carries no recall dimension, so a winner that
+        # is ineligible at serve time would be skipped wholesale by
+        # DispatchTable.lookup (it never consults the runner-up) and
+        # the chip time racing it wasted (review fix, r6)
         candidates = ["exact"]
         if cap % 128 == 0 and cap > 128:
-            if k <= 64:
+            if k <= 64 and binned_loss_fits(k):
                 candidates.append("binned")
             if k <= 256:
                 candidates.append("binned_deep")
@@ -227,6 +234,10 @@ def bench_scan_extract(key: Dict, candidates: Optional[List[str]] = None,
             storage, indices, sizes, buckets, qv, qaux, norms,
             None, k=k, metric_kind=ivf_scan.L2,
             approx=arm != "exact", interpret=interpret,
+            # the race measures TIME; recall-fit filtering happens at
+            # dispatch (choose() intersects table winners with the
+            # caller's eligible set), so keep every arm forceable here
+            recall_target=0.0,
             extract=arm,
         )
         # charge EVERY arm its downstream cross-probe merge at the real
@@ -266,12 +277,11 @@ def bench_fused_topk(key: Dict, candidates: Optional[List[str]] = None,
     d = int(key.get("d", _SCAN_D))
     k = int(key.get("k", 10))
     if candidates is None:
-        candidates = ["scan"]
-        tiles = (512, 1024, 2048)
-        if k <= 128:
-            candidates += [f"fused_exact:{t}" for t in tiles]
-        if k <= 256:
-            candidates += [f"fused_fold:{t}" for t in tiles]
+        from raft_tpu.tuning import fused_topk_candidate_impls
+
+        # race the exact same enumeration brute_force dispatches over
+        # (microbench charges fold with its deferred merge either way)
+        candidates = ["scan"] + fused_topk_candidate_impls(k, approx_ok=True)
     data, queries = _scan_dataset(n=n, d=d, m=m)
     index = brute_force.build(data, "sqeuclidean")
     q = jnp.asarray(queries)

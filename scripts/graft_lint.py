@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """graft-lint launcher (repo checkout form of the ``graft-lint`` console
-script): AST + jaxpr + graft-race static analysis for TPU correctness
-and lock-discipline hazards.
+script): AST + jaxpr + graft-race + graft-kern static analysis for TPU
+correctness, lock-discipline, and Pallas kernel-geometry hazards.
 
     python scripts/graft_lint.py --format=json raft_tpu/
     python scripts/graft_lint.py --engine=both raft_tpu/
-    python scripts/graft_lint.py --engine=both,races raft_tpu/
+    python scripts/graft_lint.py --engine=kern raft_tpu/
+    python scripts/graft_lint.py --engine=all raft_tpu/
     python scripts/graft_lint.py --list-rules
 
 See docs/static_analysis.md for the rule catalog and suppression syntax.

@@ -13,10 +13,15 @@ the real Mosaic-compiled kernels on the TPU:
   hardware-top_k oracle (ids bitwise on the exact arm),
 * beam_step.beam_merge_step (scored + packed variants) vs the numpy
   merge oracle from tests/test_beam_step.py,
-* cagra pallas search vs the scattered XLA search (recall agreement).
+* cagra pallas search vs the scattered XLA search (recall agreement),
+* the full kernel-contract adversarial sweep (ISSUE 10): every
+  registered contract's cases — the same shapes tier-1 runs in
+  interpret mode via tests/test_kernel_contracts.py — compiled on the
+  chip against their XLA oracles.
 
 The CPU shadow of these assertions rides tier-1 as
-tests/test_pallas_parity.py (marker pallas_parity, interpret mode).
+tests/test_pallas_parity.py + tests/test_kernel_contracts.py (markers
+pallas_parity / kernel_contract, interpret mode).
 
 Usage: python scripts/tpu_parity.py [out.json]
 """
@@ -185,13 +190,51 @@ def check_cagra(results):
     }
 
 
+def check_kernel_contracts(results):
+    """The adversarial kernel-contract sweep, COMPILED (ISSUE 10): the
+    exact shapes tier-1 drives in interpret mode
+    (tests/test_kernel_contracts.py) rerun against real Mosaic — the
+    same non-divisible tails, k==n, k==1, single-row, sublane-boundary
+    ±1 and lane-boundary-k corner cases, per case-seeded rng, so an
+    on-chip divergence reproduces standalone."""
+    from raft_tpu.analysis import contracts
+
+    out = {"cases": 0, "failures": []}
+    for name, c in contracts.load_all().items():
+        drv = c.resolve_driver()
+        for case in contracts.adversarial_cases(c):
+            if case.get("static_only"):
+                continue
+            out["cases"] += 1
+            try:
+                rep = drv(c, case, interpret=False)
+            except Exception as e:  # noqa: BLE001 - record, keep sweeping
+                rep = None
+                out["failures"].append(
+                    {"contract": name, "case": _case_key(case),
+                     "error": repr(e)[:200]})
+                continue
+            if not rep.ok:
+                out["failures"].append(
+                    {"contract": name, "case": _case_key(case),
+                     "kind": rep.kind, "detail": rep.detail[:200]})
+    out["ok"] = not out["failures"]
+    out["failures"] = out["failures"][:20]
+    results["kernel_contracts"] = out
+
+
+def _case_key(case):
+    return {k: v for k, v in case.items()
+            if isinstance(v, (int, str, bool))}
+
+
 def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else "PALLAS_PARITY.json"
     t0 = time.time()
     results = {"platform": jax.devices()[0].platform,
                "device": str(jax.devices()[0])}
     for fn in (check_ivf_scan, check_ivf_pq_scan, check_fused_topk,
-               check_beam_step, check_cagra):
+               check_beam_step, check_cagra, check_kernel_contracts):
         try:
             fn(results)
         except Exception as e:  # noqa: BLE001 - record, keep going

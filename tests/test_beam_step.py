@@ -18,44 +18,15 @@ from raft_tpu.distance.types import DistanceType
 from tests.oracles import eval_recall, naive_knn
 
 
-def _np_merge_oracle(bd, bi, be, cd, ci, L, width, window=2):
-    """Numpy oracle mirroring the kernel's exact semantics: sort the
-    concatenation, blank windowed duplicates IN PLACE (ghosts sink at
-    the *next* iteration's sort, as in the XLA path), truncate to L,
-    pick the first ``width`` unexplored."""
-    m = bd.shape[1]
-    LL = 1 << (L + cd.shape[0] - 1).bit_length()
-    od = np.full((L, m), np.inf, np.float32)
-    oi = np.full((L, m), -1, np.int32)
-    oe = np.ones((L, m), np.int32)
-    parents = np.full((width, m), -1, np.int32)
-    for c in range(m):
-        rows = list(zip(bd[:, c], bi[:, c], be[:, c])) + [
-            (cd[j, c], ci[j, c], 0) for j in range(cd.shape[0])
-        ]
-        rows += [(np.inf, -1, 1)] * (LL - len(rows))
-        rows.sort(key=lambda t: t[0])
-        dist = np.array([r[0] for r in rows], np.float32)
-        ids = np.array([r[1] for r in rows], np.int32)
-        expl = np.array([r[2] for r in rows], np.int32)
-        dup = np.zeros(LL, bool)
-        e = expl.copy()
-        for s in range(1, window + 1):
-            eq = (ids[s:] == ids[:-s]) & (ids[s:] >= 0)
-            dup[s:] |= eq
-            e[:-s] |= eq & (expl[s:] > 0)
-        dist = np.where(dup, np.inf, dist)
-        ids = np.where(dup, -1, ids)
-        e = np.where(dup, 1, e)
-        got = 0
-        for t in range(L):
-            od[t, c], oi[t, c], oe[t, c] = dist[t], ids[t], e[t]
-            if not e[t] and ids[t] >= 0 and np.isfinite(dist[t]) \
-                    and got < width:
-                parents[got, c] = ids[t]
-                oe[t, c] = 1
-                got += 1
-    return od, oi, oe, parents
+# THE oracle lives in one home (the kernel-contract drivers) so this
+# suite, the contract sweep, and tpu_parity's compiled rerun all judge
+# the kernel against identical semantics: sort the concatenation, blank
+# windowed duplicates IN PLACE (ghosts sink at the *next* iteration's
+# sort, as in the XLA path), truncate to L, pick the first ``width``
+# unexplored.
+from raft_tpu.analysis.contract_drivers import (  # noqa: E402
+    _np_beam_oracle as _np_merge_oracle,
+)
 
 
 def test_merge_step_matches_numpy_oracle():

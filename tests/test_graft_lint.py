@@ -11,6 +11,8 @@ import textwrap
 import pytest
 
 from raft_tpu.analysis.cli import main as cli_main
+from raft_tpu.analysis.kernels import lint_paths as kern_lint_paths
+from raft_tpu.analysis.kernels import lint_source as kern_lint_source
 from raft_tpu.analysis.lint import lint_paths, lint_source
 from raft_tpu.analysis.races import lint_paths as race_lint_paths
 from raft_tpu.analysis.races import lint_source as race_lint_source
@@ -22,6 +24,14 @@ PKG = os.path.join(REPO, "raft_tpu")
 
 def _rules(src, only=None):
     findings = lint_source(textwrap.dedent(src), "fixture.py")
+    open_f = [f for f in findings if not f.suppressed]
+    if only:
+        open_f = [f for f in open_f if f.rule == only]
+    return [f.rule for f in open_f], open_f
+
+
+def _kern_rules(src, only=None):
+    findings = kern_lint_source(textwrap.dedent(src), "fixture.py")
     open_f = [f for f in findings if not f.suppressed]
     if only:
         open_f = [f for f in open_f if f.rule == only]
@@ -249,12 +259,14 @@ def test_gl005_dated_negatives():
 
 
 # ---------------------------------------------------------------------------
-# GL006 blockspec
+# GL006 blockspec — the kern engine's literal FALLBACK screen (computed
+# accounting for resolved pallas_call sites is tested further below and
+# in test_kernel_contracts.py)
 # ---------------------------------------------------------------------------
 
 
 def test_gl006_off_tile_positive():
-    rules, _ = _rules("""
+    rules, _ = _kern_rules("""
         from jax.experimental import pallas as pl
 
         def kernel_specs():
@@ -265,7 +277,7 @@ def test_gl006_off_tile_positive():
 
 
 def test_gl006_vmem_budget_positive():
-    rules, _ = _rules("""
+    rules, _ = _kern_rules("""
         from jax.experimental import pallas as pl
 
         def huge():
@@ -275,7 +287,7 @@ def test_gl006_vmem_budget_positive():
 
 
 def test_gl006_negatives():
-    rules, _ = _rules("""
+    rules, _ = _kern_rules("""
         from jax.experimental import pallas as pl
 
         def ok(cap, g):
@@ -284,6 +296,616 @@ def test_gl006_negatives():
                     pl.BlockSpec((g, 256), lambda i: (i, 0))]
     """)
     assert rules == []
+
+
+def test_gl006_retired_from_ast_engine():
+    """The literal screen no longer runs in the AST engine — GL006 is
+    the kern engine's jurisdiction (computed accounting + fallback)."""
+    rules, _ = _rules("""
+        from jax.experimental import pallas as pl
+
+        def kernel_specs():
+            return pl.BlockSpec((16, 100), lambda i: (i, 0))
+    """)
+    assert rules == []
+
+
+def test_gl006_computed_vmem_over_budget():
+    """The tentpole: VMEM accounting through COMPUTED shapes — the
+    block size flows in from a caller, through arithmetic the literal
+    heuristic never saw."""
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x, rows):
+            big = rows * 1024
+            return pl.pallas_call(
+                kern,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((big, 1024), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((big, 1024), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((big, 1024), jnp.float32),
+            )(jnp.zeros((big, 1024), jnp.float32))
+
+        def caller(x):
+            return run(x, 8)
+    """)
+    assert rules == ["GL006"]
+    assert "witness" in fs[0].message
+
+
+def test_gl006_unevaluated_literal_spec_still_screened():
+    """Review fix (r6): a resolved site exempts only the spec nodes it
+    actually evaluated — a literal off-lane spec the interpreter never
+    reached (here: inside a loop with an unknowable condition) in the
+    SAME function must still hit the literal fallback screen."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x, flags):
+            extras = []
+            while flags.pop():
+                extras.append(pl.BlockSpec((16, 100), lambda i: (i, 0)))
+            return pl.pallas_call(
+                kern,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((256, 128), jnp.float32),
+            )(jnp.zeros((256, 128), jnp.float32))
+    """)
+    assert "GL006" in rules      # the (16, 100) literal, off-lane
+
+
+def test_gl006_resolved_site_not_double_flagged_by_literal_screen():
+    """A site the evaluator resolves gets computed checks only — the
+    literal screen must not re-flag its in-budget literal specs."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((256, 128), jnp.float32),
+            )(jnp.zeros((256, 128), jnp.float32))
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL015 kernel-oob (kern engine: index-map bounds + tail masks)
+# ---------------------------------------------------------------------------
+
+
+_OOB_SEED = """
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+def run(x):
+    return pl.pallas_call(
+        kern,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i + 1, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((512, 128), jnp.float32),
+    )(jnp.zeros((512, 128), jnp.float32))
+"""
+
+
+def test_gl015_index_map_out_of_bounds_positive():
+    rules, fs = _kern_rules(_OOB_SEED)
+    assert "GL015" in rules
+    assert any("out-of-bounds" in f.message for f in fs)
+
+
+def test_gl015_missing_tail_mask_positive():
+    """ceil-divided grid with a reachable remainder and no mask in the
+    kernel: pad garbage can win the reduction."""
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = jnp.sum(x_ref[...], axis=1, keepdims=True)
+
+        def run(x):
+            n = x.shape[0]
+            tiles = -(-n // 128)
+            xp = jnp.pad(x, ((0, tiles * 128 - n), (0, 0)))
+            return pl.pallas_call(
+                kern,
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 1), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((tiles * 128, 1),
+                                               jnp.float32),
+            )(xp)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert "GL015" in rules
+    assert any("tail" in f.message for f in fs)
+
+
+def test_gl015_masked_tail_negative():
+    """The same geometry WITH the in-kernel bound mask is clean — the
+    fused kernels' own idiom (dist = where(col < n, dist, inf))."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref, *, n):
+            i = pl.program_id(0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) \\
+                + i * 128
+            vals = jnp.where(col < n, x_ref[...], 0.0)
+            o_ref[...] = jnp.sum(vals, axis=1, keepdims=True)
+
+        def run(x):
+            import functools
+            n = x.shape[0]
+            tiles = -(-n // 128)
+            xp = jnp.pad(x, ((0, tiles * 128 - n), (0, 0)))
+            return pl.pallas_call(
+                functools.partial(kern, n=n),
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 1), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((tiles * 128, 1),
+                                               jnp.float32),
+            )(xp)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert "GL015" not in rules
+
+
+def test_gl015_value_clamp_is_not_mask_evidence():
+    """A numeric clamp (where(dist < 0, ...)) has an inequality but
+    masks nothing positional — it must NOT suppress the missing-tail-
+    mask finding (review fix, r6): evidence requires the condition to
+    involve an index-derived value (iota/program_id or a name computed
+    from one)."""
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            v = jnp.sum(x_ref[...], axis=1, keepdims=True)
+            v = jnp.where(v < 0.0, 0.0, v)      # clamp, not a mask
+            o_ref[...] = v
+
+        def run(x):
+            n = x.shape[0]
+            tiles = -(-n // 128)
+            xp = jnp.pad(x, ((0, tiles * 128 - n), (0, 0)))
+            return pl.pallas_call(
+                kern,
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 1), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((tiles * 128, 1),
+                                               jnp.float32),
+            )(xp)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert "GL015" in rules
+    assert any("tail" in f.message for f in fs)
+
+
+def test_gl015_named_index_mask_negative():
+    """The ivf_scan idiom: the mask rides a NAME computed from an iota
+    compare (valid = col < size; where(valid, ...)) — evidence."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        import functools
+
+        def kern(x_ref, o_ref, *, n):
+            i = pl.program_id(0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) \\
+                + i * 128
+            valid = col < n
+            o_ref[...] = jnp.sum(jnp.where(valid, x_ref[...], 0.0),
+                                 axis=1, keepdims=True)
+
+        def run(x):
+            n = x.shape[0]
+            tiles = -(-n // 128)
+            xp = jnp.pad(x, ((0, tiles * 128 - n), (0, 0)))
+            return pl.pallas_call(
+                functools.partial(kern, n=n),
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 1), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((tiles * 128, 1),
+                                               jnp.float32),
+            )(xp)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert "GL015" not in rules
+
+
+def test_gl015_floor_divided_grid_drops_rows_positive():
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            n = x.shape[0]
+            tiles = n // 128
+            return pl.pallas_call(
+                kern,
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((n, 128), jnp.float32),
+            )(x)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert "GL015" in rules
+    assert any("never visited" in f.message for f in fs)
+
+
+def test_gl015_guarded_divisibility_negative():
+    """A raise-guard on the remainder prunes the binding — beam_step's
+    `if m % g: raise` idiom makes the tail unreachable."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            n = x.shape[0]
+            if n % 128:
+                raise ValueError("n must be a multiple of 128")
+            tiles = n // 128
+            return pl.pallas_call(
+                kern,
+                grid=(tiles,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((n, 128), jnp.float32),
+            )(x)
+
+        def caller(x):
+            return run(jnp.zeros((300, 128), jnp.float32))
+    """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL016 tile-align (kern engine: computed block alignment)
+# ---------------------------------------------------------------------------
+
+
+_MISALIGNED_SEED = """
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def run(x, cols):
+    tile = 3 * cols + 1
+    return pl.pallas_call(
+        kern,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, tile), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, tile), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 4 * tile), jnp.float32),
+    )(jnp.zeros((32, 4 * tile), jnp.float32))
+
+def caller(x):
+    return run(x, 33)
+"""
+
+
+def test_gl016_computed_misaligned_tile_positive():
+    """The acceptance seed class: tile = 3*cols+1 = 100 is COMPUTED —
+    invisible to the literal screen, caught by abstract evaluation."""
+    rules, fs = _kern_rules(_MISALIGNED_SEED)
+    assert "GL016" in rules
+    assert any("dim 1 = 100" in f.message for f in fs)
+
+
+def test_gl016_block_equal_to_array_dim_negative():
+    """The real Mosaic rule: a block dim EQUAL to the array dim is
+    legal at any size (beam_step's (g, 4, dwq) qrep spec — the old
+    literal GL006 needed a suppression for it; the computed audit
+    proves it legal)."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = jnp.sum(x_ref[...], axis=1)
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((128, 4, 256),
+                                       lambda i: (i, 0, 0))],
+                out_specs=pl.BlockSpec((128, 256), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32),
+            )(jnp.zeros((256, 4, 256), jnp.float32))
+    """)
+    assert "GL016" not in rules
+
+
+def test_gl016_bf16_sublane_positive():
+    """dtype-aware sublane: 8 rows is a legal f32 sublane but OFF the
+    (16, 128) bf16 tile — the tile_geometry floor bug this engine
+    found in ops/fused_topk.py (fixed r6)."""
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.bfloat16),
+            )(jnp.zeros((32, 128), jnp.bfloat16))
+    """)
+    assert "GL016" in rules
+    assert any("bfloat16" in f.message and "sublane" in f.message
+               for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# GL017 grid-hazard (kern engine: revisited output refs)
+# ---------------------------------------------------------------------------
+
+
+_ACCUM_SEED = """
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def kern(x_ref, o_ref):
+    o_ref[...] = o_ref[...] + jnp.sum(x_ref[...], axis=1, keepdims=True)
+
+def run(x):
+    return pl.pallas_call(
+        kern,
+        grid=(4, 8),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((512, 128), jnp.float32),
+    )(jnp.zeros((512, 1024), jnp.float32))
+"""
+
+
+def test_gl017_uninitialized_accumulator_positive():
+    rules, fs = _kern_rules(_ACCUM_SEED)
+    assert "GL017" in rules
+    assert any("uninitialized" in f.message for f in fs)
+
+
+def test_gl017_plain_overwrite_positive():
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = jnp.sum(x_ref[...], axis=1, keepdims=True)
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 8),
+                in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((128, 1), lambda i, j: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((512, 1), jnp.float32),
+            )(jnp.zeros((512, 1024), jnp.float32))
+    """)
+    assert "GL017" in rules
+    assert any("clobbers" in f.message for f in fs)
+
+
+def test_gl017_init_guarded_accumulator_negative():
+    """The revisiting-safe pattern: first-step init via pl.when, then
+    accumulate — no finding."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            @pl.when(pl.program_id(1) == 0)
+            def _init():
+                o_ref[...] = jnp.zeros_like(o_ref)
+            o_ref[...] = o_ref[...] + jnp.sum(x_ref[...], axis=1,
+                                              keepdims=True)
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 8),
+                in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((512, 128), jnp.float32),
+            )(jnp.zeros((512, 1024), jnp.float32))
+    """)
+    assert "GL017" not in rules
+
+
+def test_gl017_guard_on_other_ref_does_not_launder():
+    """Init-guard evidence is PER REF (review fix, r6): out0's proper
+    pl.when init must not suppress out1's uninitialized accumulator."""
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, a_ref, b_ref):
+            @pl.when(pl.program_id(1) == 0)
+            def _init():
+                a_ref[...] = jnp.zeros_like(a_ref)
+            a_ref[...] = a_ref[...] + jnp.sum(x_ref[...], axis=1,
+                                              keepdims=True)
+            b_ref[...] = b_ref[...] + jnp.sum(x_ref[...], axis=1,
+                                              keepdims=True)
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 8),
+                in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+                out_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, 0)),
+                           pl.BlockSpec((128, 128), lambda i, j: (i, 0))],
+                out_shape=[
+                    jax.ShapeDtypeStruct((512, 128), jnp.float32),
+                    jax.ShapeDtypeStruct((512, 128), jnp.float32),
+                ],
+            )(jnp.zeros((512, 1024), jnp.float32))
+    """)
+    assert "GL017" in rules
+    msgs = [f.message for f in fs if f.rule == "GL017"]
+    assert any("'b_ref'" in m for m in msgs)
+    assert not any("'a_ref'" in m for m in msgs)
+
+
+def test_gl017_all_grid_dims_used_negative():
+    """An index map consuming every grid dim never revisits — the
+    shipped kernels' shape (fused_topk out specs are (i, j))."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 8),
+                in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)),
+                out_shape=jax.ShapeDtypeStruct((512, 1024), jnp.float32),
+            )(jnp.zeros((512, 1024), jnp.float32))
+    """)
+    assert "GL017" not in rules
+
+
+# ---------------------------------------------------------------------------
+# GL018 mxu-dtype (kern engine: in-kernel dot audit)
+# ---------------------------------------------------------------------------
+
+
+def test_gl018_operand_mismatch_positive():
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(a_ref, b_ref, o_ref):
+            a = a_ref[...].astype(jnp.bfloat16)
+            b = b_ref[...].astype(jnp.float32)
+            o_ref[...] = jax.lax.dot_general(
+                a, b, dimension_numbers=(((1,), (0,)), ((), ())))
+
+        def run(a, b):
+            return pl.pallas_call(
+                kern,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                          pl.BlockSpec((128, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            )(jnp.zeros((128, 128), jnp.float32),
+              jnp.zeros((128, 128), jnp.float32))
+    """)
+    assert "GL018" in rules
+    assert any("bfloat16 vs float32" in f.message for f in fs)
+
+
+def test_gl018_low_precision_accumulator_positive():
+    rules, fs = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(a_ref, b_ref, o_ref):
+            a = a_ref[...].astype(jnp.bfloat16)
+            b = b_ref[...].astype(jnp.bfloat16)
+            o_ref[...] = jax.lax.dot_general(
+                a, b, dimension_numbers=(((1,), (0,)), ((), ())))
+
+        def run(a, b):
+            return pl.pallas_call(
+                kern,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                          pl.BlockSpec((128, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            )(jnp.zeros((128, 128), jnp.float32),
+              jnp.zeros((128, 128), jnp.float32))
+    """)
+    assert "GL018" in rules
+    assert any("preferred_element_type" in f.message for f in fs)
+
+
+def test_gl018_matched_operands_with_preferred_negative():
+    """The shipped kernels' idiom: same matmul dtype on both operands +
+    f32 accumulation — clean."""
+    rules, _ = _kern_rules("""
+        import jax, jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(a_ref, b_ref, o_ref):
+            a = a_ref[...].astype(jnp.bfloat16)
+            b = b_ref[...].astype(jnp.bfloat16)
+            o_ref[...] = jax.lax.dot_general(
+                a, b, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        def run(a, b):
+            return pl.pallas_call(
+                kern,
+                grid=(1,),
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                          pl.BlockSpec((128, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((128, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((128, 128), jnp.float32),
+            )(jnp.zeros((128, 128), jnp.float32),
+              jnp.zeros((128, 128), jnp.float32))
+    """)
+    assert "GL018" not in rules
 
 
 # ---------------------------------------------------------------------------
@@ -1086,6 +1708,78 @@ def test_cli_acceptance_seeds(tmp_path, capsys, seed, rule):
 # ---------------------------------------------------------------------------
 # the tier-1 gate (AST half; jaxpr half in test_jaxpr_audit.py)
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# graft-kern CLI acceptance seeds + the kern gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed, rule", [
+    (_OOB_SEED, "GL015"),
+    (_MISALIGNED_SEED, "GL016"),
+    (_ACCUM_SEED, "GL017"),
+])
+def test_cli_kern_acceptance_seeds(tmp_path, capsys, seed, rule):
+    """ISSUE 10 acceptance: each planted kernel bug (OOB index map /
+    misaligned computed tile / unsafe grid accumulator) exits rc 1
+    naming its rule under --engine=kern."""
+    (tmp_path / "seeded.py").write_text(seed)
+    rc = cli_main(["--engine=kern", "--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == rule and f["engine"] == "kern"
+               for f in out["findings"]), out
+
+
+def test_cli_engine_kern_and_all_spellings(tmp_path, capsys):
+    """--engine=kern is comma-composable and included in 'all'."""
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    assert cli_main(["--engine=kern", "--format=json", str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert cli_main(["--engine=ast,kern", "--format=json",
+                     str(tmp_path)]) == 0
+    capsys.readouterr()
+    (tmp_path / "seeded.py").write_text(_OOB_SEED)
+    rc = cli_main(["--engine=ast,races,kern", "--format=json",
+                   str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "GL015" for f in out["findings"]), out
+
+
+@pytest.fixture(scope="module")
+def kern_gate_findings():
+    return kern_lint_paths([PKG])
+
+
+@pytest.mark.static_analysis
+def test_gate_tree_is_kernel_clean(kern_gate_findings):
+    """ISSUE 10 acceptance: graft-lint --engine=kern raft_tpu/ runs
+    clean — 0 open findings, reasoned suppressions only."""
+    open_f = [f for f in kern_gate_findings if not f.suppressed]
+    assert not open_f, "unsuppressed graft-kern findings:\n" + "\n".join(
+        f.render() for f in open_f)
+
+
+@pytest.mark.static_analysis
+def test_gate_kern_suppressions_all_have_reasons(kern_gate_findings):
+    for f in kern_gate_findings:
+        if f.suppressed:
+            assert f.reason and f.reason != "(no reason given)", f.render()
+
+
+@pytest.mark.static_analysis
+def test_gate_engine_all_includes_kern(tmp_path, capsys):
+    """The 'all' gate = every engine; a planted kernel bug must fail it
+    even when the other engines are clean."""
+    (tmp_path / "seeded.py").write_text(_ACCUM_SEED)
+    rc = cli_main(["--engine=ast,races,kern", "--format=json",
+                   str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["engine"] for f in out["findings"]} == {"kern"}, out
 
 
 @pytest.mark.static_analysis

@@ -1,0 +1,123 @@
+"""Kernel-contract harness (marker: ``kernel_contract``, tier-1).
+
+Every Pallas kernel in ``ops/`` (and the kernel-shaped select_k rungs)
+registers a :class:`~raft_tpu.analysis.contracts.KernelContract`; this
+module drives each contract's ADVERSARIAL shape sweep in interpret mode
+against XLA oracles — non-divisible rows, ``k == n``, ``k == 1``,
+single-row batches, sublane-boundary ±1 row counts, lane-boundary k,
+every declared dtype (docs/static_analysis.md §engine-4). The same
+cases feed the graft-kern static verifier's bindings, so the static
+geometry audit and this dynamic sweep cross-check each other; the
+on-chip rerun of the same cases lives in ``scripts/tpu_parity.py``.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.analysis import contracts
+
+pytestmark = pytest.mark.kernel_contract
+
+CONTRACTS = contracts.load_all()
+
+
+def _all_cases():
+    out = []
+    for name, c in CONTRACTS.items():
+        for i, case in enumerate(contracts.adversarial_cases(c)):
+            if case.get("static_only"):
+                continue
+            label = "-".join(
+                f"{k}={case[k]}" for k in ("impl", "variant", "extract",
+                                           "dtype", "k", "n", "cap", "L",
+                                           "m")
+                if k in case and isinstance(case[k], (int, str)))
+            out.append(pytest.param(name, case, id=f"{name}-{i}-{label}"))
+    return out
+
+
+@pytest.mark.parametrize("cname, case", _all_cases())
+def test_contract_case(cname, case):
+    c = CONTRACTS[cname]
+    rep = c.resolve_driver()(c, case, interpret=True)
+    assert rep.ok, (cname, case, rep)
+
+
+# ---------------------------------------------------------------------------
+# registry + sweep-shape sanity (the cross-check contract)
+# ---------------------------------------------------------------------------
+
+
+def test_every_ops_kernel_has_a_contract():
+    """ISSUE 10 acceptance: every kernel module in ops/ registers a
+    contract (a new kernel without one fails here, not in review)."""
+    modules = {c.module for c in CONTRACTS.values()}
+    for mod in ("raft_tpu.ops.fused_topk", "raft_tpu.ops.ivf_scan",
+                "raft_tpu.ops.beam_step", "raft_tpu.matrix.select_k"):
+        assert mod in modules, f"{mod} has no kernel contract"
+
+
+def test_sweep_covers_the_adversarial_classes():
+    """The generator actually produces the classes the ISSUE names."""
+    for name, c in CONTRACTS.items():
+        cases = contracts.adversarial_cases(c)
+        assert cases, name
+        dtypes = {x.get("dtype") for x in cases} - {None}
+        assert dtypes >= set(c.dtypes), (name, dtypes)
+        if c.k_key:
+            ks = {x.get(c.k_key) for x in cases}
+            assert c.k_range[0] in ks, (name, "k == lo missing")
+            if c.k_range[0] != 1 and 1 >= c.k_range[0]:
+                assert 1 in ks, (name, "k == 1 missing")
+        if c.rows_key:
+            rows = {x.get(c.rows_key) for x in cases}
+            base_rows = c.base[c.rows_key]
+            assert base_rows + 13 in rows, (name, "non-divisible rows "
+                                                  "missing")
+            # k == rows (the whole-row edge)
+            assert any(x.get(c.k_key) == x.get(c.rows_key)
+                       for x in cases), (name, "k == rows missing")
+            # sublane boundary ±1 for the primary dtype
+            s = contracts.dtype_sublane(c.dtypes[0])
+            assert {s - 1, s, s + 1} & rows, (name, "sublane boundary "
+                                                    "missing")
+        if c.batch_key:
+            assert any(x.get(c.batch_key) == 1 for x in cases), \
+                (name, "single-row batch missing")
+
+
+def test_static_engine_resolves_contracted_sites():
+    """The cross-check's other half: the graft-kern static engine must
+    fully resolve (exact VMEM accounting, computed blocks) every
+    pallas_call in a contracted module — if it ever degrades to the
+    literal fallback there, the computed audit has silently gone dark."""
+    import os
+
+    from raft_tpu.analysis.kernels import FileKernelVerifier
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("raft_tpu/ops/fused_topk.py", "raft_tpu/ops/ivf_scan.py",
+                "raft_tpu/ops/beam_step.py"):
+        path = os.path.join(repo, rel)
+        with open(path) as f:
+            v = FileKernelVerifier(path, f.read())
+        v.run()
+        assert v.report["sites"] >= 1, rel
+        assert v.report["resolved"] == v.report["sites"], (rel, v.report)
+
+
+def test_case_seeds_are_deterministic():
+    """Failures must reproduce standalone: the per-case rng is seeded
+    from the case content — STABLY ACROSS PROCESSES (a salted hash()
+    would regenerate different data per rerun), pinned by the literal
+    first draw below."""
+    from raft_tpu.analysis.contract_drivers import _rng
+
+    case = {"m": 4, "n": 16, "k": 2, "dtype": "float32"}
+    a = _rng(dict(case)).standard_normal(8)
+    b = _rng(dict(case)).standard_normal(8)
+    np.testing.assert_array_equal(a, b)
+    # cross-process stability: the seed is crc32-derived, so this first
+    # draw is a constant of the case content, not of the interpreter
+    np.testing.assert_allclose(a[0], _rng(case).standard_normal(1)[0])
+    assert abs(float(a[0]) - 1.3822953003467113) < 1e-12, float(a[0])

@@ -127,6 +127,37 @@ def test_fused_topk_pad_rows_never_selected(rng):
     assert (np.sort(np.asarray(oi), axis=1) == np.arange(10)).all()
 
 
+def test_fused_topk_fold_rejects_off_lane_tile(rng):
+    """Regression (r6, graft-kern dogfood): an explicit non-lane-
+    multiple tile_n reached the fold arm unvalidated and
+    fold_lane_stacks silently DROPPED the tail columns from the
+    reduction — rows in the dropped tail could never be returned."""
+    q, x = _bf_data(rng, m=8, n=700, d=16)
+    with pytest.raises(ValueError, match="tile_n % 128"):
+        fused_topk(jnp.asarray(q), jnp.asarray(x), 10, metric_kind=L2,
+                   variant="fold", tile_n=300, interpret=True)
+    # exact arm is tail-masked per column, not lane-folded: any tile ok
+    od, oi = fused_topk(jnp.asarray(q), jnp.asarray(x), 10,
+                        metric_kind=L2, variant="exact", tile_n=300,
+                        interpret=True)
+    np.testing.assert_array_equal(np.asarray(oi), _l2_oracle(q, x, 10))
+
+
+def test_tile_geometry_sublane_floor_is_dtype_aware():
+    """Regression (r6, found by graft-kern's computed GL016 audit): the
+    query-tile floor was a flat 8, putting the bf16 fast path's q-block
+    off the (16, 128) tile at m <= 8."""
+    from raft_tpu.ops.fused_topk import tile_geometry
+
+    assert tile_geometry(4, 1000, 32, 10, "exact", itemsize=4)["tile_q"] == 8
+    assert tile_geometry(4, 1000, 32, 10, "exact", itemsize=2)["tile_q"] == 16
+    assert tile_geometry(8, 1000, 32, 10, "fold", itemsize=2)["tile_q"] == 16
+    # 1-byte operands need the (32, 128) tile (review fix, r6)
+    assert tile_geometry(4, 1000, 32, 10, "exact", itemsize=1)["tile_q"] == 32
+    assert tile_geometry(200, 1000, 32, 10, "exact",
+                         itemsize=2)["tile_q"] == 128
+
+
 def test_fused_topk_brute_force_wiring(rng):
     """The brute_force.search impl plumbing end to end on CPU: the
     fused interpret path must return the scan path's answer (same
@@ -214,6 +245,60 @@ def test_list_scan_binned_arms_recall_band(rng, extract):
         for b in range(oi.shape[0]) for g in range(oi.shape[1])
     ])
     assert hits > 0.93, (extract, hits)   # tpu_parity's binned band
+
+
+def test_binned_loss_model_single_home():
+    """Review fix (r6): the (k-1)/256 collision-loss model lives in ONE
+    place — the entry point, the contract sweep filter, and the
+    microbench candidate set all call it, so they cannot drift."""
+    from raft_tpu.analysis import contracts
+    from raft_tpu.ops.ivf_scan import (
+        DEFAULT_RECALL_TARGET,
+        binned_k_cap,
+        binned_loss_fits,
+    )
+
+    assert binned_k_cap() == 13                     # 0.95 default
+    assert binned_loss_fits(13) and not binned_loss_fits(14)
+    assert binned_k_cap(0.8) > binned_k_cap()       # looser budget
+    assert binned_loss_fits(64, recall_target=0.0)  # forcing mode
+    assert DEFAULT_RECALL_TARGET == 0.95
+    # the contract's binned arm tracks the model, not a constant
+    c = contracts.load_all()["ivf_scan"]
+    arm = next(a for a in c.arms if a.get("extract") == "binned")
+    assert arm["k_max"] == binned_k_cap()
+
+
+def test_list_scan_binned_eligibility_is_loss_aware(rng):
+    """Regression (r6, found by the kernel-contract sweep's
+    lane-boundary cases): single-slot binning loses ~(k-1)/256 of each
+    list's top-k, so the old flat k <= 64 eligibility admitted ~25%
+    loss at k=64 against a 0.95 per-list recall target. The entry point
+    now rejects the arm when the loss model exceeds the caller's
+    budget; a recall_target <= 0 (the microbench racing arms for time)
+    keeps it forceable."""
+    storage, ids, sizes, buckets, qv = _scan_workload(rng)
+    qj = jnp.asarray(qv)
+    qaux = jnp.sum(qj * qj, axis=2)
+    norms = jnp.asarray((storage ** 2).sum(2))
+    args = (jnp.asarray(storage), jnp.asarray(ids), jnp.asarray(sizes),
+            jnp.asarray(buckets), qj, qaux, norms, None)
+    with pytest.raises(ValueError, match="not eligible"):
+        ivf_scan.fused_list_scan_topk(
+            *args, k=64, metric_kind=ivf_scan.L2, approx=True,
+            interpret=True, extract="binned")
+    # at the boundary the model admits (k=13: loss ~4.7% <= 5%) the
+    # arm still clears the documented band
+    want = _scan_oracle(storage, ids, buckets, qv, 13)
+    od, oi = ivf_scan.fused_list_scan_topk(
+        *args, k=13, metric_kind=ivf_scan.L2, approx=True,
+        interpret=True, extract="binned")
+    oi = np.asarray(oi)
+    hits = np.mean([
+        len(np.intersect1d(oi[b, g], want[b, g])) / 13
+        for b in range(oi.shape[0]) for g in range(oi.shape[1])
+    ])
+    assert hits > 0.93, hits
 
 
 def test_list_scan_fold_width_and_invalids(rng):

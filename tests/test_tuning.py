@@ -398,3 +398,42 @@ def test_capture_emits_valid_loadable_table(tmp_path, monkeypatch):
                       {"n": 1024, "k": 16, "batch": 2, "dtype": "float32"},
                       ["top_k", "tournament", "hierarchical"], "FB")
     assert w in ("top_k", "tournament", "hierarchical")
+
+
+def test_fused_topk_candidate_enumeration_is_shared():
+    """ISSUE 10 satellite: ONE home for the fused-tile candidate set —
+    brute_force dispatches over exactly these strings and graft-kern
+    audits exactly these values."""
+    impls = tuning.fused_topk_candidate_impls(10, approx_ok=True)
+    assert impls == [f"fused_exact:{t}" for t in tuning.FUSED_TOPK_TILES] \
+        + [f"fused_fold:{t}" for t in tuning.FUSED_TOPK_TILES]
+    # variant extraction budgets: exact caps at 128, fold at 256
+    assert all(s.startswith("fused_fold") for s in
+               tuning.fused_topk_candidate_impls(200, approx_ok=True))
+    assert tuning.fused_topk_candidate_impls(300, approx_ok=True) == []
+    assert all(s.startswith("fused_exact") for s in
+               tuning.fused_topk_candidate_impls(64, approx_ok=False))
+
+
+def test_kernel_shape_candidates_cover_winner_domain(tmp_path):
+    """The verifier's audited tile domain = the canonical race set, the
+    analytic halving floor, and any extra tile a site-captured table's
+    winner strings carry."""
+    doms = tuning.kernel_shape_candidates()
+    for t in tuning.FUSED_TOPK_TILES:
+        assert t in doms["tile_n"]
+    assert tuning.FUSED_TOPK_TILE_FLOOR in doms["tile_n"]
+    assert doms["variant"] == ("exact", "fold")
+    # a site-captured table with a custom tile widens the domain
+    t = DispatchTable({"version": 1, "backend": "x", "ops": {
+        "fused_topk_tile": {"entries": [
+            {"key": {"m": 1, "n": 2, "d": 3, "k": 4},
+             "times_ms": {"fused_exact:768": 1.0},
+             "winner": "fused_exact:768"}]}}, })
+    path = tmp_path / "x.json"
+    t.save(str(path))
+    tuning.set_table_path(str(path))
+    try:
+        assert 768 in tuning.kernel_shape_candidates()["tile_n"]
+    finally:
+        tuning.set_table_path(None)
