@@ -335,6 +335,50 @@ def bench_ivfpq_deep10m(results):
     except Exception as e:  # noqa: BLE001 - keep the headline alive
         results["ivfpq_cache_refined_error"] = repr(e)[:200]
 
+    # + the rabitq rung (ISSUE 11): 1-bit sign-code first stage + exact
+    # rerank from the PQ codes — the rows-per-HBM-byte ladder's bottom
+    # step. Emits TWO byte columns per arm (cost model:
+    # ivf_pq.scan_bytes_per_row): the roofline row carries the honest
+    # total traffic (codes + estimator scalars + id/slot row), and
+    # *_code_bytes_per_row carries the quantized payload alone — the
+    # ladder figure where i4 → rabitq is the full 4x (rot/2 vs rot/8)
+    try:
+        index_rbq = ivf_pq.attach_rabitq_cache(index)
+        np.asarray(index_rbq.cache_fac[0, 0])              # sync attach
+        rot = int(index.rot_dim)
+        kc_rb = 4 * k
+
+        def search_rabitq(qq, ix):
+            return ivf_pq.search_refined(sp, ix, qq, k, refine_ratio=4)
+
+        _, idx_rb = search_rabitq(q, index_rbq)
+        results["ivfpq_rabitq_recall"] = round(float(
+            compute_recall(np.asarray(idx_rb[:sub]), np.asarray(mi))), 3)
+        s = _median_s(results, "ivfpq_rabitq", lambda: scan_qps_time(
+            search_rabitq, q, n1=n1, n2=n2, operands=index_rbq),
+            n_draws=3)
+        results["ivfpq_rabitq_qps"] = round(nq / s, 1)
+        # first-stage-only roofline (the scan the compression ladder
+        # multiplies): timed at the pipeline's shortlist width
+        s1 = _median_s(results, "ivfpq_rabitq_stage1",
+                       lambda: scan_qps_time(
+                           lambda qq, ix: ivf_pq.search(sp, ix, qq, kc_rb),
+                           q, n1=n1, n2=n2, operands=index_rbq),
+                       n_draws=3)
+        rb_code, rb_total = ivf_pq.scan_bytes_per_row("rabitq", rot)
+        i4_code, i4_total = ivf_pq.scan_bytes_per_row("i4", rot)
+        _emit_roofline(
+            results, "ivfpq_rabitq_stage1",
+            bytes_moved=rows_pq * rb_total + nq * d * 4,
+            flops=rows_pq * 2 * rot,
+            seconds=s1, rows=rows_pq)
+        results["ivfpq_rabitq_code_bytes_per_row"] = rb_code
+        results["ivfpq_i4_code_bytes_per_row"] = i4_code
+        results["ivfpq_i4_scan_bytes_per_row"] = i4_total
+        del index_rbq
+    except Exception as e:  # noqa: BLE001 - keep the headline alive
+        results["ivfpq_rabitq_error"] = repr(e)[:200]
+
 
 def main():
     # --obs-snapshot [PATH]: run instrumented (graft-scope, RAFT_TPU_OBS

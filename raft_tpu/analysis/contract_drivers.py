@@ -188,25 +188,66 @@ def drive_list_scan(contract, case: dict, interpret: bool = True
     C, cap, d = case["C"], case["cap"], case["d"]
     G, nb, k = case["G"], case["nb"], case["k"]
     extract = case["extract"]
+    rabitq = bool(case.get("rabitq"))
     dtype = jnp.dtype(case.get("dtype", "float32"))
     storage = rng.standard_normal((C, cap, d)).astype(np.float32)
     ids = np.arange(C * cap, dtype=np.int32).reshape(C, cap)
     buckets = (np.arange(nb, dtype=np.int32) % C)
-    qv = jnp.asarray(rng.standard_normal((nb, G, d)).astype(np.float32),
-                     dtype)
+    if rabitq:
+        # materialize the sign-bit arm: storage rows become ±1 codes
+        # packed 32/word (transposed [C, nw, cap]), per-row correction
+        # fac = ||r||²/||r||₁, norms = TRUE ||r||², queries zero-padded
+        # to the word width. The effective scanned vectors — what the
+        # XLA oracle below scores — are the dequantized r̂ = fac·sign(r)
+        # with the stored true-norm term.
+        dp = case["dp"]
+        signs = np.where(storage > 0, 1.0, -1.0).astype(np.float32)
+        bits = (storage > 0).astype(np.uint32)
+        bits = np.concatenate(
+            [bits, np.zeros((C, cap, dp - d), np.uint32)], axis=2)
+        words = (bits.reshape(C, cap, dp // 32, 32)
+                 << np.arange(32, dtype=np.uint32)).sum(
+                     axis=3, dtype=np.uint32)
+        packed = np.swapaxes(words, 1, 2)                  # [C, nw, cap]
+        l1 = np.abs(storage).sum(2)
+        n2 = (storage ** 2).sum(2)
+        fac = (n2 / np.maximum(l1, 1e-30)).astype(np.float32)
+        # oracle scans the estimator's own arithmetic: dequantized rows
+        # r̂ (zero-padded) against the padded query, true norms
+        eff = signs * fac[:, :, None]                      # [C, cap, d]
+        eff = np.concatenate(
+            [eff, np.zeros((C, cap, dp - d), np.float32)], axis=2)
+        true_norms = n2.astype(np.float32)
+        qfull = rng.standard_normal((nb, G, d)).astype(np.float32)
+        qpad = np.concatenate(
+            [qfull, np.zeros((nb, G, dp - d), np.float32)], axis=2)
+        qv = jnp.asarray(qpad, dtype)
+    else:
+        qv = jnp.asarray(rng.standard_normal((nb, G, d)).astype(np.float32),
+                         dtype)
     # two passes over the SAME shapes: full lists, then short lists
     # (the live-size tail the extraction must mask) — no extra trace
     for size in (cap, max(1, min(cap, k) if k < cap else cap // 2 + 1)):
         sizes = np.full((C,), size, np.int32)
         q32 = qv.astype(jnp.float32)
         qaux = jnp.sum(q32 * q32, axis=2)
-        norms = jnp.asarray((storage ** 2).sum(2).astype(np.float32))
-        od, oi = ivf_scan.fused_list_scan_topk(
-            jnp.asarray(storage), jnp.asarray(ids), jnp.asarray(sizes),
-            jnp.asarray(buckets), qv, qaux, norms, None,
-            k=k, metric_kind=ivf_scan.L2,
-            approx=extract != "exact", interpret=interpret,
-            extract=extract)
+        if rabitq:
+            norms = jnp.asarray(true_norms)
+            od, oi = ivf_scan.fused_list_scan_topk(
+                jnp.asarray(packed), jnp.asarray(ids), jnp.asarray(sizes),
+                jnp.asarray(buckets), qv, qaux, norms, None,
+                row_scale=jnp.asarray(fac),
+                k=k, metric_kind=ivf_scan.L2,
+                approx=extract != "exact", interpret=interpret,
+                packed_bits=True, extract=extract)
+        else:
+            norms = jnp.asarray((storage ** 2).sum(2).astype(np.float32))
+            od, oi = ivf_scan.fused_list_scan_topk(
+                jnp.asarray(storage), jnp.asarray(ids), jnp.asarray(sizes),
+                jnp.asarray(buckets), qv, qaux, norms, None,
+                k=k, metric_kind=ivf_scan.L2,
+                approx=extract != "exact", interpret=interpret,
+                extract=extract)
         if extract == "fold":
             nb_, G_, kc = oi.shape
             od2, oi2 = merge_topk(
@@ -218,17 +259,30 @@ def drive_list_scan(contract, case: dict, interpret: bool = True
         bad = _invalid_slots_ok(od, oi)
         if bad:
             return CaseReport(False, "error", f"size={size}: {bad}")
-        # oracle: the kernel's expanded arithmetic over the live rows
+        # oracle: the kernel's expanded arithmetic over the live rows —
+        # for the rabitq arm that means dot against the DECODED ±1
+        # signs first, THEN the per-row fac scale (matching the
+        # kernel's S·fac association; fac-premultiplied rows would
+        # round differently and flip near-ties on the bitwise arm)
         want = np.full((nb, G, k), -1, np.int64)
         for b in range(nb):
-            blk = jnp.asarray(storage[buckets[b]], dtype)
+            if rabitq:
+                sp_ = np.concatenate(
+                    [signs[buckets[b]],
+                     -np.ones((cap, dp - d), np.float32)], axis=1)
+                blk = jnp.asarray(sp_, dtype)
+            else:
+                blk = jnp.asarray(storage[buckets[b]], dtype)
             dots = jax.lax.dot_general(
                 qv[b], blk, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            dots = np.asarray(dots)
+            if rabitq:
+                dots = dots * fac[buckets[b]][None, :]
             qn = np.asarray(qaux[b])
             xn = np.asarray(norms[buckets[b]])
             dist = np.maximum(qn[:, None] + xn[None, :]
-                              - 2.0 * np.asarray(dots), 0.0)
+                              - 2.0 * dots, 0.0)
             dist[:, size:] = np.inf
             order = np.argsort(dist, axis=1, kind="stable")[:, :k]
             w = ids[buckets[b]][order]

@@ -363,6 +363,15 @@ def sharded_ivf_pq_search(
     )
     bucket_batch = int(search_params.bucket_batch)
     per_cluster = int(index.codebook_kind) == ivf_pq.codebook_gen.PER_CLUSTER
+    if index.cache_kind == "rabitq":
+        # the sharded local scan discriminates uint32 caches by
+        # cache_scales and would silently score sign-bit words as pq4
+        # codes; the rabitq rung shards as per-shard PIPELINES instead
+        raise ValueError(
+            "sharded_ivf_pq_search does not scan the rabitq cache yet — "
+            "run ivf_pq.search_refined per shard (the multi-stage "
+            "pipeline) or shard an i8/i4/pq4-cache index"
+        )
     has_cache = index.recon_cache is not None
     lut = ivf_pq._norm_dtype_knob(search_params.lut_dtype)
     if lut == "i8" and index.cache_kind not in ("i8", "i4"):
@@ -401,7 +410,8 @@ def sharded_ivf_pq_search(
                       else indices)
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
                   search_ids, list_sizes, rec_norms, None, cache,
-                  jnp.float32(index.recon_scale), scales, qnorms)
+                  jnp.float32(index.recon_scale), scales, qnorms,
+                  None)      # cache_fac: rabitq rejected above
         d, i = ivf_pq._pq_search(
             arrays, int(k_search), n_probes, metric, group, bucket_batch,
             int(index.codebook_kind), 0,

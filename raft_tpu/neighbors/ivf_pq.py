@@ -56,8 +56,9 @@ from raft_tpu.neighbors.ivf_flat import (
 from raft_tpu.utils.math import round_up_to_multiple
 from raft_tpu.utils.precision import dist_dot
 
-_SERIAL_VERSION = 3  # v3: serialized cache for cache-only indexes
-# (v2: bit-packed uint32 code words + pq_dim in meta)
+_SERIAL_VERSION = 4  # v4: rabitq sign-bit cache (cache_fac sidecar)
+# (v3: serialized cache for cache-only indexes;
+#  v2: bit-packed uint32 code words + pq_dim in meta)
 
 
 class codebook_gen:
@@ -190,7 +191,12 @@ class Index:
     recon_cache: object = None
     recon_scale: float = 1.0
     cache_scales: object = None      # [n_lists, rot_dim] f32 (int4 only)
-    cache_qnorms: object = None      # [n_lists, cap] f32 (int4 cache only)
+    cache_qnorms: object = None      # [n_lists, cap] f32 (i4/rabitq caches)
+    # rabitq per-row correction fac = ||r||²/||r||₁ ([n_lists, cap] f32):
+    # the RaBitQ estimator's scalar — <q, r> ≈ fac · Σ_j sign(r_j)·q_j.
+    # Presence discriminates the rabitq sign-bit cache from the other
+    # uint32 kinds (see cache_kind)
+    cache_fac: object = None
     cache_decoded: bool = True
     cache_dtype: str = "auto"
 
@@ -227,11 +233,16 @@ class Index:
         """Which fused-scan operand the index carries: "i8" (int8 decoded
         residuals), "i4" (packed int4 raw residuals + per-list scales),
         "pq4" (transposed packed 4-bit codes — exact one-hot code scan),
-        or "none". The u32 kinds are discriminated by cache_scales: the
-        i4 residual cache cannot exist without its per-list scales."""
+        "rabitq" (packed sign bits + per-row norm/fac scalars — the
+        ~32×-compressed first-stage rung), or "none". The u32 kinds are
+        discriminated by their scalar sidecars: rabitq cannot exist
+        without cache_fac, the i4 residual cache not without its
+        per-list scales."""
         if self.recon_cache is None:
             return "none"
         if self.recon_cache.dtype == jnp.uint32:
+            if self.cache_fac is not None:
+                return "rabitq"
             return "i4" if self.cache_scales is not None else "pq4"
         return "i8"
 
@@ -240,7 +251,7 @@ jax.tree_util.register_dataclass(
     Index,
     data_fields=["centers", "centers_rot", "rotation", "pq_centers", "codes",
                  "indices", "list_sizes", "rec_norms", "recon_cache",
-                 "cache_scales", "cache_qnorms"],
+                 "cache_scales", "cache_qnorms", "cache_fac"],
     meta_fields=["metric", "pq_dim_", "metric_arg", "codebook_kind",
                  "pq_bits", "recon_scale", "cache_decoded", "cache_dtype"],
 )
@@ -727,7 +738,7 @@ def _build_streamed_impl(
         raise ValueError(
             "cache_dtype='pq4' is not supported by build_streamed (the "
             "transposed-code cache is attached by the batch build); use "
-            "cache_dtype='auto'/'i8'/'i4' here"
+            "cache_dtype='auto'/'i8'/'i4'/'rabitq' here"
         )
     i4_possible = (
         params.cache_decoded and index.rot_dim % 8 == 0
@@ -753,7 +764,13 @@ def _build_streamed_impl(
                  or (_cap_bound is not None
                      and _cap_bound // 2 <= _CACHE_BUDGET))
         )
-        if not (i8_can or i4_can):
+        # rabitq: sign bits + 2 f32 scalars per row — feasible whenever
+        # its (much smaller) footprint fits; streamed scatter mirrors i4
+        rabitq_can = (
+            cd in ("auto", "rabitq") and params.cache_decoded
+            and n * (bits_words(index.rot_dim) * 4 + 8) <= _CACHE_BUDGET
+        )
+        if not (i8_can or i4_can or rabitq_can):
             raise ValueError(
                 "keep_codes=False requires a residual cache but no "
                 f"cache_dtype={cd!r} kind can fit _CACHE_BUDGET at "
@@ -766,12 +783,23 @@ def _build_streamed_impl(
         # padding inflates C*cap past n — and unlike "auto" it has no
         # i4 fallback to degrade to. Mirror _i8_may_miss's conservative
         # <= 2x padding factor and warn up front (ADVICE r5 finding 4).
-        if cd != "auto" and not (_cap_bound is not None
-                                 and (_cap_bound if cd == "i8"
-                                      else _cap_bound // 2)
-                                 <= _CACHE_BUDGET):
-            floor = (n * index.rot_dim if cd == "i8"
-                     else n * index.rot_dim // 2)
+        if cd != "auto":
+            # per-kind padded-ceiling bytes (from the cap_rows element
+            # bound) and optimistic row-floor bytes; rabitq's row cost
+            # is its word+scalar bytes, not a rot fraction
+            if cd == "rabitq":
+                _rb = bits_words(index.rot_dim) * 4 + 8
+                _ceil = (None if _cap_bound is None
+                         else (_cap_bound // index.rot_dim) * _rb)
+                floor = n * _rb
+            else:
+                _ceil = (None if _cap_bound is None
+                         else (_cap_bound if cd == "i8"
+                               else _cap_bound // 2))
+                floor = (n * index.rot_dim if cd == "i8"
+                         else n * index.rot_dim // 2)
+        if cd != "auto" and not (_ceil is not None
+                                 and _ceil <= _CACHE_BUDGET):
             if floor * 2 > _CACHE_BUDGET:
                 import warnings
 
@@ -928,6 +956,7 @@ def _build_streamed_impl(
     if cache_kind != "i4":
         scale = jnp.maximum(jnp.max(jnp.abs(index.pq_centers)), 1e-30) / 127.0
     nw4 = rot // 8
+    nwb = bits_words(rot)
 
     # ---- pass 2: encode + donated scatter into the final layout ------
     # accumulators stay FLAT [C*cap, ...] through the loop: a 2-D-indexed
@@ -939,7 +968,8 @@ def _build_streamed_impl(
     # per-element (nw4 words per row) with 2-D (row, col) indices, which
     # keep every coordinate under int32 where a flat element index
     # overflows at 100M scale.
-    want_qnorms = cache_kind == "i4" and keep_codes
+    want_qnorms = cache_kind in ("i4", "rabitq") and keep_codes
+    want_fac = cache_kind == "rabitq"
     if _phase == "pass2":
         # restored accumulators ONLY — allocating the zero set first
         # would double peak HBM exactly when a resume is memory-tight
@@ -948,6 +978,8 @@ def _build_streamed_impl(
         acc_cache = jnp.asarray(_a["acc_cache"])
         acc_norms = jnp.asarray(_a["acc_norms"])
         acc_qnorms = jnp.asarray(_a["acc_qnorms"])
+        acc_fac = (jnp.asarray(_a["acc_fac"]) if "acc_fac" in _a
+                   else jnp.zeros((0,), jnp.float32))
         acc_ids = jnp.asarray(_a["acc_ids"])
         fill = jnp.asarray(_a["fill"])
         off = int(_state[2]["off"])
@@ -957,12 +989,17 @@ def _build_streamed_impl(
                               jnp.uint32)
         if cache_kind == "i4":
             acc_cache = jnp.zeros((C * nw4, cap), jnp.uint32)
+        elif cache_kind == "rabitq":
+            # transposed sign-bit accumulator (same dense layout + 2-D
+            # scatter coordinates as the i4 cache, 4x narrower)
+            acc_cache = jnp.zeros((C * nwb, cap), jnp.uint32)
         else:
             acc_cache = jnp.zeros(
                 (C * cap, rot if cache_kind == "i8" else 0), jnp.int8
             )
         acc_qnorms = jnp.zeros((C * cap if want_qnorms else 0,),
                                jnp.float32)
+        acc_fac = jnp.zeros((C * cap if want_fac else 0,), jnp.float32)
         acc_norms = jnp.zeros((C * cap,), jnp.float32)
         acc_ids = jnp.full((C * cap,), -1, jnp.int32)
         fill = jnp.zeros((C,), jnp.int32)
@@ -990,9 +1027,11 @@ def _build_streamed_impl(
         obs.counter("stream_chunks_total", stage="build.pass2")
         bs = batch.shape[0]
         lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
-        acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill = (
+        (acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac, acc_ids,
+         fill) = (
             _scatter_encode_batch(
-                acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill,
+                acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac,
+                acc_ids, fill,
                 batch, lab, jnp.int32(off), scale,
                 index.centers_rot, index.rotation, index.pq_centers,
                 C, cap, int(index.codebook_kind), pq_dim, pq_bits,
@@ -1012,7 +1051,8 @@ def _build_streamed_impl(
                 dict(_quant_arrays(index, ts_scales),
                      labels_all=labels_all, acc_codes=acc_codes,
                      acc_cache=acc_cache, acc_norms=acc_norms,
-                     acc_qnorms=acc_qnorms, acc_ids=acc_ids, fill=fill),
+                     acc_qnorms=acc_qnorms, acc_fac=acc_fac,
+                     acc_ids=acc_ids, fill=fill),
                 fingerprint=_fp,
             )
 
@@ -1031,6 +1071,8 @@ def _build_streamed_impl(
     big_codes = keep_codes and C * cap * nw * 4 > (2 << 30)
     if cache_kind == "i4":
         recon_cache = _donated_reshape3(acc_cache, C, nw4)
+    elif cache_kind == "rabitq":
+        recon_cache = _donated_reshape3(acc_cache, C, nwb)
     elif cache_kind == "i8":
         recon_cache = _donated_reshape3(acc_cache, C, cap)
     else:
@@ -1047,6 +1089,8 @@ def _build_streamed_impl(
         cache_scales=scale if cache_kind == "i4" else None,
         cache_qnorms=(_donated_reshape2(acc_qnorms, C, cap)
                       if want_qnorms else None),
+        cache_fac=(_donated_reshape2(acc_fac, C, cap)
+                   if want_fac else None),
     )
     return out
 
@@ -1065,11 +1109,11 @@ def _donated_reshape2(a, C: int, cap: int):
 
 @functools.partial(
     jax.jit,
-    donate_argnums=(0, 1, 2, 3, 4, 5),
-    static_argnums=(13, 14, 15, 16, 17, 18, 19),
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+    static_argnums=(14, 15, 16, 17, 18, 19, 20),
 )
 def _scatter_encode_batch(
-    acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill,
+    acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac, acc_ids, fill,
     batch, labels, id0, scale, centers_rot, rotation, pq_centers,
     C: int, cap: int, codebook_kind: int, pq_dim: int, pq_bits: int,
     keep_codes: bool, cache_kind: str,
@@ -1150,6 +1194,30 @@ def _scatter_encode_batch(
             acc_qnorms = acc_qnorms.at[slot].set(qn[order])
         else:
             rnorm = qn
+    elif cache_kind == "rabitq":
+        # sign bits of the RAW rotated residual (not the PQ recon —
+        # same fidelity choice as the i4 cache above) + the estimator's
+        # per-row scalars: fac = ||r||²/||r||₁ and the TRUE ||r||².
+        # Needs NO trainset scale pass at all — RaBitQ's build-side win.
+        # Same transposed [C*nwb, cap] element scatter as i4 (2-D
+        # coordinates keep every index under int32 at 100M scale).
+        raw = res.reshape(bs, -1)                          # [bs, rot]
+        q, fac_b, qn = _quant_pack_rabitq(raw)             # [bs, nwb]
+        nwb = q.shape[1]
+        qs = q[order]
+        l_idx = slot // cap
+        pos_idx = slot % cap
+        row = l_idx[:, None] * nwb + jnp.arange(nwb, dtype=jnp.int32)[None, :]
+        row = jnp.where(slot[:, None] >= C * cap, C * nwb, row)  # drop
+        col = jnp.broadcast_to(pos_idx[:, None], row.shape)
+        acc_cache = acc_cache.at[row.reshape(-1), col.reshape(-1)].set(
+            qs.reshape(-1)
+        )
+        acc_fac = acc_fac.at[slot].set(fac_b[order])
+        if keep_codes:
+            acc_qnorms = acc_qnorms.at[slot].set(qn[order])
+        else:
+            rnorm = qn
     elif cache_kind == "i8":
         # full decode, chunked: the [chunk, p, len] transient is
         # lane-padded len -> 128, so chunks stay small
@@ -1189,7 +1257,8 @@ def _scatter_encode_batch(
         acc_cache = with_layout_constraint(acc_cache, Layout((0, 1)))
     except Exception:  # noqa: BLE001 - layout API absent on some backends
         pass
-    return acc_codes, acc_cache, acc_norms, acc_qnorms, acc_ids, fill
+    return (acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac, acc_ids,
+            fill)
 
 
 def encode(index: Index, vectors) -> Tuple[jax.Array, jax.Array]:
@@ -1500,6 +1569,143 @@ def _pick_clip_scale(vals, base_scale, ok, qmax: int = 7):
     return base_scale * best_m[..., None]
 
 
+# ---------------------------------------------------------------------------
+# rabitq sign-bit cache (the ~32x-compressed first-stage rung, ISSUE 11)
+# ---------------------------------------------------------------------------
+#
+# IVF-RaBitQ (PAPERS.md) quantizes each rotated residual r to ONE sign
+# bit per component plus two per-row f32 scalars, and recovers an
+# UNBIASED estimate of <q, r> from them:
+#
+#     r̂ = fac · sign(r),   fac = ||r||² / ||r||₁
+#     <q, r> ≈ <q, r̂> = fac · Σ_j sign(r_j) · q_j
+#
+# (<r̂, r> = ||r||² exactly — the collinearity-corrected projection; for
+# incoherent directions, i.e. after a random rotation, the cross terms
+# cancel in expectation). The L2 estimator then uses the TRUE stored
+# norm, not ||r̂||²:  d²(q_res, r) ≈ ||q_res||² + ||r||² − 2·fac·S.
+# Storage is sign bits packed 32-per-u32 lane word, TRANSPOSED to
+# [C, ceil(rot/32), cap] like the i4 cache (components on sublanes, rows
+# on lanes — Mosaic-dense); rot dims beyond the last full word are pad
+# bits (decode −1, nulled by zero-padded queries). At 1 bit/dim this is
+# ~32× less HBM per scanned row than f32 and 4× less than the i4 rung —
+# the first-stage scan of the multi-stage rerank pipeline
+# (search_refined), never a fidelity source on its own.
+
+
+def bits_words(rot: int) -> int:
+    """Sign-bit words per row: ceil(rot / 32) (partial last word ok)."""
+    return -(-rot // 32)
+
+
+def pack_sign_bits(vals) -> jax.Array:
+    """[..., d] f32 -> [..., ceil(d/32)] u32 sign-bit words (bit j of
+    word w set where vals[..., 32w + j] > 0; pad bits zero)."""
+    d = vals.shape[-1]
+    nwb = bits_words(d)
+    pad = nwb * 32 - d
+    b = (vals > 0).astype(jnp.uint32)
+    if pad:
+        b = jnp.concatenate(
+            [b, jnp.zeros((*b.shape[:-1], pad), jnp.uint32)], axis=-1)
+    b = b.reshape(*b.shape[:-1], nwb, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(b << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_sign_bits(packed, d: int) -> jax.Array:
+    """[..., nw] u32 -> [..., d] f32 in {−1, +1} (pad bits dropped).
+    XLA analog of the kernel's 2-op bit decode."""
+    w = packed.astype(jnp.int32)
+    j = jnp.arange(d, dtype=jnp.int32)
+    words = jnp.take(w, j // 32, axis=-1)                # [..., d]
+    bit = (words >> (j % 32)) & 1
+    return (2 * bit - 1).astype(jnp.float32)
+
+
+def _quant_pack_rabitq(res):
+    """[..., rot] f32 residuals -> (packed [..., ceil(rot/32)] u32,
+    fac [...] f32, norm2 [...] f32). All-zero rows (padding slots,
+    exact-center residuals) get fac 0 — their estimated dot is 0."""
+    norm2 = jnp.sum(res * res, axis=-1)
+    l1 = jnp.sum(jnp.abs(res), axis=-1)
+    fac = norm2 / jnp.maximum(l1, 1e-30)
+    return pack_sign_bits(res), fac, norm2
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _rabitq_cache_scan(codes_packed, indices, pq_centers,
+                       codebook_kind: int, pq_dim: int, pq_bits: int):
+    """Sign-bit cache from the PQ codes, scanned over lists: binarize
+    the DECODED reconstruction (the batch-build analog of the streamed
+    path's raw-residual signs — same asymmetry the i4 cache has; the
+    sign pattern survives PQ quantization far better than magnitudes
+    do). Returns (cache_t [C, nw, cap] u32, fac [C, cap],
+    qnorms [C, cap] — the reconstruction's true norms, what the
+    estimator scores against). Padding slots (ids < 0) are zeroed."""
+    C = codes_packed.shape[0]
+    lids = jnp.arange(C, dtype=jnp.int32)
+
+    def body(_, inp):
+        blk, ids_row, lid = inp                          # [cap, nw], []
+        u = unpack_codes(blk, pq_dim, pq_bits)           # [cap, p]
+        if codebook_kind == codebook_gen.PER_SUBSPACE:
+            recon = _decode_gather(u, pq_centers, codebook_kind)
+        else:
+            recon = _decode_gather(u, pq_centers, codebook_kind,
+                                   jnp.full((u.shape[0],), lid))
+        recon = jnp.where((ids_row >= 0)[:, None], recon, 0.0)
+        packed, fac, n2 = _quant_pack_rabitq(recon)      # [cap, nw], ...
+        return None, (packed.T, fac, n2)
+
+    _, (cache_t, fac, qnorms) = jax.lax.scan(
+        body, None, (codes_packed, indices, lids)
+    )
+    return cache_t, fac, qnorms
+
+
+def scan_bytes_per_row(kind: str, rot: int, pq_dim: int = 0):
+    """First-stage scan cost model, ONE home for bench + tests:
+    returns ``(code_bytes, total_bytes)`` streamed per scanned row.
+
+    ``code_bytes`` is the quantized payload alone — the
+    rows-per-HBM-byte ladder figure (the convention behind the "~32×
+    compressed" 1-bit claim; i4→rabitq is exactly 4× here when
+    ``rot % 32 == 0``). ``total_bytes`` adds the per-row scalar
+    sidecars and the 4-byte id/slot row the scan also streams — the
+    honest roofline traffic (the rabitq ratio lands ~2.3–3.5× there
+    because two f32 estimator scalars ride every 1-bit row)."""
+    if kind == "rabitq":
+        return bits_words(rot) * 4, bits_words(rot) * 4 + 12
+    if kind == "i4":
+        return rot // 2, rot // 2 + 8
+    if kind == "i8":
+        return rot, rot + 8
+    if kind == "pq4":
+        return pq_dim // 2, pq_dim // 2 + 8
+    raise ValueError(f"unknown scan kind {kind!r}")
+
+
+def attach_rabitq_cache(index: Index) -> Index:
+    """Swap the index onto the rabitq rung: rebuild the sign-bit cache
+    (+ fac/norm sidecars) from the packed codes, replacing whatever
+    cache the index carried — the batch-path attach for A/B runs and
+    for serving an existing index through the multi-stage pipeline
+    without retraining quantizers."""
+    if index.codes.ndim != 3 or index.codes.shape[-1] == 0:
+        raise ValueError(
+            "attach_rabitq_cache needs the packed codes (cache-only "
+            "indexes already carry their final cache)")
+    cache_t, fac, qnorms = _rabitq_cache_scan(
+        index.codes, index.indices, index.pq_centers,
+        index.codebook_kind, index.pq_dim, index.pq_bits,
+    )
+    return dataclasses.replace(
+        index, recon_cache=cache_t, recon_scale=1.0,
+        cache_scales=None, cache_qnorms=qnorms, cache_fac=fac,
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def _recon_cache_scan(codes_packed, pq_centers, codebook_kind: int,
                       pq_dim: int, pq_bits: int):
@@ -1591,6 +1797,7 @@ def attach_raw_residual_cache(index: Index, dataset,
         recon_scale=1.0,
         cache_scales=jnp.concatenate(scales),
         cache_qnorms=jnp.concatenate(qnorms),
+        cache_fac=None,
     )
 
 
@@ -1614,7 +1821,21 @@ def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
     explicit choice for pq_dim < dim compression below 0.5 B/dim —
     the reference's high-compression regime
     (ivf_pq_compute_similarity-inl.cuh LUT scoring) where no residual
-    cache can operate."""
+    cache can operate.
+
+    "rabitq" (ISSUE 11) is the 1-bit/dim bottom rung — sign-bit codes
+    plus two per-row scalars, ~4× fewer code bytes than the half-byte
+    rungs. Its FIRST-STAGE recall sits well below i4's, so "auto"
+    only ever picks it through a MEASURED table winner (microbench
+    races it at matched recall through its rerank pipeline — an arm
+    that can't hit the band is filtered before the race); the analytic
+    fallback never does, and when no kind fits the budget "auto" still
+    returns None (no cache — plain search keeps its exact PQ code
+    scan, the pre-r10 semantics; a silent 1-bit downgrade there would
+    regress recall for plain-search callers). An auto- or
+    explicitly-rabitq index should be searched through
+    ``search_refined`` (the multi-stage pipeline); plain ``search``
+    serves first-stage estimates."""
     if not cache_decoded or cap == 0:
         return None
     i8_ok = C * cap * rot <= _CACHE_BUDGET
@@ -1622,11 +1843,15 @@ def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
     pq4_ok = (pq_bits == 4 and per_subspace and pq_dim > 0
               and pq_dim % 8 == 0
               and C * cap * pq_dim // 2 <= _CACHE_BUDGET)
+    # sign-bit cache: nw u32 words + fac/norm f32 scalars per row;
+    # word padding makes any rot legal
+    rabitq_ok = C * cap * (bits_words(rot) * 4 + 8) <= _CACHE_BUDGET
     if cache_dtype == "auto":
         if i8_ok:
             return "i8"
         feasible = [kind for kind, ok in
-                    (("i4", i4_ok), ("pq4", pq4_ok)) if ok]
+                    (("i4", i4_ok), ("pq4", pq4_ok),
+                     ("rabitq", rabitq_ok)) if ok]
         if not feasible:
             return None
         from raft_tpu import tuning
@@ -1643,6 +1868,8 @@ def _cache_kind_for(cache_decoded: bool, cache_dtype: str, C: int,
         return "i4" if i4_ok else None
     if cache_dtype == "pq4":
         return "pq4" if pq4_ok else None
+    if cache_dtype == "rabitq":
+        return "rabitq" if rabitq_ok else None
     raise ValueError(f"unknown cache_dtype {cache_dtype!r}")
 
 
@@ -1666,11 +1893,13 @@ def _attach_cache(index: "Index") -> "Index":
         if index.codes.shape[-1] == 0 and index.recon_cache is not None:
             return index
         return dataclasses.replace(
-            index, recon_cache=None, cache_scales=None, cache_qnorms=None
+            index, recon_cache=None, cache_scales=None, cache_qnorms=None,
+            cache_fac=None,
         )
     if kind is None:
         return dataclasses.replace(
-            index, recon_cache=None, cache_scales=None, cache_qnorms=None
+            index, recon_cache=None, cache_scales=None, cache_qnorms=None,
+            cache_fac=None,
         )
     if kind == "i8":
         cache, scale = _recon_cache_scan(
@@ -1679,7 +1908,7 @@ def _attach_cache(index: "Index") -> "Index":
         )
         return dataclasses.replace(
             index, recon_cache=cache, recon_scale=float(scale),
-            cache_scales=None, cache_qnorms=None,
+            cache_scales=None, cache_qnorms=None, cache_fac=None,
         )
     if kind == "pq4":
         # the "cache" IS the packed codes, transposed to the kernel's
@@ -1688,6 +1917,16 @@ def _attach_cache(index: "Index") -> "Index":
         return dataclasses.replace(
             index, recon_cache=jnp.swapaxes(index.codes, 1, 2),
             recon_scale=1.0, cache_scales=None, cache_qnorms=None,
+            cache_fac=None,
+        )
+    if kind == "rabitq":
+        cache_t, fac, qnorms = _rabitq_cache_scan(
+            index.codes, index.indices, index.pq_centers,
+            index.codebook_kind, index.pq_dim, index.pq_bits,
+        )
+        return dataclasses.replace(
+            index, recon_cache=cache_t, recon_scale=1.0,
+            cache_scales=None, cache_qnorms=qnorms, cache_fac=fac,
         )
     cache_t, scales, qnorms = _recon_cache_scan_i4(
         index.codes, index.indices, index.pq_centers, index.codebook_kind,
@@ -1695,7 +1934,7 @@ def _attach_cache(index: "Index") -> "Index":
     )
     return dataclasses.replace(
         index, recon_cache=cache_t, recon_scale=1.0,
-        cache_scales=scales, cache_qnorms=qnorms,
+        cache_scales=scales, cache_qnorms=qnorms, cache_fac=None,
     )
 
 
@@ -1728,12 +1967,14 @@ def _pq_search(
 ):
     (queries, centers, centers_rot, rotation, pq_centers, codes, indices,
      list_sizes, rec_norms, filter_bits, recon_cache, recon_scale,
-     cache_scales, cache_qnorms) = arrays
+     cache_scales, cache_qnorms, cache_fac) = arrays
     cache_kind = ("none" if recon_cache is None
                   else "i8" if recon_cache.dtype != jnp.uint32
+                  else "rabitq" if cache_fac is not None
                   else "i4" if cache_scales is not None
                   else "pq4")
     cache_i4 = cache_kind == "i4"
+    cache_rabitq = cache_kind == "rabitq"
     metric = DistanceType(metric_val)
     select_min = is_min_close(metric)
     C, cap = indices.shape   # codes may be FLAT [C*cap, nw] (streamed
@@ -1787,7 +2028,7 @@ def _pq_search(
         # weights), so qv stays the raw residual.
         qscale = (cache_scales[bucket_list][:, None, :]
                   if cache_scales is not None       # per-list (raw caches)
-                  else 1.0 if cache_kind == "pq4"
+                  else 1.0 if cache_kind in ("pq4", "rabitq")
                   else recon_scale)
         qv = (q_res * qscale).astype(mm)                     # [nb, G, rot]
         ip = metric == DistanceType.InnerProduct
@@ -1798,6 +2039,15 @@ def _pq_search(
             mk, qaux = ivf_scan.IP, None
         else:
             mk, qaux = ivf_scan.L2, jnp.sum(q_res * q_res, axis=2)
+        if cache_rabitq:
+            # zero-pad queries to the sign-word width: pad bits decode
+            # -1 in-kernel, so a zero query component nulls them; the
+            # per-row fac scale rides as the kernel's row_scale operand
+            # and norms hold the TRUE residual norms (the estimator's
+            # correct norm term — not the reconstruction's)
+            dpad = recon_cache.shape[1] * 32 - rot_dim
+            if dpad:
+                qv = jnp.pad(qv, ((0, 0), (0, 0), (0, dpad)))
         keep = None
         if filter_bits is not None:
             keep = filter_keep(filter_bits, filter_nbits, indices).astype(
@@ -1819,10 +2069,12 @@ def _pq_search(
             None if ip else norms,       # IP kernel never reads norms
             keep,
             lut_weights=lut_w,
+            row_scale=cache_fac if cache_rabitq else None,
             k=kl, metric_kind=mk, approx=local_recall_target < 1.0,
             recall_target=float(local_recall_target),
             interpret=scan_impl == "pallas_interpret",
             packed_i4=cache_i4,
+            packed_bits=cache_rabitq,
         )                                                    # ids in-kernel
         if ip:
             qc = jnp.einsum(
@@ -1852,7 +2104,7 @@ def _pq_search(
         sizes = list_sizes[bl]
         # pq4's transposed-code "cache" is not a decoded-residual block;
         # the XLA body scores it from the packed codes like any code index
-        use_cache_blk = (cache_kind in ("i8", "i4")
+        use_cache_blk = (cache_kind in ("i8", "i4", "rabitq")
                          and lut_dtype in ("auto", "i8"))
         rn = (cache_qnorms if use_cache_blk and cache_qnorms is not None
               else rec_norms)[bl]
@@ -1862,7 +2114,15 @@ def _pq_search(
             # measured ~5x the block matmul at CAGRA-build shapes). Only
             # taken when lut_dtype allows it — explicit f32/bf16/f8 get
             # the true decode at that precision
-            if cache_i4:
+            if cache_rabitq:
+                # XLA mirror of the kernel's estimator: dequantized
+                # r̂ = fac·sign(r) scores the cross term, rn (above)
+                # already selected the TRUE residual norms
+                blk_t = recon_cache[bl]                # [bb, nwb, cap]
+                signs = unpack_sign_bits(
+                    jnp.swapaxes(blk_t, 1, 2), rot_dim)
+                recon = signs * cache_fac[bl][:, :, None]
+            elif cache_i4:
                 blk_t = recon_cache[bl]                # [bb, nw4, cap]
                 raw = unpack_i4(jnp.swapaxes(blk_t, 1, 2))
                 recon = raw * cache_scales[bl][:, None, :]
@@ -1989,7 +2249,7 @@ def search(
             index.pq_centers, index.codes, index.indices, index.list_sizes,
             index.rec_norms, None if bits is None else bits.bits,
             index.recon_cache, jnp.float32(index.recon_scale),
-            index.cache_scales, index.cache_qnorms,
+            index.cache_scales, index.cache_qnorms, index.cache_fac,
         )  # recon_cache rides along; the body gates its use on lut_dtype
         from raft_tpu.neighbors.ivf_flat import (
             adaptive_query_group, _resolve_scan_impl,
@@ -2128,48 +2388,232 @@ def _slot_indices(indices):
     return jnp.where(indices >= 0, slot_ids, -1)
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 7, 8, 9))
+def _refine_slots_codes(queries, slots, k: int, metric_val: int,
+                        codes, pq_centers, centers_rot,
+                        codebook_kind: int, pq_dim: int, pq_bits: int,
+                        rotation=None):
+    """Exact re-rank of slot candidates against the PQ-DECODED vectors —
+    the rerank source for the rabitq pipeline when the index still
+    carries its codes: stage 1 scans 1-bit estimates, stage 2 re-scores
+    the shortlist at full PQ fidelity (one codebook gather per
+    candidate, ≤ k·ratio rows per query — FusionANNS's
+    move-only-shortlist-bytes shape). Distances are f32 in rotated
+    space; slots < 0 are invalid. Returns (dist [m, k], slots [m, k])."""
+    metric = DistanceType(metric_val)
+    q32 = jnp.asarray(queries).astype(jnp.float32)
+    qrot = dist_dot(q32, rotation.T)                     # [m, rot]
+    valid = slots >= 0
+    safe = jnp.maximum(slots, 0)
+    if codes.ndim == 2:                                  # flat streamed
+        C = centers_rot.shape[0]
+        cap = codes.shape[0] // C
+        words = codes[safe]                              # [m, c, nw]
+    else:
+        C, cap, _nw = codes.shape
+        words = codes.reshape(C * cap, -1)[safe]         # [m, c, nw]
+    lst = safe // cap
+    u = unpack_codes(words, pq_dim, pq_bits)             # [m, c, p]
+    if codebook_kind == codebook_gen.PER_SUBSPACE:
+        recon = _decode_gather(u, pq_centers, codebook_kind)
+    else:
+        recon = _decode_gather(u, pq_centers, codebook_kind, lst)
+    vec = centers_rot[lst] + recon                       # [m, c, rot]
+    if metric == DistanceType.InnerProduct:
+        d = jnp.sum(vec * qrot[:, None, :], axis=-1, dtype=jnp.float32)
+    else:
+        diff = qrot[:, None, :] - vec
+        d = jnp.sum(diff * diff, axis=-1, dtype=jnp.float32)
+        if metric == DistanceType.L2SqrtExpanded:
+            d = jnp.sqrt(d)
+    sentinel = sentinel_for(metric, jnp.float32)
+    d = jnp.where(valid, d, sentinel)
+    out_d, out_s = merge_topk(d, slots.astype(jnp.int32), k,
+                              is_min_close(metric))
+    out_s = jnp.where(out_d == sentinel, -1, out_s)
+    return out_d, out_s
+
+
+def _slot_prefilter(index: Index, prefilter):
+    """Translate a stored-id prefilter into SLOT space for the
+    slot-substituted inner search: the user/tombstone bitset is keyed by
+    global id, but the first stage emits slots — so the keep decision is
+    materialized per (list, slot) once, packed into a slot-indexed
+    bitset, and composed BEFORE the shortlist exists (a filtered row can
+    never reach the rerank). Returns a BitsetFilter or None.
+
+    Cached on the filter object keyed by (bitset version, indices
+    identity) — steady-state serving calls this per batch with one
+    composed tombstone filter, and the translation's device ops (keep
+    test + bit pack) must not be paid N times (the
+    ``resolve_filter_bits`` caching idiom)."""
+    import weakref
+
+    filt = as_filter(prefilter)
+    bits = resolve_filter_bits(filt, lambda: index.size)
+    if bits is None:
+        return None
+    # The cache lives on the LONG-LIVED underlying Bitset, not the
+    # BitsetFilter wrapper: serve constructs a fresh wrapper per batch
+    # (engine._run_search), so a wrapper-resident entry would never hit
+    # and every batch would re-pay the translation's device ops
+    # (review fix, r10). The key carries the SOURCE bitset's version,
+    # not (only) the resolved one — a keep-mode filter narrower than
+    # the index materializes through copy().resize(), whose result
+    # sits at _version == 1 every time, which would serve a stale slot
+    # filter after the source mutates — plus the wrapper's
+    # out_of_range mode (two wrappers over one bitset may disagree).
+    src = getattr(filt, "bitset", None)
+    host = src if src is not None else filt
+    key = (getattr(src, "_version", 0), getattr(bits, "_version", 0),
+           int(bits.n_bits), getattr(filt, "out_of_range", "drop"))
+    cached = getattr(host, "_slot_filter", None)
+    if (cached is not None and cached[0] == key
+            and cached[2]() is index.indices):
+        return cached[1]
+    from raft_tpu.core.bitset import Bitset
+
+    keep = filter_keep(bits.bits, int(bits.n_bits), index.indices)
+    keep = keep & (index.indices >= 0)
+    out = as_filter(Bitset.from_dense(keep.reshape(-1)))
+    try:
+        # a WEAK ref ties the entry to this exact indices array without
+        # pinning a retired generation's [C, cap] int32 block alive on
+        # a long-lived bitset object (review fix, r10); a dead or
+        # different referent simply misses the cache
+        host._slot_filter = (key, out, weakref.ref(index.indices))
+    except (AttributeError, TypeError):  # slotted host / unweakrefable
+        pass
+    return out
+
+
 def search_refined(
     search_params: SearchParams,
     index: Index,
     queries,
     k: int,
     refine_ratio: int = 2,
+    prefilter=None,
+    dataset=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Search + exact re-rank from the residual cache, no raw dataset.
+    """Multi-stage search: cheap first-stage scan over the compressed
+    cache, exact re-rank of the over-fetched shortlist (the reference's
+    ``refine_ratio`` pattern, bench/ann raft_ivf_pq_wrapper.h; the
+    FusionANNS architecture — only shortlist bytes move at fidelity).
 
-    The reference's ``refine_ratio`` pattern (bench/ann
-    raft_ivf_pq_wrapper.h: search k*ratio, then exact refine) with the
-    dataset read replaced by on-chip cache decode: the inner search runs
-    over slot-substituted indices, the top ``k * refine_ratio`` slots are
-    decoded from the int4/int8 residual cache at f32 and re-ranked
-    exactly, then slots resolve to global ids. This is the recall lever
-    for cache-only (keep_codes=False) and billion-scale sharded indexes
-    where the f32 dataset can never be resident.
+    The first stage runs over slot-substituted indices at
+    ``k * refine_ratio``; the shortlist is then re-ranked from the
+    finest available source and slots resolve to global ids. Rerank
+    source resolution:
+
+    * ``dataset`` given — exact f32/bf16 originals via
+      :mod:`~raft_tpu.neighbors.refine` (stage 1 returns global ids
+      directly; no slot indirection needed);
+    * i8/i4 residual cache — decoded at f32 on-chip (the billion-scale
+      source: the dataset is never HBM-resident);
+    * the packed PQ codes (rabitq indexes that kept them) — full PQ
+      fidelity over the 1-bit first stage's shortlist.
+
+    ``prefilter`` (tombstone/user bitsets) composes with the FIRST
+    stage — filtered rows never enter the shortlist (translated to slot
+    space for the inner search). A pq4/no-cache index without a dataset
+    still errors: its own scan is already exact PQ, so a codes rerank
+    adds nothing. Rerank-stage observability (docs/observability.md):
+    ``rerank.queries_total``/``rerank.shortlist_rows``/
+    ``rerank.bytes_fetched_total{source}`` + the first-stage vs rerank
+    latency split (``rerank.stage_ms{stage}``, device-complete).
     """
-    if index.cache_kind not in ("i8", "i4"):
-        raise ValueError(
-            "search_refined needs the decoded-RESIDUAL cache (i8/i4; "
-            "build with cache_decoded=True within _CACHE_BUDGET) — a pq4 "
-            "code cache adds no fidelity over its own exact scan; for "
-            "raw-dataset refine use neighbors.refine"
-        )
     if refine_ratio < 1:
         raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
+    kind = index.cache_kind
+    has_codes = index.codes.shape[-1] > 0
+    if dataset is None and kind not in ("i8", "i4") and not (
+            kind == "rabitq" and has_codes):
+        raise ValueError(
+            "search_refined needs a rerank source finer than the first "
+            "stage: a residual cache (i8/i4), the packed codes (rabitq "
+            "indexes built with keep_codes=True), or an explicit "
+            "dataset= — a pq4/no-cache index's own scan is already "
+            "exact PQ; for raw-dataset refine there, pass dataset= or "
+            "use neighbors.refine"
+        )
+    queries = jnp.asarray(queries)
+    m = int(queries.shape[0])
+    cap = index.indices.shape[1]
+    n_probes = int(min(search_params.n_probes, index.n_lists))
+    kc = max(int(k), min(int(k * refine_ratio), n_probes * cap))
+    rot = index.rot_dim
     with obs.span("ivf_pq.search_refined", refine_ratio=int(refine_ratio),
-                  k=int(k)):
-        slot_index = dataclasses.replace(
-            index, indices=_slot_indices(index.indices))
-        _, slots = search(search_params, slot_index, queries,
-                          int(k * refine_ratio))
-        with obs.span("ivf_pq.refine"):
-            d, s = _refine_slots(
-                jnp.asarray(queries), slots, int(k), int(index.metric),
-                index.recon_cache, index.cache_scales, index.centers_rot,
-                index.rotation, jnp.float32(index.recon_scale),
-            )
-            ids = jnp.where(
-                s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)], -1)
-            return d, ids
+                  k=int(k), cache_kind=kind) as _sp:
+        source = ("dataset" if dataset is not None
+                  else "cache" if kind in ("i8", "i4") else "codes")
+        if source == "dataset":
+            with obs.span("ivf_pq.first_stage", kc=kc) as s1:
+                d1, ids1 = search(search_params, index, queries, kc,
+                                  prefilter=prefilter)
+                if obs.enabled():
+                    s1.sync(ids1)
+            from raft_tpu.neighbors.refine import refine as _refine_ds
+
+            dataset = jnp.asarray(dataset)
+            row_bytes = int(dataset.shape[1]) * dataset.dtype.itemsize
+            with obs.span("ivf_pq.rerank", source=source) as s2:
+                d, ids = _refine_ds(dataset, queries, ids1, int(k),
+                                    index.metric)
+                if obs.enabled():
+                    s2.sync(ids)
+        else:
+            slot_filter = _slot_prefilter(index, prefilter)
+            slot_index = dataclasses.replace(
+                index, indices=_slot_indices(index.indices))
+            with obs.span("ivf_pq.first_stage", kc=kc) as s1:
+                _, slots = search(search_params, slot_index, queries, kc,
+                                  prefilter=slot_filter)
+                if obs.enabled():
+                    s1.sync(slots)
+            with obs.span("ivf_pq.rerank", source=source) as s2:
+                if source == "cache":
+                    row_bytes = (rot // 2 if kind == "i4" else rot) + 4
+                    d, s = _refine_slots(
+                        jnp.asarray(queries), slots, int(k),
+                        int(index.metric), index.recon_cache,
+                        index.cache_scales, index.centers_rot,
+                        index.rotation, jnp.float32(index.recon_scale),
+                    )
+                else:
+                    row_bytes = packed_words(index.pq_dim,
+                                             index.pq_bits) * 4
+                    codes3 = index.codes
+                    d, s = _refine_slots_codes(
+                        jnp.asarray(queries), slots, int(k),
+                        int(index.metric), codes3, index.pq_centers,
+                        index.centers_rot, int(index.codebook_kind),
+                        int(index.pq_dim), int(index.pq_bits),
+                        rotation=index.rotation,
+                    )
+                ids = jnp.where(
+                    s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)],
+                    -1)
+                if obs.enabled():
+                    s2.sync(ids)
+        if obs.enabled():
+            # the bytes-moved split ROADMAP item 3 budgets against:
+            # shortlist rows fetched at fidelity per query, and the
+            # stage latency split (device-complete when synced above)
+            obs.counter("rerank.queries_total", m, algo="ivf_pq")
+            obs.counter("rerank.shortlist_rows", m * kc, algo="ivf_pq")
+            obs.counter("rerank.bytes_fetched_total", m * kc * row_bytes,
+                        source=source)
+            obs.gauge("rerank.bytes_per_query", kc * row_bytes,
+                      source=source)
+            if getattr(s1, "device_ms", None) is not None:
+                obs.observe("rerank.stage_ms", s1.device_ms,
+                            stage="first_stage")
+            if getattr(s2, "device_ms", None) is not None:
+                obs.observe("rerank.stage_ms", s2.device_ms,
+                            stage="rerank")
+            _sp.set(source=source, shortlist=kc)
+        return d, ids
 
 
 def _norm_dtype_knob(v) -> str:
@@ -2227,12 +2671,20 @@ def save(path: str, index: Index) -> None:
     # silently wrote empty codes and rebuilt a wrong cache on load). The
     # scalar-scale decoded-i8 cache and the pq4 transposed-code cache
     # rebuild exactly from codes and are not serialized.
-    raw_scaled = index.cache_scales is not None
+    # the rabitq cache is serialized whenever present: streamed builds
+    # binarize the RAW residual (a rebuild from decoded codes would lose
+    # that fidelity), batch builds rebuild identically but the cache is
+    # tiny (1 bit/dim + 8 B/row) so one rule covers both
+    raw_scaled = (index.cache_scales is not None
+                  or index.cache_fac is not None)
     if cache_only or raw_scaled:
         arrays["recon_cache"] = np.asarray(index.recon_cache)
         cache_kind = index.cache_kind
         if raw_scaled:
-            arrays["cache_scales"] = np.asarray(index.cache_scales)
+            if index.cache_scales is not None:
+                arrays["cache_scales"] = np.asarray(index.cache_scales)
+            if index.cache_fac is not None:
+                arrays["cache_fac"] = np.asarray(index.cache_fac)
             if index.cache_qnorms is not None:
                 arrays["cache_qnorms"] = np.asarray(index.cache_qnorms)
     write_index_file(
@@ -2283,5 +2735,7 @@ def load(path: str) -> Index:
                           if "cache_scales" in arrays else None),
             cache_qnorms=(jnp.asarray(arrays["cache_qnorms"])
                           if "cache_qnorms" in arrays else None),
+            cache_fac=(jnp.asarray(arrays["cache_fac"])
+                       if "cache_fac" in arrays else None),
         )
     return _attach_cache(idx)

@@ -199,16 +199,18 @@ def _scan_kernel(
     bl_ref, ls_ref, *refs,
     k: int, metric_kind: int, extract: str, has_norms: bool,
     has_filter: bool, packed_i4: bool = False, packed_pq4: bool = False,
+    packed_bits: bool = False, has_row_scale: bool = False,
 ):
     refs = list(refs)
     storage_ref = refs.pop(0)
     ids_ref = refs.pop(0)
     norms_ref = refs.pop(0) if has_norms else None
     keep_ref = refs.pop(0) if has_filter else None
+    rs_ref = refs.pop(0) if has_row_scale else None
     qv_ref = refs.pop(0)
     w_ref = refs.pop(0) if packed_pq4 else None
     qaux_ref = refs.pop(0) if metric_kind != IP else None
-    if packed_i4 or packed_pq4:
+    if packed_i4 or packed_pq4 or packed_bits:
         outd_ref, outi_ref, recon_ref = refs
     else:
         outd_ref, outi_ref = refs
@@ -251,6 +253,31 @@ def _scan_kernel(
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+    elif packed_bits:
+        # RaBitQ sign-bit block [nw, cap] uint32 (32 sign bits per lane
+        # word, transposed like the i4 cache: components on sublanes,
+        # rows on lanes). The asymmetric estimator's hot loop is
+        # XOR+popcount-shaped — <x̄, q> over ±1 codes — phrased for the
+        # MXU: a 2-op VPU decode ((w >> j) & 1 -> 2b-1 ∈ {-1, +1}) into
+        # the [d, cap] scratch, then ONE matmul S = qv @ signs. The
+        # per-row correction scalar fac = ||r||²/||r||₁ (row_scale) is
+        # applied AFTER the matmul (per stored row — it cannot fold into
+        # the query side), giving the unbiased dot estimate fac·S; the
+        # norm term reads the TRUE ||r||² from ``norms``
+        # (docs/kernels.md §rabitq). Pad dims (d -> nw*32) decode to -1
+        # but the caller zero-pads qv there, so they contribute nothing.
+        blk_w = storage_ref[0].astype(jnp.int32)        # [nw, cap]
+        nw = blk_w.shape[0]
+        for wi in range(nw):
+            word = blk_w[wi, :]                          # [cap] i32
+            for j in range(32):
+                bit = (word >> j) & 1
+                recon_ref[wi * 32 + j, :] = (2 * bit - 1).astype(qv.dtype)
+        dots = jax.lax.dot_general(
+            qv, recon_ref[...],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # [G, cap]
     elif packed_i4:
         # packed int4 block [nw, cap] uint32 (transposed: components on
         # sublanes, rows on lanes — the Mosaic-dense layout for narrow
@@ -280,6 +307,9 @@ def _scan_kernel(
             preferred_element_type=jnp.float32,
         )                                               # [G, cap]
     G, cap = dots.shape
+    if has_row_scale:
+        # per-row estimator correction (rabitq): dots -> fac * dots
+        dots = dots * rs_ref[0, 0][None, :]
     if metric_kind == L2:
         dist = jnp.maximum(
             qaux_ref[0, 0][:, None] + norms_ref[0, 0][None, :] - 2.0 * dots,
@@ -319,6 +349,7 @@ def fused_list_scan_topk(
     norms=None,     # [C, cap] f32: ||x||^2; None for IP
     keep=None,      # [C, cap] int32 filter keep-mask; None = no filter
     lut_weights=None,  # [16, rot, p] block-diag codebook (pq4 code scan)
+    row_scale=None,    # [C, cap] f32 per-row dot scale (rabitq fac)
     *,
     k: int,
     metric_kind: int,
@@ -326,6 +357,7 @@ def fused_list_scan_topk(
     recall_target: float = 0.95,
     interpret: bool = False,
     packed_i4: bool = False,
+    packed_bits: bool = False,
     extract: str = None,
 ):
     """Scan each bucket's list block against its query group and return the
@@ -351,6 +383,16 @@ def fused_list_scan_topk(
     dequant scales must be pre-folded into ``qv`` (and ``norms`` hold the
     dequantized-vector norms), so the kernel itself is scale-free.
 
+    ``packed_bits`` (the rabitq arm): storage holds 1-bit sign codes of
+    the rotated residuals packed 32-per-u32, TRANSPOSED to
+    [C, ceil(d/32), cap]; ``row_scale`` must carry the per-row RaBitQ
+    correction fac = ||r||²/||r||₁ (applied to the dots after the MXU
+    pass — the unbiased estimator <q, r> ≈ fac·Σ±q_j) and ``norms`` the
+    TRUE residual norms ||r||². Queries must be zero-padded to the
+    word-padded width ceil(d/32)*32 so pad bits score nothing. ~32×
+    compressed vs f32 — the cheap first stage of the multi-stage rerank
+    pipeline (ivf_pq.search_refined).
+
     ``lut_weights`` (mutually exclusive with ``packed_i4``): storage holds
     packed 4-bit PQ CODES [C, p//8, cap] u32 and scoring runs the 16-pass
     one-hot contraction against the block-diagonal codebook weights
@@ -373,7 +415,8 @@ def fused_list_scan_topk(
     # ``extract`` bypasses the table (the microbench forcing each arm).
     from raft_tpu import obs, tuning
 
-    cap = (storage.shape[2] if (packed_i4 or lut_weights is not None)
+    cap = (storage.shape[2]
+           if (packed_i4 or packed_bits or lut_weights is not None)
            else storage.shape[1])
     binned_ok = approx and cap % 128 == 0 and cap > 128
     # single-slot binning is only eligible when its collision-loss
@@ -405,28 +448,36 @@ def fused_list_scan_topk(
                   k=int(k), nb=int(bucket_list.shape[0])):
         return _fused_list_scan_topk(
             storage, indices, list_sizes, bucket_list, qv, qaux, norms,
-            keep, lut_weights, k=k, metric_kind=metric_kind,
-            interpret=interpret, packed_i4=packed_i4, extract=extract,
+            keep, lut_weights, row_scale, k=k, metric_kind=metric_kind,
+            interpret=interpret, packed_i4=packed_i4,
+            packed_bits=packed_bits, extract=extract,
         )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric_kind", "interpret", "packed_i4",
-                     "extract"),
+                     "packed_bits", "extract"),
 )
 def _fused_list_scan_topk(
     storage, indices, list_sizes, bucket_list, qv, qaux=None, norms=None,
-    keep=None, lut_weights=None, *,
+    keep=None, lut_weights=None, row_scale=None, *,
     k: int, metric_kind: int, interpret: bool = False,
-    packed_i4: bool = False, extract: str = "exact",
+    packed_i4: bool = False, packed_bits: bool = False,
+    extract: str = "exact",
 ):
     packed_pq4 = lut_weights is not None
     if packed_pq4 and packed_i4:
         raise ValueError("packed_i4 and lut_weights are mutually exclusive")
+    if packed_bits and (packed_i4 or packed_pq4):
+        raise ValueError(
+            "packed_bits is mutually exclusive with packed_i4/lut_weights")
     if packed_i4:
         C, nw_c, cap = storage.shape
         d = nw_c * 8
+    elif packed_bits:
+        C, nw_c, cap = storage.shape
+        d = nw_c * 32
     elif packed_pq4:
         C, nw_c, cap = storage.shape
         d = lut_weights.shape[1]                       # rot_dim
@@ -440,6 +491,7 @@ def _fused_list_scan_topk(
     nb, G, _ = qv.shape
     has_norms = norms is not None
     has_filter = keep is not None
+    has_row_scale = row_scale is not None
 
     # 2-D per-row arrays are lifted to [*, 1, n] so each block equals the
     # full trailing dims (the Mosaic block rule: last two dims divisible by
@@ -447,7 +499,8 @@ def _fused_list_scan_topk(
     inputs = [storage, indices.reshape(C, 1, cap)]
     in_specs = [
         pl.BlockSpec(
-            (1, nw_c, cap) if (packed_i4 or packed_pq4) else (1, cap, d),
+            (1, nw_c, cap) if (packed_i4 or packed_pq4 or packed_bits)
+            else (1, cap, d),
             lambda i, bl, ls: (bl[i], 0, 0),
         ),
         pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0)),
@@ -459,6 +512,11 @@ def _fused_list_scan_topk(
         )
     if has_filter:
         inputs.append(keep.reshape(C, 1, cap))
+        in_specs.append(
+            pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0))
+        )
+    if has_row_scale:
+        inputs.append(row_scale.reshape(C, 1, cap))
         in_specs.append(
             pl.BlockSpec((1, 1, cap), lambda i, bl, ls: (bl[i], 0, 0))
         )
@@ -480,7 +538,8 @@ def _fused_list_scan_topk(
         _scan_kernel,
         k=k, metric_kind=metric_kind, extract=extract,
         has_norms=has_norms, has_filter=has_filter, packed_i4=packed_i4,
-        packed_pq4=packed_pq4,
+        packed_pq4=packed_pq4, packed_bits=packed_bits,
+        has_row_scale=has_row_scale,
     )
     # candidate width: the extracting arms emit k columns; the fold arm
     # emits its full R*128 lane-stack buffer (selection deferred)
@@ -496,7 +555,8 @@ def _fused_list_scan_topk(
                 pl.BlockSpec((1, G, kc), lambda i, bl, ls: (i, 0, 0)),
             ],
             scratch_shapes=(
-                [pltpu.VMEM((d, cap), qv.dtype)] if packed_i4
+                [pltpu.VMEM((d, cap), qv.dtype)]
+                if (packed_i4 or packed_bits)
                 else [pltpu.VMEM((nw_c * 8, cap), jnp.int32)] if packed_pq4
                 else []
             ),
@@ -531,6 +591,18 @@ def _scan_case_derive(case: dict) -> dict:
         case["nw_c"] = case["d"] // 8
         case["storage_shape"] = ("C", "nw_c", "cap")
         case["storage_dtype"] = "uint32"
+        case["lut_weights"] = False
+    elif case.get("rabitq"):
+        # 1-bit sign codes: 32/word, last word PARTIAL when d % 32 != 0
+        # (pad bits decode -1; queries are zero-padded to dp = nw*32)
+        case["nw_c"] = -(-case["d"] // 32)
+        case["dp"] = case["nw_c"] * 32
+        case["storage_shape"] = ("C", "nw_c", "cap")
+        case["storage_dtype"] = "uint32"
+        case["qv_shape"] = ("nb", "G", "dp")
+        case["packed_bits"] = True
+        case["row_scale"] = True
+        case["row_scale_dtype"] = "float32"
         case["lut_weights"] = False
     elif case.get("pq4"):
         case["nw_c"] = case.setdefault("p", case["d"] // 4) // 8 or 1
@@ -583,7 +655,7 @@ kernel_contract(
             "list_sizes": ("C",), "bucket_list": ("nb",),
             "qv": ("nb", "G", "d"), "qaux": ("nb", "G"),
             "norms": ("C", "cap"), "keep": ("C", "cap"),
-            "lut_weights": (16, "rot", "p")},
+            "row_scale": ("C", "cap"), "lut_weights": (16, "rot", "p")},
     derive=_scan_case_derive,
     case_filter=_scan_case_ok,
     extra_cases=(
@@ -601,6 +673,34 @@ kernel_contract(
          "dtype": "bfloat16", "static_only": True},
         {"extract": "exact", "k": 10, "cap": 256, "pq4": True,
          "dtype": "bfloat16", "static_only": True},
+        # rabitq sign-bit arm (ISSUE 11): driven DYNAMICALLY here — the
+        # estimator's XLA mirror is the oracle. Adversarial classes:
+        # dim divisible by 32, dim NOT divisible by 32 (partial last
+        # word: pad bits decode -1, zero-padded queries must null them),
+        # non-lane-multiple dims, k == n (whole-list edge), and the
+        # single/short-row lists every case exercises via the driver's
+        # short-size second pass. The estimator-unbiasedness statistical
+        # check vs the exact-distance oracle lives in
+        # tests/test_kernel_contracts.py::test_rabitq_estimator_unbiased.
+        {"extract": "exact", "k": 10, "cap": 256, "rabitq": True,
+         "d": 64, "dtype": "float32"},
+        {"extract": "exact", "k": 10, "cap": 256, "rabitq": True,
+         "d": 48, "dtype": "float32"},          # partial last word
+        {"extract": "exact", "k": 10, "cap": 256, "rabitq": True,
+         "d": 40, "dtype": "bfloat16"},         # non-lane-multiple dim
+        # k == n at lane-legal geometry (cap < 128 cannot reach the
+        # kernel through dispatch — _resolve_scan_impl requires
+        # cap % 128 == 0 — so the whole-list edge rides the fold arm)
+        {"extract": "fold", "k": 256, "cap": 256, "rabitq": True,
+         "d": 64, "dtype": "float32"},
+        # k == 1: the driver's short-size pass makes this the
+        # single-row-list case (size = 1)
+        {"extract": "exact", "k": 1, "cap": 256, "rabitq": True,
+         "d": 64, "dtype": "float32"},
+        {"extract": "binned", "k": 10, "cap": 256, "rabitq": True,
+         "d": 64, "dtype": "float32"},
+        {"extract": "fold", "k": 65, "cap": 256, "rabitq": True,
+         "d": 64, "dtype": "float32"},
     ),
     notes="binned loses ~C(k,2)/128 per list, binned_deep/fold lose "
           "only when > R of the list's top-k share a lane; the "

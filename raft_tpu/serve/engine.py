@@ -139,6 +139,21 @@ class _Handle:
             return ivf_flat.search(self.search_params, self.index, qdev, k,
                                    prefilter=filt)
         if self.algo == "ivf_pq":
+            kind = getattr(self.index, "cache_kind", "none")
+            if kind == "rabitq" and (
+                    self.raw_dataset is not None
+                    or int(self.index.codes.shape[-1]) > 0):
+                # the rabitq rung IS a multi-stage pipeline: sign-bit
+                # first stage + exact rerank, with tombstone/user
+                # prefilters composed into the first stage so filtered
+                # rows never reach the shortlist (docs/serving.md §5).
+                # Rerank source: the generation's raw row store when
+                # serving kept it, else the index's own PQ codes.
+                rr = self.refine_ratio if self.refine_ratio > 1 else 4
+                return ivf_pq.search_refined(
+                    self.search_params, self.index, qdev, k,
+                    refine_ratio=rr, prefilter=filt,
+                    dataset=self.raw_dev())
             if self.refine_ratio > 1 and self.raw_dataset is not None:
                 kc = min(k * self.refine_ratio, self.rows)
                 d, i = ivf_pq.search(self.search_params, self.index, qdev,

@@ -29,8 +29,12 @@ cpp/scripts/heuristics/select_k). Ops:
 ``pq_scan``
     end-to-end IVF-PQ search per cache kind — i8 decoded residuals
     (1 MXU pass), packed-i4 raw residuals (1 pass, in-kernel nibble
-    decode), pq4 transposed codes (16-pass one-hot contraction). Only
-    the recall-tied half-byte rungs (i4/pq4) compete for
+    decode), pq4 transposed codes (16-pass one-hot contraction), and
+    the rabitq sign-bit rung TIMED THROUGH ITS RERANK PIPELINE
+    (``search_refined``, codes rerank). The race is matched-recall:
+    arms that cannot clear the finest classic rung's recall − 0.01 are
+    filtered out before any timing (the ``binned_loss_fits``
+    eligibility pattern). The recall-band survivors compete for
     ``cache_dtype="auto"``'s sub-i8-budget slot (``_cache_kind_for``
     keeps the finest rung whenever it fits); i8's time is captured for
     the record.
@@ -299,14 +303,69 @@ def bench_fused_topk(key: Dict, candidates: Optional[List[str]] = None,
     return times
 
 
+def _pq_oracle_ids(data, queries, k: int):
+    """Exact L2 top-k ids for the shared pq_scan workload (the recall
+    judge for the matched-recall race below)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(data)
+    q = jnp.asarray(queries)
+    d2 = (jnp.sum(q * q, 1)[:, None] + jnp.sum(x * x, 1)[None, :]
+          - 2.0 * q @ x.T)
+    _, ids = jax.lax.top_k(-d2, k)
+    return np.asarray(ids)
+
+
+def _pq_recall(ids, want) -> float:
+    """Set-intersection recall@k — THE one implementation
+    (bench.harness.compute_recall), so the dispatch race's recall gate
+    can never drift from bench's reported recall."""
+    from raft_tpu.bench.harness import compute_recall
+
+    return float(compute_recall(np.asarray(ids), np.asarray(want)))
+
+
+def rabitq_matched_refine_ratio(recalls: Dict[int, float],
+                                target: float) -> Optional[int]:
+    """Smallest refine_ratio whose measured pipeline recall clears
+    ``target`` — or None when no ratio does (the arm is then filtered
+    out of the race entirely). The loss-aware-eligibility pattern from
+    ``ivf_scan.binned_loss_fits``: an arm that cannot hit the caller's
+    recall band must be excluded BEFORE the race, because the table key
+    carries no recall dimension and ``DispatchTable.lookup`` never
+    consults the runner-up."""
+    for rr in sorted(recalls):
+        if recalls[rr] >= target:
+            return rr
+    return None
+
+
+# refine ratios the rabitq arm may race at (the acceptance band caps
+# the pipeline at <= 4; larger ratios would change the op's semantics)
+_RABITQ_RATIOS = (2, 4)
+
+
 def bench_pq_scan(key: Dict, candidates: List[str],
                   reps: int = _DEF_REPS):
     """Time end-to-end IVF-PQ search per cache kind at ``key``. The
-    build uses pq_bits=4 so all three kinds (i8/i4/pq4) are feasible on
-    one quantizer config; search runs with lut_dtype="auto" (cache scan
-    — the path the choice governs). Returns (times, key) with the key
-    enriched by the built geometry (cap/rot/pq_bits — the fields
-    ``_cache_kind_for`` looks up by)."""
+    build uses pq_bits=4 so the classic kinds (i8/i4/pq4) are feasible
+    on one quantizer config; search runs with lut_dtype="auto" (cache
+    scan — the path the choice governs). Returns (times, key) with the
+    key enriched by the built geometry (cap/rot/pq_bits — the fields
+    ``_cache_kind_for`` looks up by).
+
+    The race is MATCHED-RECALL (ISSUE 11): each arm's recall vs the
+    exact oracle is measured first; the target is the finest SUB-i8
+    classic rung's recall minus 0.01 (the acceptance band — the entry
+    decides the sub-i8 auto slot, so i8 must not set the bar), and an
+    arm that cannot hit it is filtered out BEFORE any timing — the
+    ``binned_loss_fits`` eligibility pattern, because a table winner is
+    never re-filtered by recall at dispatch time. The "rabitq" arm is
+    timed through its WHOLE pipeline (``search_refined`` at the
+    smallest refine_ratio <= 4 that clears the target; codes rerank),
+    so its time is end-to-end honest against the single-stage kinds.
+    Sub-target recalls are recorded in the key for the table record."""
     from raft_tpu.neighbors import ivf_pq
 
     key = dict(key)
@@ -315,8 +374,10 @@ def bench_pq_scan(key: Dict, candidates: List[str],
     n_probes = int(key.get("n_probes", 8))
     pq_dim = int(key.get("pq_dim", 32))
     data, queries = _scan_dataset(n=int(key.get("n", _SCAN_N)))
-    times: Dict[str, float] = {}
-    for kind in ("i8", "i4", "pq4"):
+    want = _pq_oracle_ids(data, queries, k)
+    built: Dict[str, tuple] = {}      # kind -> (index, search thunk)
+    recalls: Dict[str, float] = {}
+    for kind in ("i8", "i4", "pq4", "rabitq"):
         if kind not in candidates:
             continue
         params = ivf_pq.IndexParams(
@@ -331,12 +392,49 @@ def bench_pq_scan(key: Dict, candidates: List[str],
             key.setdefault("rot", int(index.rot_dim))
             key.setdefault("pq_bits", 4)
             sp = ivf_pq.SearchParams(n_probes=n_probes)
+            if kind == "rabitq":
+                rr_rec = {}
+                for rr in _RABITQ_RATIOS:
+                    _, ids = ivf_pq.search_refined(sp, index, queries, k,
+                                                   refine_ratio=rr)
+                    rr_rec[rr] = _pq_recall(ids, want)
+                built[kind] = (index, sp, rr_rec)
+            else:
+                _, ids = ivf_pq.search(sp, index, queries, k)
+                recalls[kind] = _pq_recall(ids, want)
+                built[kind] = (index, sp, None)
+        except Exception:  # noqa: BLE001 - kind unavailable on backend
+            continue
+    # matched-recall target: the finest SUB-i8 classic rung present,
+    # minus the acceptance band's 0.01. NOT i8's recall — the table
+    # entry decides the sub-i8 "auto" slot (dispatch only consults it
+    # when i8 misses the budget, with sub-i8 candidates), so a target
+    # set by i8 would filter every actual competitor and leave a
+    # winner=i8 entry the lookup can never use (review fix, r10).
+    # i8 is still timed below, for the record.
+    classic = [recalls[kk] for kk in ("i4", "pq4") if kk in recalls]
+    target = (max(classic) - 0.01) if classic else 0.0
+    key["recall_target"] = round(target, 4)
+    times: Dict[str, float] = {}
+    for kind, (index, sp, rr_rec) in built.items():
+        if kind == "rabitq":
+            rr = rabitq_matched_refine_ratio(rr_rec, target)
+            key["rabitq_recall"] = round(max(rr_rec.values()), 4)
+            if rr is None:
+                continue              # can't hit the band: not raced
+            key["rabitq_refine_ratio"] = int(rr)
+            times[kind] = _median_ms(
+                lambda sp=sp, ix=index, rr=rr: ivf_pq.search_refined(
+                    sp, ix, queries, k, refine_ratio=rr),
+                reps,
+            )
+        else:
+            if recalls.get(kind, 0.0) < target:
+                continue              # below the band: not raced
             times[kind] = _median_ms(
                 lambda sp=sp, ix=index: ivf_pq.search(sp, ix, queries, k),
                 reps,
             )
-        except Exception:  # noqa: BLE001 - kind unavailable on backend
-            continue
     return times, key
 
 
@@ -497,7 +595,8 @@ def capture(backend: Optional[str] = None, quick: bool = True,
                     f"{t.record('ivf_scan', key, times)} {times}")
     if "pq_scan" in want:
         for key in pq_grid(quick):
-            times, key = bench_pq_scan(key, ["i8", "i4", "pq4"], reps=reps)
+            times, key = bench_pq_scan(key, ["i8", "i4", "pq4", "rabitq"],
+                                       reps=reps)
             if times:
                 log(f"pq_scan {key} -> "
                     f"{t.record('pq_scan', key, times)} {times}")

@@ -8,7 +8,9 @@ dispatch — select_k / merge_topk (lax.top_k vs tournament vs
 hierarchical), ivf_scan (fused Pallas kernel vs XLA bucketized scan),
 ivf_scan_extract (in-kernel extraction arms incl. the unextracted
 fold), fused_topk_tile (brute-force scan vs fused kernel per
-variant/row-tile), pq_scan (i8/i4/pq4 cache kinds) — over a shape
+variant/row-tile), pq_scan (i8/i4/pq4/rabitq cache kinds — the rabitq
+arm races its whole rerank pipeline at matched recall, and arms that
+cannot hit the recall band are filtered before timing) — over a shape
 grid, plus the environment byte budgets, and writes
 ``raft_tpu/tuning/tables/<backend>.json``. Consumers pick these
 winners up automatically through ``raft_tpu.tuning.choose`` (knob:

@@ -9,6 +9,10 @@ the real Mosaic-compiled kernels on the TPU:
 
 * ivf_scan.fused_list_scan_topk (exact + binned + binned-deep + fold)
   vs the XLA bucketized scan on identical inputs,
+* the rabitq sign-bit first stage (packed_bits kernel arm) vs the XLA
+  estimator scan + the multi-stage rerank pipeline vs the i4 band
+  (check_rabitq — chip day picks the ISSUE-11 rung up with no code
+  change),
 * fused_topk.fused_topk (exact + fold brute-force kernel) vs the
   hardware-top_k oracle (ids bitwise on the exact arm),
 * beam_step.beam_merge_step (scored + packed variants) vs the numpy
@@ -96,6 +100,55 @@ def check_ivf_pq_scan(results):
         "recall_pallas": round(recalls["pallas"], 4),
         "ok": bool(recalls["pallas"] > recalls["xla"] - 0.05
                    and recalls["pallas"] > 0.7),
+    }
+
+
+def check_rabitq(results):
+    """The rabitq rung on real Mosaic (ISSUE 11): sign-bit first-stage
+    kernel vs the XLA estimator scan (recall agreement — separate
+    implementations of the same estimator), plus the full multi-stage
+    pipeline (first stage + codes rerank) against the exact oracle at
+    refine_ratio 4 vs the i4 rung's band."""
+    from raft_tpu.neighbors import ivf_pq
+    from tests.oracles import naive_knn, eval_recall
+
+    rng = np.random.default_rng(12)
+    n, d, m, k = 20_000, 96, 512, 64
+    # blob rows + perturbed-row queries (the tier-1 acceptance shape,
+    # tests/test_ivf_pq.py::test_rabitq_pipeline_recall_band): a query
+    # near its true neighbors gives the 1-bit estimator distance gaps
+    # to resolve — pure-noise queries at this dim are the documented
+    # hostile regime (docs/kernels.md §rabitq) and sit ~0.13 below
+    centers = rng.uniform(-5, 5, (64, d)).astype(np.float32)
+    x = (centers[rng.integers(0, 64, n)]
+         + rng.standard_normal((n, d))).astype(np.float32)
+    q = (x[rng.integers(0, n, m)]
+         + 0.3 * rng.standard_normal((m, d))).astype(np.float32)
+    index = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=32, pq_dim=48, kmeans_n_iters=5,
+                           cache_dtype="rabitq"), x)
+    _, want = naive_knn(q, x, k)
+    recalls = {}
+    for impl in ("xla", "pallas"):
+        sp = ivf_pq.SearchParams(n_probes=32, local_recall_target=1.0,
+                                 scan_impl=impl)
+        _, ii = ivf_pq.search(sp, index, q, k)
+        recalls[impl] = eval_recall(np.asarray(ii), want)
+    sp = ivf_pq.SearchParams(n_probes=32)
+    _, ir = ivf_pq.search_refined(sp, index, q, k, refine_ratio=4)
+    r_pipe = eval_recall(np.asarray(ir), want)
+    index_i4 = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=32, pq_dim=48, kmeans_n_iters=5,
+                           cache_dtype="i4"), x)
+    _, i4ids = ivf_pq.search(sp, index_i4, q, k)
+    r_i4 = eval_recall(np.asarray(i4ids), want)
+    results["rabitq"] = {
+        "recall_stage1_xla": round(recalls["xla"], 4),
+        "recall_stage1_pallas": round(recalls["pallas"], 4),
+        "recall_pipeline_rr4": round(r_pipe, 4),
+        "recall_i4": round(r_i4, 4),
+        "ok": bool(abs(recalls["pallas"] - recalls["xla"]) < 0.05
+                   and r_pipe > r_i4 - 0.01),
     }
 
 
@@ -233,8 +286,9 @@ def main():
     t0 = time.time()
     results = {"platform": jax.devices()[0].platform,
                "device": str(jax.devices()[0])}
-    for fn in (check_ivf_scan, check_ivf_pq_scan, check_fused_topk,
-               check_beam_step, check_cagra, check_kernel_contracts):
+    for fn in (check_ivf_scan, check_ivf_pq_scan, check_rabitq,
+               check_fused_topk, check_beam_step, check_cagra,
+               check_kernel_contracts):
         try:
             fn(results)
         except Exception as e:  # noqa: BLE001 - record, keep going

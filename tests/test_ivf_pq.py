@@ -436,8 +436,12 @@ def test_pq4_cache_roundtrip_and_guards(dataset, tmp_path):
     _, i0 = ivf_pq.search(sp, index, q[:30], 10)
     _, i1 = ivf_pq.search(sp, loaded, q[:30], 10)
     np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
-    with pytest.raises(ValueError, match="RESIDUAL"):
+    with pytest.raises(ValueError, match="rerank source"):
         ivf_pq.search_refined(sp, index, q[:10], 10)
+    # ... but an explicit dataset IS a finer source for pq4 too
+    d_ds, i_ds = ivf_pq.search_refined(sp, index, q[:10], 10,
+                                       refine_ratio=2, dataset=x)
+    assert np.asarray(i_ds).shape == (10, 10)
 
 
 def test_cache_disabled_matches(dataset):
@@ -789,3 +793,273 @@ def test_fused_scan_packed_i4_kernel_oracle():
         np.testing.assert_array_equal(out_i[b], want_i)
         np.testing.assert_allclose(
             out_d[b], np.sort(d2, axis=1)[:, :k], rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# rabitq sign-bit rung + multi-stage rerank pipeline (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dataset96():
+    """The rabitq acceptance dataset: 96-dim blobs with queries drawn as
+    perturbed data rows (the realistic ANN shape — a query sits near its
+    true neighbors, so distance gaps exist for the 1-bit estimator to
+    resolve; pure-noise queries at low dim are the known-hostile regime,
+    docs/kernels.md §rabitq)."""
+    rng = np.random.default_rng(11)
+    n, d = 4000, 96
+    centers = rng.uniform(-5, 5, (32, d)).astype(np.float32)
+    x = (centers[rng.integers(0, 32, n)]
+         + rng.standard_normal((n, d))).astype(np.float32)
+    qi = rng.integers(0, n, 100)
+    q = (x[qi] + 0.3 * rng.standard_normal((100, d))).astype(np.float32)
+    return x, q
+
+
+def test_pack_sign_bits_roundtrip():
+    """Sign-bit pack/unpack at word-aligned AND partial-last-word dims."""
+    rng = np.random.default_rng(3)
+    for d in (64, 48, 33):
+        v = rng.standard_normal((5, d)).astype(np.float32)
+        packed = np.asarray(ivf_pq.pack_sign_bits(v))
+        assert packed.shape == (5, -(-d // 32))
+        signs = np.asarray(ivf_pq.unpack_sign_bits(packed, d))
+        np.testing.assert_array_equal(signs, np.where(v > 0, 1.0, -1.0))
+
+
+def test_rabitq_estimator_scalars():
+    """fac = ||r||²/||r||₁ and <r̂, r> = ||r||² exactly (the RaBitQ
+    collinearity correction)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    r = rng.standard_normal((16, 40)).astype(np.float32)
+    packed, fac, n2 = ivf_pq._quant_pack_rabitq(jnp.asarray(r))
+    signs = np.asarray(ivf_pq.unpack_sign_bits(packed, 40))
+    rhat = np.asarray(fac)[:, None] * signs
+    np.testing.assert_allclose((rhat * r).sum(1), np.asarray(n2),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(n2), (r * r).sum(1), rtol=1e-5)
+
+
+def test_rabitq_cache_build(dataset):
+    x, q = dataset
+    index = _build(x, cache_dtype="rabitq")
+    assert index.cache_kind == "rabitq"
+    C, cap = index.indices.shape
+    nwb = -(-index.rot_dim // 32)
+    assert index.recon_cache.shape == (C, nwb, cap)
+    assert index.recon_cache.dtype == np.uint32
+    assert index.cache_fac.shape == (C, cap)
+    assert index.cache_qnorms.shape == (C, cap)
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "inner_product"])
+def test_rabitq_scan_interpret_matches_xla(dataset, metric):
+    """The Pallas packed_bits arm and the XLA estimator scan are two
+    implementations of the same estimator — rankings must agree."""
+    x, q = dataset
+    index = _build(x, metric=metric, cache_dtype="rabitq")
+    sp_x = ivf_pq.SearchParams(n_probes=16, scan_impl="xla",
+                               local_recall_target=1.0)
+    sp_p = ivf_pq.SearchParams(n_probes=16, scan_impl="pallas_interpret",
+                               local_recall_target=1.0)
+    _, ix_ = ivf_pq.search(sp_x, index, q[:64], 10)
+    _, ip_ = ivf_pq.search(sp_p, index, q[:64], 10)
+    ix_, ip_ = np.asarray(ix_), np.asarray(ip_)
+    # the two paths round differently (the XLA body casts ±fac rows to
+    # bf16; the kernel scales f32 dots by fac after the ±1 matmul), so
+    # judge rankings as SETS: the estimator's dense near-ties reorder
+    # exact positions without selection consequence
+    overlap = np.mean([len(np.intersect1d(a, b)) / len(b)
+                       for a, b in zip(ix_, ip_)])
+    assert overlap > 0.8, overlap
+    _, want = naive_knn(q[:64], x, 10, metric=metric)
+    r_x = eval_recall(ix_, want)
+    r_p = eval_recall(ip_, want)
+    assert abs(r_x - r_p) < 0.05, (r_x, r_p)
+
+
+def test_rabitq_pipeline_recall_band(dataset96):
+    """ISSUE 11 acceptance: first stage + exact rerank matches the i4
+    rung's recall band (within 0.01) at refine_ratio <= 4."""
+    x, q = dataset96
+    k = 64
+    _, want = naive_knn(q, x, k)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    rbq = ivf_pq.build(ivf_pq.IndexParams(
+        n_lists=16, pq_dim=48, kmeans_n_iters=6, cache_dtype="rabitq"), x)
+    i4 = ivf_pq.build(ivf_pq.IndexParams(
+        n_lists=16, pq_dim=48, kmeans_n_iters=6, cache_dtype="i4"), x)
+    _, ids_i4 = ivf_pq.search(sp, i4, q, k)
+    r_i4 = eval_recall(np.asarray(ids_i4), want)
+    _, ids_rb = ivf_pq.search_refined(sp, rbq, q, k, refine_ratio=4)
+    r_rb = eval_recall(np.asarray(ids_rb), want)
+    assert r_rb > r_i4 - 0.01, (r_rb, r_i4)
+    # the first stage alone is NOT in the band — the pipeline is the rung
+    _, ids_s1 = ivf_pq.search(sp, rbq, q, k)
+    assert eval_recall(np.asarray(ids_s1), want) < r_rb
+
+
+def test_rabitq_bytes_ladder():
+    """The rows-per-HBM-byte ladder figure: rabitq's quantized payload
+    is >= 4x smaller than i4's (exactly 4x at word-aligned rot), and
+    the honest total (scalars + id row included) still >= 2x."""
+    for rot in (64, 96, 128):
+        i4_code, i4_total = ivf_pq.scan_bytes_per_row("i4", rot)
+        rb_code, rb_total = ivf_pq.scan_bytes_per_row("rabitq", rot)
+        assert i4_code >= 4 * rb_code, (rot, i4_code, rb_code)
+        assert i4_total >= 2 * rb_total, (rot, i4_total, rb_total)
+
+
+def test_rabitq_prefilter_composes(dataset):
+    """Tombstone/user bitsets compose with the FIRST stage: filtered
+    ids never reach the shortlist or the reranked answer."""
+    x, q = dataset
+    index = _build(x, cache_dtype="rabitq")
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, base = ivf_pq.search_refined(sp, index, q[:50], 10, refine_ratio=4)
+    banned = set(np.asarray(base)[:, 0].tolist()) - {-1}
+    bits = Bitset(x.shape[0])
+    bits = bits.set(np.asarray(sorted(banned), np.int32), False)
+    _, got = ivf_pq.search_refined(sp, index, q[:50], 10, refine_ratio=4,
+                                   prefilter=bits)
+    got = np.asarray(got)
+    assert not (set(got[got >= 0].ravel().tolist()) & banned)
+    # and the same filter composes with the dataset-rerank path
+    _, got2 = ivf_pq.search_refined(sp, index, q[:50], 10, refine_ratio=4,
+                                    prefilter=bits, dataset=x)
+    got2 = np.asarray(got2)
+    assert not (set(got2[got2 >= 0].ravel().tolist()) & banned)
+
+
+def test_rabitq_dataset_rerank_beats_codes(dataset96):
+    """dataset= reranks from the f32 originals — at least as good as
+    the PQ-codes rerank."""
+    x, q = dataset96
+    k = 10
+    _, want = naive_knn(q, x, k)
+    index = ivf_pq.build(ivf_pq.IndexParams(
+        n_lists=16, pq_dim=48, kmeans_n_iters=6, cache_dtype="rabitq"), x)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, i_codes = ivf_pq.search_refined(sp, index, q, k, refine_ratio=4)
+    _, i_ds = ivf_pq.search_refined(sp, index, q, k, refine_ratio=4,
+                                    dataset=x)
+    r_codes = eval_recall(np.asarray(i_codes), want)
+    r_ds = eval_recall(np.asarray(i_ds), want)
+    assert r_ds >= r_codes - 0.02, (r_ds, r_codes)
+
+
+def test_rabitq_save_load(dataset, tmp_path):
+    """The sign-bit cache + fac/norm sidecars survive serialization
+    (streamed builds binarize RAW residuals — a rebuild from codes
+    would lose that, so the cache is always serialized)."""
+    x, q = dataset
+    index = _build(x, cache_dtype="rabitq")
+    p = str(tmp_path / "rbq.idx")
+    ivf_pq.save(p, index)
+    loaded = ivf_pq.load(p)
+    assert loaded.cache_kind == "rabitq"
+    np.testing.assert_array_equal(np.asarray(loaded.recon_cache),
+                                  np.asarray(index.recon_cache))
+    np.testing.assert_array_equal(np.asarray(loaded.cache_fac),
+                                  np.asarray(index.cache_fac))
+    sp = ivf_pq.SearchParams(n_probes=8)
+    _, i0 = ivf_pq.search_refined(sp, index, q[:30], 10, refine_ratio=2)
+    _, i1 = ivf_pq.search_refined(sp, loaded, q[:30], 10, refine_ratio=2)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+def test_rabitq_extend_rebuilds_cache(dataset):
+    x, q = dataset
+    index = _build(x[:5000], cache_dtype="rabitq")
+    bigger = ivf_pq.extend(index, x[5000:])
+    assert bigger.cache_kind == "rabitq"
+    assert int(bigger.size) == x.shape[0]
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, ids = ivf_pq.search_refined(sp, bigger, q[:30], 10, refine_ratio=4)
+    assert np.asarray(ids).max() >= 5000  # new rows reachable
+
+
+def test_attach_rabitq_cache_swaps_rung(dataset):
+    x, q = dataset
+    index = _build(x, cache_dtype="i8")
+    assert index.cache_kind == "i8"
+    rbq = ivf_pq.attach_rabitq_cache(index)
+    assert rbq.cache_kind == "rabitq"
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, ids = ivf_pq.search_refined(sp, rbq, q[:30], 10, refine_ratio=4)
+    assert np.asarray(ids).shape == (30, 10)
+
+
+def test_rabitq_streamed_build():
+    """build_streamed handles the rabitq cache-kind honestly (ISSUE 11
+    satellite): streamed scatter of sign codes + fac/norm scalars, both
+    keep_codes modes; the streamed cache binarizes the RAW residual."""
+    import jax.numpy as jnp
+    from tests.oracles import naive_knn, eval_recall
+
+    rng = np.random.default_rng(13)
+    n, d, bs, k = 5000, 64, 1024, 10
+    centers = rng.uniform(-4, 4, (16, d)).astype(np.float32)
+    x = (centers[rng.integers(0, 16, n)]
+         + rng.standard_normal((n, d))).astype(np.float32)
+    params = ivf_pq.IndexParams(
+        n_lists=16, pq_dim=32, kmeans_n_iters=5,
+        kmeans_trainset_fraction=1.0, cache_dtype="rabitq",
+    )
+
+    def make_batches():
+        xd = jnp.asarray(x)
+        npad = -(-n // bs) * bs
+        xp = jnp.pad(xd, ((0, npad - n), (0, 0)))
+        for off in range(0, npad, bs):
+            yield xp[off:off + bs]
+
+    # keep_codes=True: codes + sign cache + separate qnorms
+    got = ivf_pq.build_streamed(params, make_batches, n, d, trainset=x)
+    assert got.cache_kind == "rabitq"
+    assert got.cache_qnorms is not None and got.cache_fac is not None
+    q = x[:100] + 0.3 * rng.standard_normal((100, d)).astype(np.float32)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, ids = ivf_pq.search_refined(sp, got, q, k, refine_ratio=4)
+    _, want = naive_knn(q, x, k)
+    assert eval_recall(np.asarray(ids), want) > 0.6
+    # keep_codes=False: cache-only — first stage serves from the cache,
+    # rerank needs an explicit dataset (no finer source on the index)
+    got2 = ivf_pq.build_streamed(params, make_batches, n, d, trainset=x,
+                                 keep_codes=False)
+    assert got2.cache_kind == "rabitq" and got2.codes.shape[-1] == 0
+    _, ids2 = ivf_pq.search(sp, got2, q, k)
+    assert np.asarray(ids2).shape == (100, k)
+    with pytest.raises(ValueError, match="rerank source"):
+        ivf_pq.search_refined(sp, got2, q, k, refine_ratio=4)
+    _, ids3 = ivf_pq.search_refined(sp, got2, q, k, refine_ratio=4,
+                                    dataset=x)
+    assert eval_recall(np.asarray(ids3), want) > 0.6
+
+
+def test_rabitq_slot_prefilter_invalidates_on_mutation(dataset):
+    """Review fix (r10): a keep-mode filter narrower than the index
+    materializes at _version == 1 every time, so the slot-filter cache
+    must key on the SOURCE bitset's version — mutating the filter
+    between pipeline calls must evict the cached slot translation."""
+    from raft_tpu.neighbors.common import BitsetFilter
+
+    x, q = dataset
+    index = _build(x[:5000], cache_dtype="rabitq")
+    index = ivf_pq.extend(index, x[5000:])        # filter narrower than n
+    sp = ivf_pq.SearchParams(n_probes=16)
+    bits = Bitset(5000)                           # keep-mode: new rows kept
+    filt = BitsetFilter(bits, out_of_range="keep")
+    _, i0 = ivf_pq.search_refined(sp, index, q[:40], 10, refine_ratio=4,
+                                  prefilter=filt)
+    victim = int(np.asarray(i0)[0, 0])
+    if victim >= 5000:                            # pick an in-range id
+        cand = np.asarray(i0).ravel()
+        victim = int(cand[(cand >= 0) & (cand < 5000)][0])
+    bits.set(np.asarray([victim], np.int32), False)   # in-place mutation
+    _, i1 = ivf_pq.search_refined(sp, index, q[:40], 10, refine_ratio=4,
+                                  prefilter=filt)
+    assert victim not in np.asarray(i1), "stale cached slot filter served"

@@ -141,6 +141,33 @@ def test_non_pow2_max_k_warm_and_served(data):
         assert serve.trace_cache_sizes() == before
 
 
+def test_rabitq_rung_serves_trace_stable(data):
+    """ISSUE 11: the rabitq multi-stage pipeline is reachable from serve
+    (ivf_pq index with a rabitq cache routes through search_refined,
+    tombstones composing with the first stage) and steady-state serving
+    adds ZERO XLA traces — the warmup ladder covers both pipeline
+    stages."""
+    from raft_tpu.neighbors import ivf_pq
+
+    x, q = data
+    bp = ivf_pq.IndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=4,
+                            cache_dtype="rabitq")
+    with serve.Server(_params(max_k=8)) as srv:    # warmup on
+        srv.create_index("default", x, algo="ivf_pq", build_params=bp,
+                         search_params=ivf_pq.SearchParams(n_probes=8))
+        before = serve.trace_cache_sizes()
+        d, i = srv.search(q[:5], 4)
+        assert i.shape == (5, 4)
+        assert (np.asarray(i) >= 0).all()
+        # delete a served id: the tombstone must compose with the FIRST
+        # stage (the deleted row never reaches the rerank shortlist)
+        victim = int(np.asarray(i)[0, 0])
+        srv.delete([victim])
+        _, i2 = srv.search(q[:5], 4)
+        assert victim not in np.asarray(i2)
+        assert serve.trace_cache_sizes() == before
+
+
 def test_submit_validation(data):
     x, _ = data
     with serve.Server(_params(warmup=False)) as srv:

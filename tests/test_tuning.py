@@ -437,3 +437,42 @@ def test_kernel_shape_candidates_cover_winner_domain(tmp_path):
         assert 768 in tuning.kernel_shape_candidates()["tile_n"]
     finally:
         tuning.set_table_path(None)
+
+
+def test_rabitq_matched_refine_ratio_filter():
+    """The pq_scan race's loss-aware eligibility (ISSUE 11): the rabitq
+    arm races at the smallest refine_ratio that clears the recall
+    target, and is filtered out entirely — BEFORE any timing — when no
+    ratio does (the binned_loss_fits pattern: a table winner is never
+    recall-re-filtered at dispatch)."""
+    from raft_tpu.tuning.microbench import rabitq_matched_refine_ratio
+
+    assert rabitq_matched_refine_ratio({2: 0.9, 4: 0.95}, 0.88) == 2
+    assert rabitq_matched_refine_ratio({2: 0.80, 4: 0.92}, 0.88) == 4
+    assert rabitq_matched_refine_ratio({2: 0.5, 4: 0.6}, 0.88) is None
+    assert rabitq_matched_refine_ratio({}, 0.88) is None
+
+
+def test_pq_scan_auto_ladder_rabitq_gating():
+    """cache-kind resolution: rabitq is reachable explicitly and as a
+    MEASURED table winner, but the analytic auto fallback never picks
+    it — when nothing fits the budget, auto still returns None so
+    plain search keeps its exact PQ code scan (a silent 1-bit
+    downgrade would regress plain-search recall; review fix, r10)."""
+    from raft_tpu.neighbors.ivf_pq import _CACHE_BUDGET, _cache_kind_for
+    from raft_tpu import tuning
+
+    # explicit request, always feasible at small scale (any rot —
+    # partial last word is padded)
+    assert _cache_kind_for(True, "rabitq", 4, 128, 48) == "rabitq"
+    # shapes where i8/i4/pq4 all blow the budget but the 1-bit cache
+    # fits: the auto FALLBACK must stay None (tuning off = pure
+    # analytic answer)
+    C = 1024
+    cap = 8192
+    rot = (_CACHE_BUDGET // (C * cap) + 8) // 8 * 8 + 256
+    tuning.set_mode("off")
+    try:
+        assert _cache_kind_for(True, "auto", C, cap, rot + 4) is None
+    finally:
+        tuning.set_mode(None)
