@@ -335,6 +335,44 @@ def bench_ivfpq_deep10m(results):
     except Exception as e:  # noqa: BLE001 - keep the headline alive
         results["ivfpq_cache_refined_error"] = repr(e)[:200]
 
+    # + tiered host-tier refine (ISSUE 12, docs/serving.md §12): the
+    # f32 originals stay HOST-resident — only each batch's unique
+    # shortlist rows cross the link (vs the x_dev full upload the
+    # f32-refined config above is built on). Wall-clock timed: the
+    # host gather sits outside the jit chain, so scan_qps_time's
+    # scan-chained methodology cannot carry it. Emits the
+    # bytes-moved-per-query column ROADMAP item 3 budgets against.
+    try:
+        from raft_tpu.neighbors import tiered as _tiered
+
+        src_t = _tiered.HostArraySource(x, hot_rows=65536)
+
+        def search_tiered(qq):
+            return ivf_pq.search_refined(sp, index, qq, k,
+                                         refine_ratio=3, dataset=src_t)
+
+        dist_t, idx_t = search_tiered(q)
+        jax.block_until_ready(idx_t)
+        assert np.array_equal(np.asarray(idx_t), np.asarray(idx_r)), \
+            "tiered rerank diverged from the full-upload refine"
+        results["ivfpq_tiered_refined_recall"] = round(float(
+            compute_recall(np.asarray(idx_t[:sub]), np.asarray(mi))), 3)
+        st0 = src_t.stats()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(search_tiered(q))
+        s = (time.perf_counter() - t0) / 3
+        st1 = src_t.stats()
+        results["ivfpq_tiered_refined_qps"] = round(nq / s, 1)
+        results["ivfpq_tiered_bytes_per_query"] = round(
+            (st1["bytes_moved"] - st0["bytes_moved"]) / (3 * nq), 1)
+        results["ivfpq_tiered_hot_hit_rate"] = round(
+            st1["hit_rate_hbm"], 4)
+        results["ivfpq_tiered_timing"] = "wall-clock (host gather)"
+        del src_t
+    except Exception as e:  # noqa: BLE001 - keep the headline alive
+        results["ivfpq_tiered_refined_error"] = repr(e)[:200]
+
     # + the rabitq rung (ISSUE 11): 1-bit sign-code first stage + exact
     # rerank from the PQ codes — the rows-per-HBM-byte ladder's bottom
     # step. Emits TWO byte columns per arm (cost model:

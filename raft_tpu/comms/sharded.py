@@ -307,6 +307,7 @@ def sharded_ivf_pq_search(
     axis_name: str = "shard",
     refine_ratio: int = 1,
     partial_ok: bool = False,
+    rerank_source=None,
 ) -> Tuple[jax.Array, ...]:
     """Approximate KNN with the IVF-PQ index's *lists* sharded over the
     mesh — the DEEP-1B-scale model (the reference fits DEEP-1B in 24 GiB
@@ -329,6 +330,16 @@ def sharded_ivf_pq_search(
     indices, decodes those slots from ITS OWN cache shard at f32, ranks
     exactly, and only the refined top-k rides the all-gather. Requires
     the index to carry a residual cache.
+
+    ``rerank_source`` (the tiered-memory shape, docs/serving.md §12)
+    reranks from HOST-resident originals INSTEAD of the per-shard
+    cache: a :class:`raft_tpu.neighbors.tiered.RerankSource` (or host
+    numpy/memmap array — wrapped per call). The shards then merge
+    their FIRST-stage top-``k*refine_ratio`` candidates, and the host
+    source fetches only the merged shortlist's unique rows for the
+    exact final ranking — no residual cache required, and
+    ``partial_ok`` composes (an uncovered shard's ``-1`` rows stay
+    invalid through the rerank; coverage passes through unchanged).
 
     ``partial_ok=True`` returns ``(dists, ids, coverage)`` with invalid
     shards masked out of the merge (module docstring).
@@ -382,11 +393,18 @@ def sharded_ivf_pq_search(
     internal = ivf_pq._norm_dtype_knob(search_params.internal_distance_dtype)
 
     refine_ratio = int(refine_ratio)
-    if refine_ratio > 1 and index.cache_kind not in ("i4", "i8"):
+    src = None
+    if rerank_source is not None:
+        from raft_tpu.neighbors import tiered
+
+        src = tiered.as_source(rerank_source)
+    cache_refine = refine_ratio > 1 and src is None
+    if cache_refine and index.cache_kind not in ("i4", "i8"):
         raise ValueError(
             "refine_ratio > 1 needs the decoded-RESIDUAL cache (i8/i4; "
-            "build with cache_decoded=True within the cache budget) — a "
-            "pq4 code cache carries no fidelity beyond the scan itself"
+            "build with cache_decoded=True within the cache budget) or "
+            "a host rerank_source= (neighbors.tiered) — a pq4 code "
+            "cache carries no fidelity beyond the scan itself"
         )
     k_search = k * refine_ratio
     if k_search > n_probes * cap:
@@ -394,6 +412,10 @@ def sharded_ivf_pq_search(
             f"k*refine_ratio={k_search} exceeds the per-shard candidate "
             f"pool (n_probes/shard={n_probes} x cap={cap})"
         )
+    # with a host rerank source the shards merge their FIRST-stage
+    # shortlists at full k_search width; the tiered rerank happens once
+    # on the merged candidates, host-side of the collective
+    k_merge = k_search if src is not None else k
 
     has_scales = has_cache and index.cache_scales is not None
     partial = partial_ok or faultinject.has_shard_faults()
@@ -406,7 +428,7 @@ def sharded_ivf_pq_search(
         qnorms = rest.pop(0) if has_scales else None
         bad = rest.pop(0) if partial else None
         rank = jax.lax.axis_index(axis_name)
-        search_ids = (ivf_pq._slot_indices(indices) if refine_ratio > 1
+        search_ids = (ivf_pq._slot_indices(indices) if cache_refine
                       else indices)
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
                   search_ids, list_sizes, rec_norms, None, cache,
@@ -420,7 +442,7 @@ def sharded_ivf_pq_search(
             float(search_params.merge_recall_target),
             lut, internal, int(index.pq_dim), int(index.pq_bits), "xla",
         )
-        if refine_ratio > 1:
+        if cache_refine:
             # per-shard cache-decoded exact re-rank, then slots -> ids
             d, s = ivf_pq._refine_slots(
                 q, i, int(k), metric, cache, scales, centers_rot,
@@ -433,7 +455,7 @@ def sharded_ivf_pq_search(
             d, i, valid = _mask_invalid(d, i, rank, bad, select_min)
         gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
         gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
-        md, mi = merge_topk(gd, gi, k, select_min)
+        md, mi = merge_topk(gd, gi, k_merge, select_min)
         if partial:
             return md, mi, _coverage(valid, axis_name)
         return md, mi
@@ -477,6 +499,15 @@ def sharded_ivf_pq_search(
                         queries=int(queries.shape[0]), k=int(k),
                         shards=int(nshards), refine_ratio=refine_ratio):
         out = jax.jit(fn)(*args)
+        if src is not None:
+            # tiered rerank over the MERGED shortlist: only its unique
+            # rows are fetched from the host source; uncovered shards'
+            # -1 rows stay invalid and sink at the exact ranking
+            md, mi = out[0], out[1]
+            with obs.span("sharded_ivf_pq.tiered_rerank",
+                          kc=int(k_merge)):
+                rd, ri = src.rerank(queries, mi, int(k), index.metric)
+            out = (rd, ri) + tuple(out[2:])
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_pq_search")
     _record_full_coverage("sharded_ivf_pq_search")

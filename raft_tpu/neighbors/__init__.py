@@ -19,7 +19,7 @@ from raft_tpu.neighbors.common import (
     merge_topk,
 )
 from raft_tpu.neighbors.refine import refine, refine_host
-from raft_tpu.neighbors import stream
+from raft_tpu.neighbors import stream, tiered
 
 __all__ = [
     "ball_cover",
@@ -32,6 +32,7 @@ __all__ = [
     "refine",
     "refine_host",
     "stream",
+    "tiered",
     "BitsetFilter",
     "IndexParams",
     "NoneSampleFilter",

@@ -2487,6 +2487,16 @@ def _slot_prefilter(index: Index, prefilter):
     return out
 
 
+def refined_shortlist_width(search_params: SearchParams, index: Index,
+                            k: int, refine_ratio: int) -> int:
+    """The first-stage over-fetch width ``search_refined`` uses for
+    ``k`` at ``refine_ratio`` — exposed so serve's warmup can trace the
+    tiered rerank at exactly the shortlist shapes dispatch will see."""
+    cap = index.indices.shape[1]
+    n_probes = int(min(search_params.n_probes, index.n_lists))
+    return max(int(k), min(int(k * refine_ratio), n_probes * cap))
+
+
 def search_refined(
     search_params: SearchParams,
     index: Index,
@@ -2506,9 +2516,15 @@ def search_refined(
     finest available source and slots resolve to global ids. Rerank
     source resolution:
 
-    * ``dataset`` given — exact f32/bf16 originals via
-      :mod:`~raft_tpu.neighbors.refine` (stage 1 returns global ids
-      directly; no slot indirection needed);
+    * ``dataset`` given — exact originals. A **device** ``jax.Array``
+      keeps the resident full-upload fast path
+      (:mod:`~raft_tpu.neighbors.refine`); a **host** numpy array or
+      ``np.memmap`` routes through the tiered shortlist-only fetch
+      (:class:`raft_tpu.neighbors.tiered.HostArraySource` — only the
+      unique shortlist rows ever cross the link, bitwise-identical
+      results); a :class:`~raft_tpu.neighbors.tiered.RerankSource`
+      instance is used as-is (the persistent hot-row-cache path).
+      Stage 1 returns global ids directly; no slot indirection needed;
     * i8/i4 residual cache — decoded at f32 on-chip (the billion-scale
       source: the dataset is never HBM-resident);
     * the packed PQ codes (rabitq indexes that kept them) — full PQ
@@ -2519,10 +2535,14 @@ def search_refined(
     space for the inner search). A pq4/no-cache index without a dataset
     still errors: its own scan is already exact PQ, so a codes rerank
     adds nothing. Rerank-stage observability (docs/observability.md):
-    ``rerank.queries_total``/``rerank.shortlist_rows``/
-    ``rerank.bytes_fetched_total{source}`` + the first-stage vs rerank
-    latency split (``rerank.stage_ms{stage}``, device-complete).
+    ``rerank.queries_total``/``rerank.shortlist_rows`` (valid slots
+    only)/``rerank.bytes_fetched_total{source}`` (unique rows on the
+    tiered path) + the first-stage vs rerank latency split
+    (``rerank.stage_ms{stage}``, device-complete), and ``tiered.*``
+    for the host tiers.
     """
+    from raft_tpu.neighbors import tiered as _tiered
+
     if refine_ratio < 1:
         raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
     kind = index.cache_kind
@@ -2537,31 +2557,30 @@ def search_refined(
             "exact PQ; for raw-dataset refine there, pass dataset= or "
             "use neighbors.refine"
         )
+    src_obj = None if dataset is None else _tiered.as_source(dataset)
     queries = jnp.asarray(queries)
     m = int(queries.shape[0])
-    cap = index.indices.shape[1]
-    n_probes = int(min(search_params.n_probes, index.n_lists))
-    kc = max(int(k), min(int(k * refine_ratio), n_probes * cap))
+    kc = refined_shortlist_width(search_params, index, k, refine_ratio)
     rot = index.rot_dim
+    fetch = None
     with obs.span("ivf_pq.search_refined", refine_ratio=int(refine_ratio),
                   k=int(k), cache_kind=kind) as _sp:
-        source = ("dataset" if dataset is not None
-                  else "cache" if kind in ("i8", "i4") else "codes")
-        if source == "dataset":
+        source = ("cache" if src_obj is None and kind in ("i8", "i4")
+                  else "codes" if src_obj is None
+                  else "host" if src_obj.kind == "host" else "dataset")
+        if src_obj is not None:
             with obs.span("ivf_pq.first_stage", kc=kc) as s1:
                 d1, ids1 = search(search_params, index, queries, kc,
                                   prefilter=prefilter)
                 if obs.enabled():
                     s1.sync(ids1)
-            from raft_tpu.neighbors.refine import refine as _refine_ds
-
-            dataset = jnp.asarray(dataset)
-            row_bytes = int(dataset.shape[1]) * dataset.dtype.itemsize
+            row_bytes = int(src_obj.row_bytes)
             with obs.span("ivf_pq.rerank", source=source) as s2:
-                d, ids = _refine_ds(dataset, queries, ids1, int(k),
-                                    index.metric)
+                d, ids, fetch = src_obj.rerank_info(queries, ids1,
+                                                    int(k), index.metric)
                 if obs.enabled():
                     s2.sync(ids)
+            shortlist = ids1
         else:
             slot_filter = _slot_prefilter(index, prefilter)
             slot_index = dataclasses.replace(
@@ -2596,15 +2615,31 @@ def search_refined(
                     -1)
                 if obs.enabled():
                     s2.sync(ids)
+            shortlist = slots
         if obs.enabled():
-            # the bytes-moved split ROADMAP item 3 budgets against:
-            # shortlist rows fetched at fidelity per query, and the
-            # stage latency split (device-complete when synced above)
+            # the bytes-moved split ROADMAP item 3 budgets against —
+            # counting what was ACTUALLY read at fidelity: valid
+            # shortlist slots only (when k*refine_ratio over-fetches
+            # past the available candidates the sentinel (-1) padding
+            # slots fetch nothing), and on the tiered host path the
+            # per-batch UNIQUE rows (the gather dedupes repeats before
+            # a byte moves). Stage latency is device-complete (synced
+            # above).
+            if source == "host" and fetch is not None:
+                valid_slots = int(fetch.valid_slots)
+                fetched_rows = int(fetch.unique_rows)
+            else:
+                # the shortlist is already host-synced by s1.sync above
+                valid_slots = int(np.count_nonzero(
+                    np.asarray(shortlist) >= 0))
+                fetched_rows = valid_slots
             obs.counter("rerank.queries_total", m, algo="ivf_pq")
-            obs.counter("rerank.shortlist_rows", m * kc, algo="ivf_pq")
-            obs.counter("rerank.bytes_fetched_total", m * kc * row_bytes,
-                        source=source)
-            obs.gauge("rerank.bytes_per_query", kc * row_bytes,
+            obs.counter("rerank.shortlist_rows", valid_slots,
+                        algo="ivf_pq")
+            obs.counter("rerank.bytes_fetched_total",
+                        fetched_rows * row_bytes, source=source)
+            obs.gauge("rerank.bytes_per_query",
+                      fetched_rows * row_bytes / max(m, 1),
                       source=source)
             if getattr(s1, "device_ms", None) is not None:
                 obs.observe("rerank.stage_ms", s1.device_ms,

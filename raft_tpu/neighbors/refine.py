@@ -53,6 +53,21 @@ def _refine(dataset, queries, candidates, k: int, metric_val: int):
     valid = candidates >= 0
     safe = jnp.where(valid, candidates, 0)
     cand_vecs = dataset[safe].astype(compute)  # [m, c, d]
+    return score_gathered(q, cand_vecs, candidates, k, metric)
+
+
+def score_gathered(q, cand_vecs, candidates, k: int,
+                   metric: DistanceType):
+    """Exact-scoring tail shared by every gathered-candidate rerank:
+    ``q`` [m, d] and ``cand_vecs`` [m, c, d] already at the compute
+    dtype, ``candidates`` [m, c] with < 0 marking invalid slots. ONE
+    home on purpose — :mod:`raft_tpu.neighbors.tiered` gathers the same
+    rows from its fetched/hot blocks instead of a resident dataset, and
+    the bitwise-identity acceptance (tiered vs full-upload rerank on
+    the same shortlist) holds exactly because both paths run THIS
+    arithmetic on value-identical operands. Called inside jit."""
+    compute = q.dtype
+    valid = candidates >= 0
 
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
         # ||q - v||^2 via einsum (MXU): q·v per (query, cand)
@@ -79,7 +94,8 @@ def _refine(dataset, queries, candidates, k: int, metric_val: int):
 
     sentinel = sentinel_for(metric, compute)
     d = jnp.where(valid, d, sentinel)
-    return merge_topk(d, candidates.astype(jnp.int32), k, is_min_close(metric))
+    return merge_topk(d, candidates.astype(jnp.int32), k,
+                      is_min_close(metric))
 
 
 def refine_host(

@@ -63,6 +63,9 @@ TRACKED_JITS = (
     ("raft_tpu.neighbors.cagra", "_beam_search"),
     ("raft_tpu.neighbors.cagra", "_beam_search_pallas"),
     ("raft_tpu.neighbors.refine", "_refine"),
+    ("raft_tpu.neighbors.tiered", "_score_fetched"),
+    ("raft_tpu.neighbors.tiered", "_score_fetched_hot"),
+    ("raft_tpu.neighbors.tiered", "_promote_scatter"),
     ("raft_tpu.serve.engine", "_merge_with_side"),
     ("raft_tpu.matrix.select_k", "_select_k"),
     ("raft_tpu.matrix.select_k", "_tournament_topk"),

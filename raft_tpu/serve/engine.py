@@ -68,6 +68,12 @@ from raft_tpu.serve.registry import Registry
 
 ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
 
+# the refine over-fetch a rabitq-cache index is served at when the
+# caller left refine_ratio defaulted — ONE home: _Handle.pipeline_rr
+# feeds dispatch AND warmup, which must agree or warmup traces the
+# wrong shortlist-width rungs and steady state silently recompiles
+RABITQ_DEFAULT_REFINE_RATIO = 4
+
 # latency histogram edges tuned for ms-scale online serving
 _LAT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000)
 
@@ -87,6 +93,19 @@ class ServeParams:
     dispatch_retries: int = 2       # classified transient/dead retries
     retry_backoff_s: float = 0.05
     request_timeout_s: float = 120.0  # Server.search() convenience bound
+    # tiered-memory rerank (docs/serving.md §12, ISSUE 12): keep the
+    # raw originals HOST-resident and fetch only unique shortlist rows
+    # per batch through neighbors.tiered, instead of uploading the
+    # whole dataset per generation (ivf_pq with refine_ratio > 1 or a
+    # rabitq cache). hot_rows=None draws the HBM hot-row budget from
+    # tuning.budget("tiered_hot_rows").
+    tiered_rerank: bool = False
+    tiered_hot_rows: Optional[int] = None
+    # bounded result cache in front of dispatch: repeated queries
+    # (Zipf traffic) answered without touching the engine, keyed on
+    # (query bytes, k) x generation x mutation epoch so hot-swap and
+    # delete/upsert invalidate correctly. 0 = off.
+    result_cache_entries: int = 0
 
 
 class _Handle:
@@ -96,12 +115,13 @@ class _Handle:
     __slots__ = ("algo", "index", "state", "search_params",
                  "user_search_params", "build_params",
                  "refine_ratio", "metric", "select_min", "dtype", "dim",
-                 "rows", "raw_dataset", "_raw_dev", "_side_cache")
+                 "rows", "raw_dataset", "_raw_dev", "_side_cache",
+                 "tiered_source")
 
     def __init__(self, algo: str, index, state: MutableState,
                  search_params, build_params, refine_ratio: int,
                  raw_dataset: Optional[np.ndarray],
-                 user_search_params=None):
+                 user_search_params=None, tiered_source=None):
         self.algo = algo
         self.index = index
         self.state = state
@@ -122,6 +142,22 @@ class _Handle:
         self.raw_dataset = raw_dataset
         self._raw_dev = None                  # device copy, cached lazily
         self._side_cache: Optional[Tuple[int, object, object]] = None
+        # tiered rerank source (ISSUE 12): when set, the ivf_pq refine
+        # paths fetch only unique shortlist rows from the HOST raw
+        # store instead of device-uploading it wholesale (raw_dev).
+        # Per-generation on purpose — a swap/compaction gets a FRESH
+        # hot-row cache, so stale rows can never serve after a content
+        # change.
+        self.tiered_source = tiered_source
+
+    def pipeline_rr(self) -> int:
+        """The refine_ratio the multi-stage pipeline dispatches at:
+        the caller's when set, else the rabitq serving default. Used
+        by BOTH dispatch and warmup — they must agree, or warmup
+        traces the wrong shortlist-width rungs and steady-state
+        serving recompiles per batch."""
+        return (self.refine_ratio if self.refine_ratio > 1
+                else RABITQ_DEFAULT_REFINE_RATIO)
 
     def raw_dev(self):
         """Device-resident raw row store (refine operand) — transferred
@@ -140,6 +176,17 @@ class _Handle:
                                    prefilter=filt)
         if self.algo == "ivf_pq":
             kind = getattr(self.index, "cache_kind", "none")
+            if self.tiered_source is not None and (
+                    kind == "rabitq" or self.refine_ratio > 1):
+                # the tiered-memory shape (docs/serving.md §12): the
+                # raw originals stay HOST-resident and the rerank
+                # stage fetches only this batch's unique shortlist
+                # rows (hot rows served from the HBM cache). Bitwise
+                # identical to the raw_dev() full-upload paths below.
+                return ivf_pq.search_refined(
+                    self.search_params, self.index, qdev, k,
+                    refine_ratio=self.pipeline_rr(), prefilter=filt,
+                    dataset=self.tiered_source)
             if kind == "rabitq" and (
                     self.raw_dataset is not None
                     or int(self.index.codes.shape[-1]) > 0):
@@ -149,10 +196,9 @@ class _Handle:
                 # rows never reach the shortlist (docs/serving.md §5).
                 # Rerank source: the generation's raw row store when
                 # serving kept it, else the index's own PQ codes.
-                rr = self.refine_ratio if self.refine_ratio > 1 else 4
                 return ivf_pq.search_refined(
                     self.search_params, self.index, qdev, k,
-                    refine_ratio=rr, prefilter=filt,
+                    refine_ratio=self.pipeline_rr(), prefilter=filt,
                     dataset=self.raw_dev())
             if self.refine_ratio > 1 and self.raw_dataset is not None:
                 kc = min(k * self.refine_ratio, self.rows)
@@ -263,6 +309,48 @@ def _merge_with_side(d, i, sd, sp, side_int, k: int, select_min: bool):
     return merge_topk(cd, ci, k, select_min)
 
 
+class _ResultCache:
+    """Bounded LRU result cache in front of dispatch (ISSUE 12,
+    docs/serving.md §12): repeated queries — the Zipf head of real
+    traffic — answered from host memory without touching the engine.
+
+    Entries are keyed on ``(query bytes, k)`` and stamped with the
+    ``(generation, mutation seq)`` pair they were computed under; a
+    lookup only hits when BOTH still match the serving state, so a
+    hot-swap (new generation) or a delete/upsert (seq bump)
+    invalidates every stale answer implicitly. Stale entries are
+    evicted on touch; capacity evicts least-recently-used."""
+
+    def __init__(self, entries: int):
+        self.entries = int(entries)
+        from collections import OrderedDict
+
+        self._od: "OrderedDict" = OrderedDict()
+        self._lock = lockwatch.make_lock("serve.result_cache")
+
+    def get(self, key, gen: int, epoch: int):
+        with self._lock:
+            v = self._od.get(key)
+            if v is None:
+                return None
+            if v[0] != gen or v[1] != epoch:
+                del self._od[key]          # stale: swap or mutation
+                return None
+            self._od.move_to_end(key)
+            return v[2]
+
+    def put(self, key, gen: int, epoch: int, value) -> None:
+        with self._lock:
+            self._od[key] = (gen, epoch, value)
+            self._od.move_to_end(key)
+            while len(self._od) > self.entries:
+                self._od.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+
 class _IndexServing:
     """One named index's serving unit: batcher + mutation overlay +
     dispatch/warmup logic against the shared registry."""
@@ -283,6 +371,9 @@ class _IndexServing:
         # A handoff FLAG, not a critical-section lock — see
         # lockwatch.make_flag_lock for why the sanitizer exempts it
         self.compacting = lockwatch.make_flag_lock("serve.compacting")
+        self.result_cache = (_ResultCache(self.params.result_cache_entries)
+                             if self.params.result_cache_entries > 0
+                             else None)
         self.batcher = MicroBatcher(
             self._dispatch,
             max_batch_rows=self.params.max_batch_rows,
@@ -494,6 +585,21 @@ class _IndexServing:
                                                side_ids)
                         jax.block_until_ready(out)
                         warmed += 1
+                        if (h.tiered_source is not None
+                                and h.algo == "ivf_pq"
+                                and (h.refine_ratio > 1 or getattr(
+                                    h.index, "cache_kind", "none")
+                                    == "rabitq")):
+                            # tiered rerank: the fetched-block rung is
+                            # data-dependent (unique shortlist rows),
+                            # so trace the whole pow2 rung ladder for
+                            # this (bucket, k) — steady state then
+                            # never compiles whatever the miss mix is
+                            kc = ivf_pq.refined_shortlist_width(
+                                h.search_params, h.index, kq,
+                                h.pipeline_rr())
+                            h.tiered_source.warm(bucket, kc, kq,
+                                                 h.metric)
                     except ValueError as e:
                         # a rung this index cannot serve (e.g. k beyond
                         # the probed candidate pool) fails identically at
@@ -599,11 +705,12 @@ class Server:
             rows, dim, np.float32, ext_ids=ids,
             side_capacity=self.params.side_capacity,
         )
+        raw = _raw_dataset(algo, index, dataset)
         h = _Handle(algo, index, state,
                     _default_search_params(algo, index, search_params),
-                    build_params, refine_ratio,
-                    _raw_dataset(algo, index, dataset),
-                    user_search_params=search_params)
+                    build_params, refine_ratio, raw,
+                    user_search_params=search_params,
+                    tiered_source=self._make_tiered(algo, raw))
         with self._lock:
             # checked under the SAME lock that registers the serving: a
             # close() racing the unlocked gap would snapshot _servings
@@ -621,6 +728,19 @@ class Server:
             serving.warmup_handle(h)
         gen = self._publish_guarded(name, h)
         return gen.version
+
+    def _make_tiered(self, algo: str, raw: Optional[np.ndarray]):
+        """A per-generation tiered rerank source over the host raw row
+        store (None unless ``tiered_rerank`` is on and this algo can
+        use it). Fresh per generation: compaction/swap content changes
+        must not serve a predecessor's hot rows."""
+        if (not self.params.tiered_rerank or algo != "ivf_pq"
+                or raw is None):
+            return None
+        from raft_tpu.neighbors import tiered
+
+        return tiered.HostArraySource(
+            raw, hot_rows=self.params.tiered_hot_rows)
 
     def _publish_guarded(self, name: str, h: "_Handle"):
         """Publish under the server lock: a background build finishing
@@ -688,7 +808,57 @@ class Server:
                 # requests down with it — reject it at the door
                 raise ValueError(
                     f"query dim {q.shape[1]} != index dim {handle.dim}")
+            if (serving.result_cache is not None and prefilter is None
+                    and handle is not None):
+                return self._submit_cached(serving, handle, gen, q,
+                                           int(k), index)
             return serving.batcher.submit(q, int(k), prefilter=prefilter)
+
+    def _submit_cached(self, serving: "_IndexServing", handle: "_Handle",
+                       gen, q: np.ndarray, k: int, index: str) -> Future:
+        """The result-cache front (docs/serving.md §12): answer a
+        repeated (query, k) from host memory when nothing changed since
+        it was computed; otherwise submit and install the answer once
+        it delivers — only if the serving state is STILL the one the
+        key was stamped with (a swap or mutation racing the in-flight
+        request must not be cached under the older stamp)."""
+        cache = serving.result_cache
+        key = (q.tobytes(), k)
+        with handle.state.lock:
+            epoch = handle.state.seq
+        gen_v = gen.version
+        hit = cache.get(key, gen_v, epoch)
+        if hit is not None:
+            obs.counter("serve.result_cache_hits_total", index=index)
+            fut: Future = Future()
+            fut.generation = gen_v
+            # hand back COPIES: a caller mutating its result in place
+            # must not poison every later hit
+            fut.set_result((hit[0].copy(), hit[1].copy()))
+            return fut
+        obs.counter("serve.result_cache_misses_total", index=index)
+        fut = serving.batcher.submit(q, k, prefilter=None)
+
+        def _install(f: Future) -> None:
+            if f.exception() is not None:
+                return
+            if getattr(f, "generation", None) != gen_v:
+                return                    # answered by a newer swap
+            try:
+                cur = self.registry.get(index)
+                if cur is None or cur.version != gen_v:
+                    return
+                st = cur.handle.state
+                with st.lock:
+                    if st.seq != epoch:
+                        return            # a mutation landed in flight
+            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow cache-insert probe only; a torn-down registry just skips the insert
+                return
+            d, i = f.result()
+            cache.put(key, gen_v, epoch, (d.copy(), i.copy()))
+
+        fut.add_done_callback(_install)
+        return fut
 
     def search(self, queries, k: int, *, index: str = "default",
                prefilter=None, timeout_s: Optional[float] = None):
@@ -795,7 +965,9 @@ class Server:
                 # valid; the raw user params ride along for later swaps
                 new_h = _Handle(h.algo, new_index, st, h.search_params,
                                 h.build_params, h.refine_ratio, new_raw,
-                                user_search_params=h.user_search_params)
+                                user_search_params=h.user_search_params,
+                                tiered_source=self._make_tiered(
+                                    h.algo, new_raw))
                 if serving.warmup_enabled:
                     serving.warmup_handle(new_h)
                 # commit + publish under the mutation lock: a dispatcher
@@ -884,6 +1056,7 @@ class Server:
                     sp_user = (search_params if search_params is not None
                                else h.user_search_params
                                if a == h.algo else None)
+                    new_raw = _raw_dataset(a, new_index, ds)
                     new_h = _Handle(
                         a, new_index, state,
                         _default_search_params(a, new_index, sp_user),
@@ -891,8 +1064,9 @@ class Server:
                         else h.build_params,
                         refine_ratio if refine_ratio is not None
                         else h.refine_ratio,
-                        _raw_dataset(a, new_index, ds),
-                        user_search_params=sp_user)
+                        new_raw,
+                        user_search_params=sp_user,
+                        tiered_source=self._make_tiered(a, new_raw))
                     if serving.warmup_enabled:
                         serving.warmup_handle(new_h)
                     gen = self._publish_guarded(name, new_h)

@@ -9,7 +9,8 @@ generalized to every hot-path dispatch the repo used to hard-code:
 * ``merge_topk`` — the cross-probe/parts merge's selection backend
 * ``ivf_scan``   — fused Pallas list scan vs the XLA bucketized scan
 * ``pq_scan``    — IVF-PQ cache/scoring kind (i8 / i4 / pq4 one-hot)
-* budgets        — e.g. CAGRA's inline packed-table byte budget
+* budgets        — e.g. CAGRA's inline packed-table byte budget, the
+  tiered rerank's ``tiered_hot_rows`` HBM hot-row cache capacity
 
 Consumers call ``choose(op, key, candidates, fallback)`` with a static
 shape key; the answer comes from a **persisted per-backend table** of

@@ -12,6 +12,16 @@ through ``ivf_pq.build_streamed``'s donated-scatter encoder; ground
 truth runs the same generator through a streaming brute-force merge.
 
 Usage: python scripts/deep100m.py [out.json] [--n 100000000]
+
+Tiered-memory acceptance (ISSUE 12, ROADMAP item 3): ``--tiered-out
+TIERED_r12.json`` appends a stage that materializes the dataset to a
+host memmap (the SSD/host tier), reranks through
+``neighbors.tiered``'s shortlist-only fetch under a Zipf query mix,
+and records recall / QPS / bytes-moved (vs the full-upload baseline)
+/ hot-row hit-rate — asserting the tiered path is bitwise identical
+to the device full-upload rerank on the same shortlists.
+``--tiered-only`` skips the main battery (the CPU-smoke acceptance
+shape; pair with --n 200000 and DEEP100M_FORCE_CPU=1).
 """
 
 import json
@@ -32,12 +42,210 @@ if os.environ.get("DEEP100M_FORCE_CPU"):
 import jax.numpy as jnp
 
 
+def tiered_stage(out_path: str, n: int, cpu_smoke: bool) -> dict:
+    """ISSUE 12 acceptance: the tiered-memory rerank measured at a
+    DEEP-smoke shape — host/memmap originals, shortlist-only fetch,
+    Zipf query mix, hot-row residency — vs the full-upload baseline.
+
+    Writes ``out_path`` (TIERED_r12.json) with recall / QPS /
+    bytes-moved / hit-rate, a bitwise-identity verdict, and the
+    steady-state retrace count. Every number is dated and carries the
+    platform (GL005: CPU-smoke QPS is CPU QPS, labeled as such)."""
+    import tempfile
+
+    from raft_tpu import obs, serve
+    from raft_tpu.bench.run import _gen_device_block
+    from raft_tpu.bench.harness import compute_recall
+    from raft_tpu.neighbors import ivf_pq, tiered
+
+    d, k, rr = 96, 10, 3
+    bs = 50_000
+    # lists capped so the CPU-smoke xla scan stays minutes-scale: the
+    # bytes/bitwise/hit-rate columns are shape-independent, only the
+    # QPS columns carry the smoke's reduced probe work
+    n_lists = max(64, min(1024, n // 256))
+    n_probes = max(16, n_lists // 16)
+    pool_q, batch_q, n_batches = 1024, 256, 16
+    hot_rows = 65_536
+    gen = _gen_device_block(bs, d, 16)
+    key0 = jax.random.PRNGKey(71)
+    nb = -(-n // bs)
+
+    res = {"date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "platform": jax.devices()[0].platform,
+           "config": {"n": n, "dim": d, "n_lists": n_lists,
+                      "n_probes": n_probes, "k": k, "refine_ratio": rr,
+                      "cache_dtype": "i4", "zipf_s": 1.0,
+                      "query_pool": pool_q, "query_batches": n_batches,
+                      "batch_rows": batch_q, "hot_rows": hot_rows}}
+
+    # ---- materialize the host tier: stream-generate -> memmap --------
+    tmp = tempfile.NamedTemporaryFile(suffix=".f32", delete=False)
+    mm = np.memmap(tmp.name, dtype=np.float32, mode="w+", shape=(n, d))
+    for b in range(nb):
+        blk = np.asarray(gen(jax.random.fold_in(key0, b)))
+        rows = min(bs, n - b * bs)
+        mm[b * bs:b * bs + rows] = blk[:rows]
+    mm.flush()
+    mm = np.memmap(tmp.name, dtype=np.float32, mode="r", shape=(n, d))
+    print(f"tiered: host tier materialized ({n}x{d} f32, "
+          f"{mm.nbytes / 1e6:.0f} MB memmap)", flush=True)
+
+    # ---- build: streamed, cache-only i4 (HBM holds codes ONLY) -------
+    params = ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=64, pq_bits=8, kmeans_n_iters=4,
+        cache_dtype="i4",
+    )
+    t0 = time.time()
+
+    def make_batches():
+        for b in range(nb):
+            yield jnp.asarray(np.asarray(mm[b * bs:(b + 1) * bs]))
+
+    trainset = jnp.asarray(np.asarray(mm[:min(n, 4 * bs)]))
+    index = ivf_pq.build_streamed(
+        params, make_batches, n, d, trainset, keep_codes=False,
+        cap_rows=int(1.4 * n / n_lists), verbose=False,
+    )
+    jax.block_until_ready(index.list_sizes)
+    res["build_s"] = round(time.time() - t0, 1)
+    print(f"tiered: build {res['build_s']}s", flush=True)
+
+    # ---- Zipf(s=1.0) query mix over a finite pool --------------------
+    qgen = _gen_device_block(pool_q, d, 16)
+    pool = np.asarray(qgen(jax.random.fold_in(key0, 10_000)))
+    rng = np.random.default_rng(12)
+    ranks = np.arange(1, pool_q + 1, dtype=np.float64)
+    p = 1.0 / ranks
+    p /= p.sum()
+    draws = rng.choice(pool_q, size=(n_batches, batch_q), p=p)
+
+    # ---- ground truth on the pool (exact, streamed brute force) ------
+    t0 = time.time()
+    qd = jnp.asarray(pool)
+    qn = jnp.sum(qd.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+
+    @jax.jit
+    def partial_knn(batch, off):
+        b32 = batch.astype(jnp.float32)
+        dots = jnp.dot(qd, b32.T, preferred_element_type=jnp.float32)
+        dist = qn + jnp.sum(b32 * b32, axis=1)[None, :] - 2.0 * dots
+        valid = off + jnp.arange(batch.shape[0]) < n
+        dist = jnp.where(valid[None, :], dist, jnp.inf)
+        dd, ii = jax.lax.top_k(-dist, k)
+        return -dd, ii + off
+
+    from raft_tpu.neighbors.common import merge_topk
+
+    cur_d = jnp.full((pool_q, k), jnp.inf)
+    cur_i = jnp.full((pool_q, k), -1, jnp.int32)
+    for b in range(nb):
+        bd, bi = partial_knn(jnp.asarray(
+            np.asarray(mm[b * bs:(b + 1) * bs])), jnp.int32(b * bs))
+        cur_d, cur_i = merge_topk(
+            jnp.concatenate([cur_d, bd], axis=1),
+            jnp.concatenate([cur_i, bi], axis=1), k, True)
+    gt = np.asarray(jnp.where(cur_i < n, cur_i, -1))
+    res["groundtruth_s"] = round(time.time() - t0, 1)
+    print(f"tiered: groundtruth {res['groundtruth_s']}s", flush=True)
+
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_impl="xla")
+    obs.set_mode("on")
+    obs.reset()
+
+    def run(dataset, label):
+        outs = []
+        t0 = time.perf_counter()
+        for b in range(n_batches):
+            qb = jnp.asarray(pool[draws[b]])
+            d_, i_ = ivf_pq.search_refined(sp, index, qb, k,
+                                           refine_ratio=rr,
+                                           dataset=dataset)
+            outs.append((np.asarray(d_), np.asarray(i_)))
+        wall = time.perf_counter() - t0
+        qps = n_batches * batch_q / wall
+        print(f"tiered: {label} {wall:.1f}s ({qps:.0f} qps)", flush=True)
+        return outs, qps
+
+    # ---- baseline: full-upload device rerank -------------------------
+    ds_dev = jnp.asarray(np.asarray(mm))
+    jax.block_until_ready(ds_dev)
+    bytes_full = int(mm.nbytes)          # what the upload actually moves
+    base, qps_full = run(ds_dev, "full-upload baseline")
+    del ds_dev
+
+    # ---- tiered: shortlist-only fetch + hot-row residency ------------
+    src = tiered.HostArraySource(mm, hot_rows=hot_rows, promote_after=1,
+                                 promote_batch=1024)
+    # trace the full fetched-block rung ladder up front (what serve's
+    # warmup does), so BOTH epochs below run at zero added traces
+    kc = ivf_pq.refined_shortlist_width(sp, index, k, rr)
+    src.warm(batch_q, kc, k, index.metric)
+    tiered_out, qps_warm = run(src, "tiered (cold+warming)")
+    bitwise = all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        for a, b in zip(base, tiered_out))
+    # steady state: the hot set is resident, every rung traced — a
+    # second epoch must add ZERO XLA traces and hit the hot tier
+    st_warm = src.stats()
+    traces0 = serve.total_trace_count()
+    steady, qps_steady = run(src, "tiered (steady state)")
+    retraces = serve.total_trace_count() - traces0
+    bitwise = bitwise and all(
+        np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        for a, b in zip(base, steady))
+
+    st = src.stats()
+    bytes_tiered = int(st["bytes_moved"])
+    recall = compute_recall(
+        np.concatenate([draw_i for _, draw_i in steady]),
+        gt[draws.reshape(-1)])
+    res.update({
+        "bitwise_identical_to_full_upload": bool(bitwise),
+        "recall_at_10": round(float(recall), 4),
+        "qps_full_upload": round(qps_full, 1),
+        "qps_tiered_warming": round(qps_warm, 1),
+        "qps_tiered_steady": round(qps_steady, 1),
+        "bytes_full_upload": bytes_full,
+        "bytes_moved_tiered": bytes_tiered,
+        "bytes_ratio": round(bytes_full / max(bytes_tiered, 1), 1),
+        "bytes_per_query_tiered": round(
+            bytes_tiered / (2 * n_batches * batch_q), 1),
+        "hot_hit_rate": round(st["hit_rate_hbm"], 4),
+        "hot_hit_rate_steady": round(
+            (st["hbm_hits"] - st_warm["hbm_hits"])
+            / max(st["lookups"] - st_warm["lookups"], 1), 4),
+        "evictions": int(st["evictions"]),
+        "promotions": int(st["promotions"]),
+        "steady_state_retraces": int(retraces),
+        "timing": "wall-clock over %d x %d Zipf(1.0) query batches"
+                  % (n_batches, batch_q),
+    })
+    if cpu_smoke:
+        res["note"] = ("CPU smoke (xla scan): QPS columns are CPU-host "
+                       "numbers; bytes/bitwise/hit-rate are "
+                       "platform-independent")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+        f.write("\n")
+    os.unlink(tmp.name)
+    print(json.dumps(res))
+    return res
+
+
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_path = args[0] if args else "DEEP100M.json"
     n = 100_000_000
     if "--n" in sys.argv:
         n = int(sys.argv[sys.argv.index("--n") + 1])
+    tiered_out = None
+    if "--tiered-out" in sys.argv:
+        tiered_out = sys.argv[sys.argv.index("--tiered-out") + 1]
+    if "--tiered-only" in sys.argv:
+        tiered_stage(tiered_out or "TIERED_r12.json", n,
+                     bool(os.environ.get("DEEP100M_FORCE_CPU")))
+        return
     scan_impl = "pallas"
     if "--scan-impl" in sys.argv:   # CPU smoke: pass pallas_interpret
         scan_impl = sys.argv[sys.argv.index("--scan-impl") + 1]
@@ -191,6 +399,8 @@ def main():
     with open(out_path, "w") as f:
         json.dump(res, f, indent=1)
     print(json.dumps(res))
+    if tiered_out:
+        tiered_stage(tiered_out, n, cpu_smoke)
 
 
 if __name__ == "__main__":

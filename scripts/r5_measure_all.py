@@ -77,6 +77,26 @@ OPTIONAL_STAGES = [
       "--duration-s", "45", "--k", "1,10,100",
       "--out", "FABRIC_r06.json",
       "--obs-snapshot", "FABRIC_r06.obs.json"], 900),
+    # tiered-memory acceptance (ISSUE 12, ROADMAP item 3): host/mmap
+    # originals + shortlist-only fetch vs the full-upload baseline,
+    # then a Zipf(1.0) serve run whose hot-row hit-rate / zero-retrace
+    # columns merge into the same artifact
+    ("tiered_deep100m",
+     [PY, "scripts/deep100m.py", "--tiered-only", "--n", "1000000",
+      "--tiered-out", "TIERED_r12.json"], 2700),
+    # flags match the committed SERVE_TIERED_r12.json exactly, so the
+    # stage REPRODUCES the artifact (result cache off on purpose: with
+    # it on, repeats never reach the engine and the hot-ROW tier idles
+    # at ~0.4 hit rate — the result cache's own under-load evidence is
+    # the r12 run recorded in CHANGES.md and tests/test_tiered.py)
+    ("tiered_serve_zipf",
+     [PY, "scripts/serve_loadgen.py", "--n", "20000", "--dim", "96",
+      "--tiered", "--zipf", "1.0", "--query-pool", "256",
+      "--refine-ratio", "3", "--result-cache", "0",
+      "--hot-rows", "16384", "--max-batch-rows", "16",
+      "--concurrency", "8", "--duration-s", "30", "--k", "1,10",
+      "--out", "SERVE_TIERED_r12.json",
+      "--merge-into", "TIERED_r12.json"], 1200),
 ]
 
 

@@ -85,6 +85,31 @@ def main() -> int:
                          "cannot hang it")
     ap.add_argument("--k", default="1,10,32",
                     help="comma list; each request draws one uniformly")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="query-skew exponent s: requests draw from a "
+                         "finite pool of --query-pool distinct queries "
+                         "with rank-r probability ~ 1/r^s (0 = every "
+                         "request a fresh query). The knob that makes "
+                         "the tiered hot-row / result caches "
+                         "measurable (docs/serving.md §12)")
+    ap.add_argument("--query-pool", type=int, default=512,
+                    help="distinct queries behind --zipf sampling")
+    ap.add_argument("--tiered", action="store_true",
+                    help="serve with the tiered-memory rerank: host-"
+                         "resident originals, shortlist-only fetch, "
+                         "HBM hot-row cache (forces --algo ivf_pq; "
+                         "docs/serving.md §12)")
+    ap.add_argument("--refine-ratio", type=int, default=3,
+                    help="rerank over-fetch ratio for --tiered")
+    ap.add_argument("--hot-rows", type=int, default=None,
+                    help="HBM hot-row cache budget (default: the "
+                         "tuning.budget('tiered_hot_rows') knob)")
+    ap.add_argument("--result-cache", type=int, default=0,
+                    help="serve result-cache entries (0 = off)")
+    ap.add_argument("--merge-into", default=None,
+                    help="also merge the tiered/zipf summary into this "
+                         "existing JSON artifact under 'serve_zipf' "
+                         "(the TIERED_r12.json acceptance wiring)")
     ap.add_argument("--max-batch-rows", type=int, default=128)
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue-rows", type=int, default=2048)
@@ -118,6 +143,13 @@ def main() -> int:
 
     from raft_tpu import obs, serve
 
+    if args.tiered:
+        if args.algo not in ("ivf_pq",):
+            args.algo = "ivf_pq"
+        if obs.mode() == "off" and not os.environ.get("RAFT_TPU_OBS"):
+            # the hit-rate/bytes-moved columns need the metrics
+            # registry; same env-wins contract as --obs-snapshot below
+            obs.set_mode("on")
     if args.obs_snapshot and obs.mode() == "off":
         # the snapshot needs metrics recording, but an env-selected mode
         # must win: r5_measure_all runs this stage under RAFT_TPU_OBS=
@@ -139,13 +171,33 @@ def main() -> int:
         max_wait_ms=args.max_wait_ms,
         max_queue_rows=args.max_queue_rows,
         max_k=max(ks),
+        tiered_rerank=args.tiered,
+        tiered_hot_rows=args.hot_rows,
+        result_cache_entries=args.result_cache,
     )
     srv = serve.Server(params)
     t_build = time.perf_counter()
-    srv.create_index("default", dataset, algo=args.algo)
+    srv.create_index("default", dataset, algo=args.algo,
+                     refine_ratio=args.refine_ratio if args.tiered else 1)
     build_s = time.perf_counter() - t_build
     print(f"index up: {args.algo} n={args.n} d={args.dim} "
+          f"tiered={args.tiered} zipf={args.zipf} "
           f"(build+warmup {build_s:.1f}s)", flush=True)
+    # steady state starts HERE: create_index warmed the whole ladder
+    # (buckets x k-rungs x tiered fetch rungs), so any trace-cache
+    # growth during the run is a zero-retrace violation worth a column
+    traces_before = serve.total_trace_count()
+
+    # --zipf: a finite pool of distinct queries, rank-r probability
+    # ~ 1/r^s — the repeated-query head that makes residency and the
+    # result cache do work (JUNO's skewed-workload shape)
+    qpool = rng.standard_normal(
+        (args.query_pool, args.dim)).astype(np.float32)
+    zipf_p = None
+    if args.zipf > 0:
+        ranks = np.arange(1, args.query_pool + 1, dtype=np.float64)
+        zipf_p = 1.0 / ranks ** args.zipf
+        zipf_p /= zipf_p.sum()
 
     stop = threading.Event()
     lock = threading.Lock()
@@ -166,7 +218,10 @@ def main() -> int:
                 if pause > 0:
                     time.sleep(pause)
             k = int(wrng.choice(ks))
-            q = wrng.standard_normal(args.dim).astype(np.float32)
+            if zipf_p is not None:
+                q = qpool[int(wrng.choice(args.query_pool, p=zipf_p))]
+            else:
+                q = wrng.standard_normal(args.dim).astype(np.float32)
             t0 = time.perf_counter()
             try:
                 d, ids = srv.search(q, k, timeout_s=60.0)
@@ -220,7 +275,34 @@ def main() -> int:
     wall_s = time.perf_counter() - t_run
 
     stats = srv.stats()
+    traces_after = serve.total_trace_count()
+    snap = obs.snapshot() if obs.enabled() else {"metrics": {}}
     srv.close()
+
+    def _metric(name, **labels):
+        want = {str(k): str(v) for k, v in labels.items()}
+        for p in snap["metrics"].get(name, {}).get("points", []):
+            if all(p["labels"].get(k) == v for k, v in want.items()):
+                return p.get("value")
+        return None
+
+    lookups = _metric("tiered.lookups_total") or 0
+    hbm_hits = _metric("tiered.hits_total", tier="hbm") or 0
+    tiered_cols = {
+        "zipf_s": args.zipf,
+        "query_pool": args.query_pool if args.zipf > 0 else None,
+        "hot_hit_rate": (round(hbm_hits / lookups, 4) if lookups
+                         else None),
+        "hot_lookups": int(lookups),
+        "bytes_moved_total": _metric("tiered.bytes_moved_total",
+                                     link="host_to_device"),
+        "evictions": _metric("tiered.evictions_total") or 0,
+        "result_cache_hits": _metric("serve.result_cache_hits_total",
+                                     index="default") or 0,
+        "result_cache_misses": _metric("serve.result_cache_misses_total",
+                                       index="default") or 0,
+        "steady_state_retraces": int(traces_after - traces_before),
+    }
     report = {
         "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "config": {
@@ -229,8 +311,11 @@ def main() -> int:
             "k": ks, "max_batch_rows": args.max_batch_rows,
             "max_wait_ms": args.max_wait_ms,
             "max_queue_rows": args.max_queue_rows,
+            "tiered": args.tiered, "refine_ratio": args.refine_ratio,
+            "hot_rows": args.hot_rows, "result_cache": args.result_cache,
             "duration_s": round(wall_s, 2), "build_s": round(build_s, 2),
         },
+        "tiered": tiered_cols,
         "throughput_qps": round(counts["completed"] / max(wall_s, 1e-9), 1),
         **counts,
         "swap_generation": swap_version,
@@ -243,12 +328,31 @@ def main() -> int:
         f.write("\n")
     if args.obs_snapshot:
         obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    if args.merge_into:
+        # the TIERED_r12.json acceptance wiring: the serve-level Zipf
+        # numbers (hot hit rate, retraces, bytes moved) land in the
+        # deep100m artifact as its 'serve_zipf' section
+        merge_path = os.path.join(ROOT, args.merge_into)
+        try:
+            with open(merge_path) as f:
+                merged = json.load(f)
+        except (OSError, ValueError):
+            merged = {}
+        merged["serve_zipf"] = {
+            "date": report["date"], "artifact": args.out,
+            "throughput_qps": report["throughput_qps"],
+            **tiered_cols,
+        }
+        with open(merge_path, "w") as f:
+            json.dump(merged, f, indent=1)
+            f.write("\n")
+        print(f"merged serve_zipf into {args.merge_into}", flush=True)
     # every printed number names its artifact + capture date (the GL005
     # stale-claim contract: a QPS quoted from this output is citable as
     # "<qps> QPS (<date>, <artifact>)" without further archaeology)
     print(json.dumps({**{k: report[k] for k in
                          ("throughput_qps", "completed", "rejected",
-                          "latency_ms")},
+                          "latency_ms", "tiered")},
                       "artifact": args.out, "date": report["date"]}),
           flush=True)
     print(f"wrote {args.out} (measured {report['date']})", flush=True)
