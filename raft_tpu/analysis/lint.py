@@ -197,6 +197,7 @@ class FileLinter:
         self._lint_tree()
         self._lint_comments_and_docstrings()
         self._check_unspanned_entries()
+        self._check_untraced_rpc()
         # nested defs are revisited by the per-function GL003 pass; dedupe
         seen: Set[Tuple[str, int, str]] = set()
         unique: List[Finding] = []
@@ -664,6 +665,115 @@ class FileLinter:
                            "its latency and query counts are attributed to "
                            "nobody; wrap the body in obs.entry_span/obs.span "
                            "or suppress with a reason")
+
+    # -- GL019 untraced RPC ------------------------------------------------
+
+    # transport method-attribute names that fan an RPC across a process
+    # boundary (comms/procgroup.py's ProcGroup/LocalGroup surface)
+    _RPC_CALL_ATTRS = ("call", "call_async")
+    # helpers that inject the graft-trace context into a payload
+    _TRACE_HELPERS = ("traced_payload", "with_trace")
+
+    def _is_traced_payload_expr(self, expr: Optional[ast.AST],
+                                traced_names: Set[str]) -> bool:
+        """Does this payload expression carry the trace-context field?
+
+        Accepted evidence: a (possibly nested) call to one of
+        :data:`_TRACE_HELPERS`; a name previously assigned from one; or
+        a dict literal spelling the wire field key. A payload forwarded
+        through a function parameter is NOT evidence — the pass-through
+        site says so with a reasoned suppression, so the audit trail
+        names where the threading actually happened."""
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            if expr.id in traced_names:
+                return True
+        if isinstance(expr, ast.Dict):
+            for key in expr.keys:
+                if isinstance(key, ast.Constant) and key.value == "trace":
+                    return True
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                fname = _dotted(sub.func) or ""
+                if fname.rsplit(".", 1)[-1] in self._TRACE_HELPERS:
+                    return True
+        return False
+
+    def _check_untraced_rpc(self) -> None:
+        """GL019: in ``serve/`` and ``comms/`` modules, every transport
+        ``call``/``call_async`` site — shape ``<obj>.call(rank,
+        "method", payload)`` — must thread the graft-trace context
+        field through its payload, or suppress with a reason
+        (control-plane RPCs that belong to no query)."""
+        if self.rules is not None and "GL019" not in self.rules:
+            return
+        parts = Path(self.path).parts
+        if "serve" not in parts and "comms" not in parts:
+            return
+        # enclosing-function index: a call's payload evidence (params,
+        # traced-name assignments) is scoped to the function holding it
+        encl: Dict[ast.AST, Optional[ast.AST]] = {}
+
+        def _index(node: ast.AST, fn: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                encl[child] = fn
+                _index(child,
+                       child if isinstance(
+                           child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)) else fn)
+
+        _index(self.tree, None)
+        fn_evidence: Dict[Optional[ast.AST], Set[str]] = {}
+
+        def _evidence(fn: Optional[ast.AST]) -> Set[str]:
+            hit = fn_evidence.get(fn)
+            if hit is not None:
+                return hit
+            traced: Set[str] = set()
+            scope = fn if fn is not None else self.tree
+            for sub in ast.walk(scope):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and self._is_traced_payload_expr(sub.value,
+                                                         traced):
+                    traced.add(sub.targets[0].id)
+            fn_evidence[fn] = traced
+            return traced
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._RPC_CALL_ATTRS):
+                continue
+            # the transport shape: (rank, method[, payload]) with the
+            # method a string literal (the common call site) or a
+            # forwarded name (a wrapper like fabric._call_control) —
+            # what separates an RPC fan-out from every other .call()
+            if len(node.args) < 2:
+                continue
+            marg = node.args[1]
+            if isinstance(marg, ast.Constant) and isinstance(marg.value,
+                                                             str):
+                method = marg.value
+            elif isinstance(marg, ast.Name):
+                method = f"<{marg.id}>"
+            else:
+                continue
+            payload = node.args[2] if len(node.args) >= 3 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "payload"), None)
+            traced = _evidence(encl.get(node))
+            if self._is_traced_payload_expr(payload, traced):
+                continue
+            self._emit("GL019", node,
+                       f"transport {node.func.attr}() RPC {method!r} "
+                       "does not thread the graft-trace context: wrap "
+                       "the payload in obs.trace.traced_payload(...) so "
+                       "the worker's spans share the query's trace id, "
+                       "or suppress with a reason for control-plane "
+                       "RPCs that belong to no query")
 
     # -- GL004 f64 ---------------------------------------------------------
 

@@ -223,6 +223,20 @@ register_rule(
     "invisible in interpret mode when the test grid is 1",
 )
 register_rule(
+    "GL019", "untraced-rpc",
+    "transport call/call_async site in serve/ or comms/ whose payload "
+    "does not thread the graft-trace context field",
+    "the serving path is multi-process (PR 6): an RPC that drops the "
+    "(trace_id, parent_span_id) field severs the query's identity at "
+    "the process boundary, and its worker-side spans/flight events "
+    "become unattributable fragments — exactly the blind spot "
+    "graft-trace (docs/observability.md §distributed-tracing) closes. "
+    "Thread the payload through obs.trace.traced_payload(); "
+    "control-plane RPCs that belong to no query — and pass-through "
+    "sites whose payload was threaded upstream — suppress with a "
+    "reason naming where the threading happens",
+)
+register_rule(
     "GL018", "mxu-dtype",
     "in-kernel dot with mismatched operand dtypes, or low-precision "
     "operands without preferred_element_type (kern engine)",

@@ -45,7 +45,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.resilience import faultinject
 
@@ -158,10 +160,15 @@ class WorkerRuntime:
     per child process, :class:`LocalGroup` one per daemon thread."""
 
     def __init__(self, rank: int, algo: str = "brute_force",
-                 slow_s: float = 0.15):
+                 slow_s: float = 0.15, shared_registry: bool = False):
         self.rank = int(rank)
         self.algo = algo
         self.slow_s = float(slow_s)
+        # True for LocalGroup's in-process twin: every worker thread
+        # shares the ROUTER's metrics registry, so collect_metrics must
+        # not hand the same registry back once per worker (the fleet
+        # sum would multiply (n_workers+1)x)
+        self.shared_registry = bool(shared_registry)
         self.current_gen = 0
         # gen_id -> {shard_id: entry}; staged holds prepared-not-published
         self.gens: Dict[int, Dict[int, tuple]] = {}
@@ -185,6 +192,7 @@ class WorkerRuntime:
             fn = getattr(self, "_do_" + method, None)
             if fn is None:
                 raise ValueError(f"unknown fabric RPC {method!r}")
+            obs.counter("fabric.worker_rpcs_total", method=method)
             return "ok", fn(payload or {})
         except BaseException as e:  # noqa: BLE001 — classified here, re-classified by the router from the serialized kind
             kind = _rerrors.classify(e)
@@ -210,9 +218,49 @@ class WorkerRuntime:
             raise KeyError(
                 f"{_NO_GEN}: worker {self.rank} holds generation {gen} "
                 f"but not shard {sid}")
-        d, i = search_shard_entry(entry, np.asarray(payload["q"]),
-                                  int(payload["k"]))
-        return {"gen": gen, "shard": sid, "d": d, "i": i}
+        q = np.asarray(payload["q"])
+        k = int(payload["k"])
+        if not obs.enabled():
+            d, i = search_shard_entry(entry, q, k)
+            return {"gen": gen, "shard": sid, "d": d, "i": i}
+        # graft-trace adoption (ISSUE 13): the RPC's trace context
+        # becomes this thread's ambient context, so the spans the
+        # search itself opens (brute_force/ivf_flat entry spans) carry
+        # the SAME trace id the router minted — and a compact span
+        # summary piggybacks on the reply, which is how the router
+        # assembles the per-query waterfall without a second round
+        # trip. No extra span is opened here: the entry span inside
+        # search_shard_entry already names this work, and the serving
+        # hot path pays for every per-RPC obs call in the loadgen A/B
+        # overhead budget (FABRIC_r13.json). search_shard_entry
+        # returns host numpy (it np.asarray's the device result), so
+        # the measured ms is device-COMPLETE scan time, not dispatch
+        # wall-clock.
+        ctx = obs_trace.adopt(payload.get(obs_trace.WIRE_FIELD))
+        with obs_trace.activate(ctx):
+            t0 = time.perf_counter()
+            d, i = search_shard_entry(entry, q, k)
+            scan_ms = (time.perf_counter() - t0) * 1e3
+        return {"gen": gen, "shard": sid, "d": d, "i": i,
+                "spans": [{"name": "worker_scan", "worker": self.rank,
+                           "shard": sid, "ms": round(scan_ms, 4),
+                           "device_complete": True}]}
+
+    def _do_collect_metrics(self, payload: dict) -> dict:
+        """Fleet federation (ISSUE 13): hand the router this worker's
+        whole metrics registry as a snapshot-shaped map. The router
+        merges every worker's map under a ``worker`` label into one
+        Prometheus exposition / JSON snapshot
+        (:mod:`raft_tpu.obs.federation`). A shared-registry runtime
+        (LocalGroup threads) answers with an EMPTY map and says so —
+        its series already reach the router as its own registry, and
+        returning them per worker would multiply every fleet sum."""
+        if self.shared_registry:
+            return {"rank": self.rank, "mode": obs.mode(),
+                    "shared_registry": True, "metrics": {}}
+        metrics = (obs.snapshot(runtime_gauges=False)["metrics"]
+                   if obs.enabled() else {})
+        return {"rank": self.rank, "mode": obs.mode(), "metrics": metrics}
 
     # -- two-phase swap control plane ---------------------------------------
 
@@ -270,7 +318,8 @@ class WorkerRuntime:
 
 def _proc_worker_main(rank: int, req_q, resp_q, algo: str, slow_s: float,
                       fault_spec: Optional[str],
-                      platform: Optional[str]) -> None:
+                      platform: Optional[str],
+                      obs_mode: Optional[str] = None) -> None:
     """Child-process entry: run one :class:`WorkerRuntime` over the
     request queue until a ``stop``. A ``dead@proc`` fault hard-exits
     (``os._exit``) with no response — the honest SIGKILL analog."""
@@ -279,6 +328,12 @@ def _proc_worker_main(rank: int, req_q, resp_q, algo: str, slow_s: float,
         # spawn, but backend selection must never fall through to a
         # hung TPU plugin inside a fabric worker
         os.environ.setdefault("JAX_PLATFORMS", platform)
+    if obs_mode is not None:
+        # inherit the PARENT's resolved obs mode, not just the env: a
+        # parent that called obs.set_mode("on") (tests, loadgen) would
+        # otherwise spawn blind workers and the federation / worker-span
+        # half of every trace would silently be empty
+        obs.set_mode(obs_mode)
     if fault_spec:
         faultinject.install(fault_spec)
     rt = WorkerRuntime(rank, algo=algo, slow_s=slow_s)
@@ -365,7 +420,7 @@ class ProcGroup:
         proc = self._ctx.Process(
             target=_proc_worker_main,
             args=(rank, req_q, resp_q, self.algo, self.slow_s,
-                  fault_spec, self.platform),
+                  fault_spec, self.platform, obs.mode()),
             daemon=True,
             name=f"raft-tpu-fabric-w{rank}",
         )
@@ -574,7 +629,8 @@ class LocalGroup:
 
     def _spawn(self, rank: int) -> _LocalWorker:
         w = _LocalWorker(rank, WorkerRuntime(rank, algo=self.algo,
-                                             slow_s=self.slow_s))
+                                             slow_s=self.slow_s,
+                                             shared_registry=True))
         w.thread = threading.Thread(
             target=self._loop, args=(w,), daemon=True,
             name=f"raft-tpu-fabric-local-w{rank}")

@@ -34,9 +34,17 @@ from raft_tpu.obs.config import (
     set_mode,
 )
 from raft_tpu.obs import config as _config
+from raft_tpu.obs import federation
 from raft_tpu.obs import flight as _flight
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import spans as _spans
+from raft_tpu.obs import trace
+from raft_tpu.obs.trace import (
+    TraceContext,
+    start_trace,
+    trace_report,
+    traced_payload,
+)
 from raft_tpu.obs.metrics import (
     DEFAULT_MS_BUCKETS,
     capture_runtime_gauges,
@@ -73,18 +81,21 @@ def write_snapshot(path: str) -> str:
 
 
 def reset() -> None:
-    """Drop all metrics, completed span trees, and flight events
-    (tests / between bench cases). The mode is untouched."""
+    """Drop all metrics, completed span trees, flight events, and
+    trace waterfalls (tests / between bench cases). The mode is
+    untouched."""
     _metrics.reset()
     _spans.reset()
     _flight.clear()
+    trace.reset()
 
 
 __all__ = [
     "DEFAULT_MS_BUCKETS", "DIR_VAR", "ENV_VAR", "MODES", "Span",
-    "capture_runtime_gauges", "counter", "current", "enabled",
-    "entry_span", "event", "export_prometheus", "flight_dump",
-    "flight_events", "gauge", "last_dump_path", "mode", "obs_dir",
-    "observe", "on_error", "recent", "reload", "reset", "set_mode",
-    "snapshot", "span", "write_snapshot",
+    "TraceContext", "capture_runtime_gauges", "counter", "current",
+    "enabled", "entry_span", "event", "export_prometheus", "federation",
+    "flight_dump", "flight_events", "gauge", "last_dump_path", "mode",
+    "obs_dir", "observe", "on_error", "recent", "reload", "reset",
+    "set_mode", "snapshot", "span", "start_trace", "trace",
+    "trace_report", "traced_payload", "write_snapshot",
 ]

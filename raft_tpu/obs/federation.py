@@ -1,0 +1,81 @@
+"""Fleet metrics federation: merge per-worker snapshots into one view.
+
+The router is the natural scrape point for the fabric, but through r12
+each worker process owned a private metrics registry the router never
+saw. Federation (ISSUE 13) makes the fleet one surface: the
+``collect_metrics`` worker RPC returns each worker's
+``obs.snapshot()['metrics']`` map, and :func:`merge_metric_maps` folds
+them — every point gains a ``worker`` label naming its source, so
+identical series from different workers stay distinct instances
+(Prometheus-style federation: label, never sum, across instances).
+
+Outputs: one JSON snapshot (:func:`federated_snapshot`) and one
+Prometheus text exposition (:func:`render_prometheus` — the
+snapshot-shaped twin of :func:`raft_tpu.obs.metrics.export_prometheus`,
+which reads the live registry instead).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+WORKER_LABEL = "worker"
+
+
+def merge_metric_maps(parts: Dict[str, dict]) -> dict:
+    """Merge ``{source_label: metrics_map}`` (each a
+    ``snapshot()['metrics']`` dict) into one metrics map whose every
+    point carries ``worker=<source_label>``.
+
+    A name registered with conflicting kinds across sources keeps the
+    first kind seen and records the clash under ``_conflicts`` instead
+    of silently mixing exposition types."""
+    out: dict = {}
+    conflicts: List[str] = []
+    for src in sorted(parts):
+        mmap = parts[src] or {}
+        for name in sorted(mmap):
+            m = mmap[name]
+            dst = out.get(name)
+            if dst is None:
+                dst = out[name] = {"kind": m.get("kind"), "points": []}
+            elif dst["kind"] != m.get("kind"):
+                conflicts.append(
+                    f"{name}: {src} says {m.get('kind')!r}, "
+                    f"kept {dst['kind']!r}")
+                continue
+            for p in m.get("points", []):
+                q = dict(p)
+                q["labels"] = dict(p.get("labels", {}))
+                q["labels"][WORKER_LABEL] = str(src)
+                dst["points"].append(q)
+    if conflicts:
+        out["_conflicts"] = {"kind": "meta", "points": conflicts}
+    return out
+
+
+def federated_snapshot(parts: Dict[str, dict],
+                       workers: Optional[List] = None) -> dict:
+    """A snapshot-shaped federated view: ``{"mode": "federated",
+    "time_unix": ..., "workers": [...], "metrics": {...}}``. ``workers``
+    names the live sources (defaults to the keys of ``parts``)."""
+    return {
+        "mode": "federated",
+        "time_unix": time.time(),
+        "workers": sorted(str(w) for w in (
+            workers if workers is not None else parts)),
+        "metrics": merge_metric_maps(parts),
+    }
+
+
+def render_prometheus(metrics_map: dict) -> str:
+    """Render a snapshot-shaped metrics map (``snapshot()['metrics']``
+    or :func:`merge_metric_maps` output) as Prometheus text exposition
+    0.0.4 — delegates to :func:`raft_tpu.obs.metrics.render_metrics_map`
+    (ONE rendering path shared with the live exporter, so
+    naming/escaping rules cannot diverge; federation meta entries like
+    ``_conflicts`` are skipped there)."""
+    return _metrics.render_metrics_map(metrics_map)

@@ -17,6 +17,10 @@ Dump grammar: one JSON object per line, every line carrying ``t``
 * ``{"kind": "error", "error_kind": "oom"|..., "type": ..., "message": ...}``
 * ``{"kind": "event", "event": ..., ...}`` — library breadcrumbs
   (retries, ladder downshifts, injected faults, checkpoint saves)
+* ``{"kind": "waterfall", "trace_id": ..., "stages": [...], ...}`` — a
+  completed graft-trace waterfall (:mod:`raft_tpu.obs.trace`); dumps
+  from different processes stitch by ``trace_id``
+  (``scripts/obs_report.py stitch``)
 * a final ``{"kind": "snapshot", "metrics": {...}}`` line — the full
   registry at dump time.
 """
@@ -24,6 +28,7 @@ Dump grammar: one JSON object per line, every line carrying ``t``
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
@@ -39,6 +44,11 @@ _lock = threading.Lock()
 _events: "collections.deque" = collections.deque(maxlen=DEFAULT_CAPACITY)
 _auto_dumped = False
 _last_dump_path: Optional[str] = None
+# monotonic per-process dump sequence: two dumps in the same wall-clock
+# second used to compute the same flight-<pid>-<unix>.jsonl path and the
+# second silently OVERWROTE the first (ISSUE 13 satellite) — the
+# counter makes every default path distinct for the process lifetime
+_dump_seq = itertools.count(1)
 
 
 def record(kind: str, **fields) -> None:
@@ -79,14 +89,18 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> str:
     """Write the ring + a final metrics-snapshot line as JSONL.
 
     ``path`` defaults to ``RAFT_TPU_OBS_DIR`` (or cwd) /
-    ``flight-<pid>-<unix>.jsonl``. Returns the path written.
+    ``flight-<pid>-<unix>-<seq>.jsonl`` — ``seq`` is a monotonic
+    per-process counter, so two dumps landing in the same second get
+    distinct paths instead of the later overwriting the earlier.
+    Returns the path written.
     """
     global _last_dump_path
     if path is None:
         d = config.obs_dir()
         os.makedirs(d, exist_ok=True)
         path = os.path.join(
-            d, f"flight-{os.getpid()}-{int(time.time())}.jsonl")
+            d, f"flight-{os.getpid()}-{int(time.time())}"
+               f"-{next(_dump_seq):03d}.jsonl")
     with _lock:
         evts = list(_events)
     with open(path, "w") as fp:

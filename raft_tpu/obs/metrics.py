@@ -278,34 +278,55 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def export_prometheus() -> str:
-    """The registry in Prometheus text exposition format (0.0.4): one
-    ``# TYPE`` line per metric, cumulative ``le`` buckets + ``_sum`` /
-    ``_count`` for histograms. Serve it from any HTTP handler (or write
-    it to a textfile-collector drop) to scrape a long-running job."""
+def render_metrics_map(metrics_map: dict) -> str:
+    """Render a snapshot-shaped metrics map (``snapshot()['metrics']``
+    or :func:`raft_tpu.obs.federation.merge_metric_maps` output) as
+    Prometheus text exposition 0.0.4: one ``# TYPE`` line per metric,
+    cumulative ``le`` buckets + ``_sum``/``_count`` for histograms.
+    THE one rendering path — the live exporter and the federated one
+    both delegate here, so naming/escaping rules cannot diverge.
+    Underscore-prefixed entries (federation meta like ``_conflicts``)
+    and unknown kinds are skipped."""
     lines: List[str] = []
-    with _lock:
-        for name in sorted(_registry):
-            m = _registry[name]
-            pname = _prom_name(name, m.kind)
-            lines.append(f"# TYPE {pname} {m.kind}")
-            for key in sorted(m.points):
-                if m.kind == _HISTOGRAM:
-                    counts, total, n = m.points[key]
-                    cum = 0
-                    for edge, c in zip(m.buckets, counts):
-                        cum += c
-                        le = 'le="%s"' % _fmt(edge)
-                        lines.append(
-                            f"{pname}_bucket{_prom_labels(key, le)} {cum}")
-                    cum += counts[-1]
-                    le = 'le="+Inf"'
+    for name in sorted(metrics_map):
+        if name.startswith("_"):
+            continue
+        m = metrics_map[name]
+        kind = m.get("kind")
+        if kind not in (_COUNTER, _GAUGE, _HISTOGRAM):
+            continue
+        pname = _prom_name(name, kind)
+        lines.append(f"# TYPE {pname} {kind}")
+        for p in m.get("points", ()):
+            key = tuple(sorted(
+                (str(k), str(v)) for k, v in p.get("labels", {}).items()))
+            if kind == _HISTOGRAM:
+                counts = p.get("bucket_counts", [])
+                buckets = p.get("buckets", [])
+                cum = 0
+                for edge, c in zip(buckets, counts):
+                    cum += c
+                    le = 'le="%s"' % _fmt(edge)
                     lines.append(
                         f"{pname}_bucket{_prom_labels(key, le)} {cum}")
-                    lines.append(f"{pname}_sum{_prom_labels(key)}"
-                                 f" {_fmt(total)}")
-                    lines.append(f"{pname}_count{_prom_labels(key)} {n}")
-                else:
-                    lines.append(f"{pname}{_prom_labels(key)}"
-                                 f" {_fmt(m.points[key])}")
+                if len(counts) > len(buckets):
+                    cum += counts[-1]
+                inf_le = 'le="+Inf"'
+                lines.append(
+                    f"{pname}_bucket{_prom_labels(key, inf_le)} {cum}")
+                lines.append(f"{pname}_sum{_prom_labels(key)}"
+                             f" {_fmt(p.get('sum', 0.0))}")
+                lines.append(f"{pname}_count{_prom_labels(key)}"
+                             f" {p.get('count', 0)}")
+            else:
+                lines.append(f"{pname}{_prom_labels(key)}"
+                             f" {_fmt(p.get('value', 0.0))}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def export_prometheus() -> str:
+    """The live registry in Prometheus text exposition format (0.0.4)
+    — :func:`render_metrics_map` over a point-in-time snapshot. Serve
+    it from any HTTP handler (or write it to a textfile-collector drop)
+    to scrape a long-running job."""
+    return render_metrics_map(snapshot(runtime_gauges=False)["metrics"])

@@ -26,6 +26,7 @@ from typing import Optional
 
 from raft_tpu.obs import config
 from raft_tpu.obs import metrics
+from raft_tpu.obs import trace as _trace
 
 # children kept per span before truncation (a 100k-chunk streamed search
 # must not grow an unbounded tree); the drop count is recorded
@@ -97,6 +98,13 @@ class Span:
             self._ta.__enter__()
         except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow profiler annotation is best-effort; span timing must survive a profiler-less runtime
             self._ta = None
+        # graft-trace adoption (ISSUE 13): a span opened under an
+        # activated cross-process context carries the shared trace id,
+        # so one trace id names worker-side spans, router spans, and
+        # flight-dumped trees alike — the stitch key obs_report uses
+        tid = _trace.current_id()
+        if tid is not None and "trace_id" not in self.attrs:
+            self.attrs["trace_id"] = tid
         _stack().append(self)
         self.t0 = time.perf_counter()
         return self

@@ -1,0 +1,366 @@
+"""graft-trace: cross-process trace context + per-query waterfalls.
+
+PR 4's spans are per-process trees; PR 6 made the serving path
+multi-process, so one query crossing ``Server.submit -> batcher ->
+fabric router -> worker RPC -> shard scan -> merge`` used to leave
+disconnected fragments with no shared identity. This module is the
+shared identity (ISSUE 13):
+
+* **trace context** — a ``(trace_id, parent_span_id)`` pair minted at
+  the serving entry (:func:`start_trace`), carried across every
+  transport ``call`` as the structured :data:`WIRE_FIELD` payload field
+  (:func:`traced_payload` injects it; graft-lint rule GL019 keeps
+  call sites honest), and adopted worker-side (:func:`adopt` +
+  :func:`activate`) so the worker's spans carry the same trace id;
+* **waterfall assembly** — the router appends per-stage timings
+  (:func:`stage`: ``queue_wait`` / ``linger`` / ``rpc`` /
+  ``worker_scan`` / ``merge`` / ``rerank``, hedge attempts and retries
+  as sibling stages with a ``status``) into a bounded per-trace record,
+  completed by :func:`finish` into a ring readable with
+  :func:`trace_report` — and, in flight mode, recorded as a
+  ``waterfall`` event so cross-process dumps stitch by trace id
+  (``scripts/obs_report.py``).
+
+Off-mode contract (the PR-4 allocation guard extends here): every
+public function returns after one module-attribute read
+(:data:`raft_tpu.obs.config.ENABLED`) — no ids are minted, no ring is
+touched, :func:`traced_payload` hands its payload back unmodified.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from raft_tpu.obs import config
+
+# the structured RPC-payload field carrying the context across the
+# process boundary. The field rides the payload INTO the worker's
+# handler untouched; each traced handler (procgroup._do_search today)
+# adopts + activates it itself — a new traced RPC must do the same, or
+# its worker-side spans carry no trace id
+WIRE_FIELD = "trace"
+
+# bounded assembly state: open waterfalls a failure orphaned are
+# evicted oldest-first past MAX_OPEN; completed waterfalls ride a ring
+# sized like the flight recorder's event ring so a chaos loadgen's
+# whole answer stream stays reportable
+MAX_OPEN = 1024
+MAX_DONE = 4096
+# stages kept per waterfall before truncation (a retry storm must not
+# grow an unbounded record); the drop count is kept on the waterfall
+MAX_STAGES = 128
+
+_lock = threading.Lock()
+_open: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+_done: "collections.deque" = collections.deque(maxlen=MAX_DONE)
+# lifetime completion count: _done_total - len(_done) = how many
+# completed waterfalls the bounded ring has evicted — consumers that
+# present per-run totals (the loadgen columns) must not pretend the
+# ring is the run (no silent caps)
+_done_total = 0
+_ids = itertools.count(1)
+_pid_salt: Optional[str] = None
+
+_tls = threading.local()
+
+
+class TraceContext:
+    """One query's identity: ``trace_id`` names the whole path,
+    ``parent_span_id`` the entry span children attach under."""
+
+    __slots__ = ("trace_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, parent_span_id: str):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.trace_id!r}, {self.parent_span_id!r})"
+
+
+def _mint_id() -> str:
+    # pid + random salt + monotonic counter: unique across the fabric's
+    # processes with no coordination (two workers minting concurrently
+    # can never collide on the salt+pid prefix)
+    global _pid_salt
+    if _pid_salt is None:
+        _pid_salt = f"{os.getpid():x}.{os.urandom(3).hex()}"
+    return f"{_pid_salt}.{next(_ids):x}"
+
+
+# ---------------------------------------------------------------------------
+# context minting / wire format / ambient adoption
+# ---------------------------------------------------------------------------
+
+
+def start_trace(entry: str, **attrs) -> Optional[TraceContext]:
+    """Mint a trace context at a serving entry point and open its
+    waterfall. Returns ``None`` when obs is off."""
+    if not config.ENABLED:
+        return None
+    tid = _mint_id()
+    ctx = TraceContext(tid, _mint_id())
+    wf = {
+        "trace_id": tid,
+        "entry": entry,
+        "t_unix": time.time(),
+        "_t0": time.perf_counter(),
+        "attrs": dict(attrs),
+        "stages": [],
+        "dropped_stages": 0,
+    }
+    with _lock:
+        _open[tid] = wf
+        while len(_open) > MAX_OPEN:
+            _open.popitem(last=False)      # orphaned by a failure: evict
+    return ctx
+
+
+def to_wire(ctx: Optional[TraceContext]) -> Optional[dict]:
+    """The structured RPC field for ``ctx`` (None passes through)."""
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id,
+            "parent_span_id": ctx.parent_span_id}
+
+
+def adopt(wire) -> Optional[TraceContext]:
+    """Rebuild a context from a :data:`WIRE_FIELD` payload field (the
+    worker side of the propagation). Tolerates None/garbage — a
+    malformed field must degrade to an untraced call, never fail it."""
+    if not config.ENABLED or not isinstance(wire, dict):
+        return None
+    tid = wire.get("trace_id")
+    if not isinstance(tid, str):
+        return None
+    psid = wire.get("parent_span_id")
+    return TraceContext(tid, psid if isinstance(psid, str) else tid)
+
+
+def traced_payload(payload: Optional[dict],
+                   ctx: Optional[TraceContext] = None) -> Optional[dict]:
+    """Inject the trace context (``ctx`` or the thread's ambient one)
+    into an RPC payload under :data:`WIRE_FIELD`. The GL019-enforced
+    helper: every data-plane transport ``call`` site threads its payload
+    through here. Off mode (or no context) returns ``payload``
+    unchanged — one module-attribute read."""
+    if not config.ENABLED:
+        return payload
+    if ctx is None:
+        ctx = current()
+    if ctx is None:
+        return payload
+    out = dict(payload) if payload else {}
+    out[WIRE_FIELD] = to_wire(ctx)
+    return out
+
+
+def current() -> Optional[TraceContext]:
+    """The thread's ambient trace context, or None."""
+    if not config.ENABLED:
+        return None
+    return getattr(_tls, "ctx", None)
+
+
+def current_id() -> Optional[str]:
+    """The ambient trace id (the span layer stamps it on every span)."""
+    ctx = getattr(_tls, "ctx", None)
+    return ctx.trace_id if ctx is not None else None
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the thread's ambient context for the body (the
+    worker-side adoption: spans opened inside carry its trace id)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+# ---------------------------------------------------------------------------
+# waterfall assembly
+# ---------------------------------------------------------------------------
+
+
+def _trace_id(ctx_or_id) -> Optional[str]:
+    if isinstance(ctx_or_id, TraceContext):
+        return ctx_or_id.trace_id
+    if isinstance(ctx_or_id, str):
+        return ctx_or_id
+    return None
+
+
+def stage(ctx_or_id, name: str, ms: Optional[float] = None,
+          t_start: Optional[float] = None, status: str = "ok",
+          **attrs) -> None:
+    """Append one stage to an open waterfall. ``ms`` is the stage's
+    duration; ``t_start`` (a ``time.perf_counter()`` value) positions it
+    on the waterfall's time axis as ``t_off_ms``. ``status`` marks
+    hedge winners/losers, failures, and retries (``"ok"`` |
+    ``"hedge_win"`` | ``"hedge_loser"`` | ``"failed"`` | ``"timeout"``
+    | ``"retry"`` | ...)."""
+    if not config.ENABLED:
+        return
+    tid = _trace_id(ctx_or_id)
+    if tid is None:
+        return
+    entry: Dict[str, object] = {"stage": name, "status": status}
+    if ms is not None:
+        entry["ms"] = round(float(ms), 4)
+    for k, v in attrs.items():
+        if v is not None:
+            entry[k] = v
+    with _lock:
+        wf = _open.get(tid)
+        if wf is None:
+            return                        # evicted / already finished
+        if t_start is not None:
+            entry["t_off_ms"] = round(
+                (float(t_start) - wf["_t0"]) * 1e3, 4)
+        if len(wf["stages"]) < MAX_STAGES:
+            wf["stages"].append(entry)
+        else:
+            wf["dropped_stages"] += 1
+
+
+def finish(ctx_or_id, status: str = "ok", **attrs) -> Optional[dict]:
+    """Complete a waterfall: stamp total ``ms`` + ``status``, move it to
+    the done ring, record it to the flight ring (``kind="waterfall"``)
+    and the ``trace.waterfalls_total{status}`` counter. Returns the
+    completed record (shared with the ring — treat as read-only)."""
+    global _done_total
+    if not config.ENABLED:
+        return None
+    tid = _trace_id(ctx_or_id)
+    if tid is None:
+        return None
+    with _lock:
+        wf = _open.pop(tid, None)
+        if wf is None:
+            return None
+        wf["ms"] = round((time.perf_counter() - wf.pop("_t0")) * 1e3, 4)
+        wf["status"] = status
+        if attrs:
+            wf["attrs"].update(attrs)
+        if not wf["dropped_stages"]:
+            del wf["dropped_stages"]
+        _done.append(wf)
+        _done_total += 1
+    from raft_tpu.obs import metrics
+
+    metrics.counter("trace.waterfalls_total", status=status)
+    if config.FLIGHT:
+        from raft_tpu.obs import flight
+
+        flight.record("waterfall", **wf)
+    return wf
+
+
+def trace_report(trace_id: Optional[str] = None,
+                 limit: Optional[int] = None) -> List[dict]:
+    """Completed waterfalls, oldest first (``obs.trace_report()``).
+
+    ``trace_id`` filters to one trace; ``limit`` keeps the newest N.
+    Records are shared with the ring — treat them as read-only."""
+    with _lock:
+        items = list(_done)
+    if trace_id is not None:
+        items = [w for w in items if w["trace_id"] == trace_id]
+    if limit is not None:
+        items = items[-int(limit):]
+    return items
+
+
+def _percentile(sorted_ms: List[float], p: float) -> Optional[float]:
+    if not sorted_ms:
+        return None
+    # nearest-rank on the sorted sample — dependency-free (this module
+    # must stay importable without numpy)
+    idx = min(len(sorted_ms) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_ms) - 1)))))
+    return round(sorted_ms[idx], 4)
+
+
+def stage_stats(waterfalls: List[dict]) -> dict:
+    """Per-stage latency attribution over a set of waterfalls: for each
+    stage name, ``{count, p50_ms, p99_ms, hedge_wins, hedge_losers,
+    failed, retries}`` — the columns ``serve_loadgen --fabric`` emits
+    and ``obs_report.py`` renders. Failed/timeout/retry stages carry no
+    ``ms`` toward the percentiles of successful work."""
+    per: Dict[str, dict] = {}
+    for wf in waterfalls:
+        for s in wf.get("stages", ()):
+            d = per.setdefault(str(s.get("stage")), {
+                "count": 0, "_ms": [], "hedge_wins": 0,
+                "hedge_losers": 0, "failed": 0, "retries": 0,
+            })
+            d["count"] += 1
+            status = s.get("status", "ok")
+            if status == "hedge_win":
+                d["hedge_wins"] += 1
+            elif status == "hedge_loser":
+                d["hedge_losers"] += 1
+            elif status in ("failed", "timeout"):
+                d["failed"] += 1
+            elif status == "retry":
+                d["retries"] += 1
+            if s.get("ms") is not None and status in ("ok", "hedge_win"):
+                d["_ms"].append(float(s["ms"]))
+    out: Dict[str, dict] = {}
+    for name in sorted(per):
+        d = per[name]
+        ms = sorted(d.pop("_ms"))
+        d["p50_ms"] = _percentile(ms, 50)
+        d["p99_ms"] = _percentile(ms, 99)
+        out[name] = d
+    return out
+
+
+def ring_stats() -> dict:
+    """Honesty accounting for the bounded done ring: ``completed_total``
+    waterfalls finished since the last :func:`reset`, ``retained`` still
+    readable, ``evicted`` aged out of the ring. Consumers presenting
+    per-run aggregates (the loadgen waterfall columns) surface
+    ``evicted`` so a truncated window never reads as the whole run."""
+    with _lock:
+        retained = len(_done)
+        return {"completed_total": _done_total, "retained": retained,
+                "evicted": _done_total - retained}
+
+
+def waterfall_complete(wf: dict) -> bool:
+    """ONE definition of a complete end-to-end fabric waterfall — the
+    chaos acceptance (tests/test_fabric.py) and the loadgen's
+    ``complete_fraction`` column consume this same predicate, so the
+    shipped artifact and the test cannot silently diverge: the query
+    was ANSWERED (ok/degraded), a ``merge`` stage closed it, and every
+    shard it reports covered contributed a device-complete
+    ``worker_scan`` stage from the worker that served it."""
+    if wf.get("status") not in ("ok", "degraded"):
+        return False
+    stages = wf.get("stages", ())
+    if not any(s.get("stage") == "merge" for s in stages):
+        return False
+    covered = set(wf.get("attrs", {}).get("covered_shards", ()))
+    scanned = {s.get("shard") for s in stages
+               if s.get("stage") == "worker_scan"
+               and s.get("device_complete")}
+    return covered <= scanned
+
+
+def reset() -> None:
+    """Drop open and completed waterfalls (tests / between runs)."""
+    global _done_total
+    with _lock:
+        _open.clear()
+        _done.clear()
+        _done_total = 0

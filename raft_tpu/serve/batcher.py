@@ -37,6 +37,7 @@ import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.utils.math import next_pow2
 
@@ -120,6 +121,10 @@ class Request:
     prefilter: object             # user filter (batch-grouping key)
     future: Future
     t_enqueue: float = 0.0
+    # graft-trace context (ISSUE 13): minted at submit, carried by the
+    # batch as a span LINK (one batch serves many traces), completed at
+    # delivery — None when obs is off
+    trace: Optional[obs_trace.TraceContext] = None
 
     @property
     def rows(self) -> int:
@@ -136,6 +141,9 @@ class Batch:
     bucket: int
     prefilter: object
     seq: int = 0
+    # the head request's formation wait — the linger attribution every
+    # member trace's batch stage carries
+    linger_ms: float = 0.0
 
     @property
     def k_max(self) -> int:
@@ -198,7 +206,15 @@ class MicroBatcher:
                       rows=int(queries.shape[0]), k=int(k)):
             req = Request(queries=queries, k=int(k), prefilter=prefilter,
                           future=Future())
+            # the serving entry mints the trace (ISSUE 13): the id is
+            # minted BEFORE admission so a rejection still completes a
+            # (tiny) waterfall naming why the query died at the door
+            req.trace = obs_trace.start_trace(
+                "serve.submit", index=self.name, rows=req.rows,
+                k=int(k))
             if req.rows > self.max_batch_rows:
+                obs_trace.finish(req.trace, status="rejected",
+                                 reason="oversized")
                 raise ValueError(
                     f"request rows={req.rows} exceeds max_batch_rows="
                     f"{self.max_batch_rows}; split the query block or "
@@ -224,6 +240,8 @@ class MicroBatcher:
             if reason is not None:
                 obs.counter("serve.rejects_total", index=self.name,
                             reason=reason)
+                obs_trace.finish(req.trace, status="rejected",
+                                 reason=reason)
                 exc = Overloaded(
                     f"serve[{self.name}]: {reason} "
                     f"(pending={pending} rows, "
@@ -291,6 +309,8 @@ class MicroBatcher:
                 self._dispatch(batch)
             except BaseException as e:  # noqa: BLE001 — classified by the engine; the loop must survive to fail ONLY this batch
                 for r in batch.requests:
+                    obs_trace.finish(r.trace, status="error",
+                                     error=type(e).__name__)
                     if not r.future.done():
                         r.future.set_exception(e)
 
@@ -358,11 +378,19 @@ class MicroBatcher:
                     bucket=str(bucket))
         obs.observe("serve.batch_fill_ratio", rows / bucket,
                     buckets=FILL_BUCKETS, index=self.name)
-        obs.observe("serve.queue_wait_ms",
-                    (time.monotonic() - head.t_enqueue) * 1e3,
-                    index=self.name)
+        now = time.monotonic()
+        linger_ms = (now - head.t_enqueue) * 1e3
+        obs.observe("serve.queue_wait_ms", linger_ms, index=self.name)
+        # per-request queue_wait stages: each member trace records ITS
+        # enqueue->drain wait, with the batch seq as the span link tying
+        # the traces this batch serves together
+        for r in taken:
+            obs_trace.stage(r.trace, "queue_wait",
+                            ms=(now - r.t_enqueue) * 1e3,
+                            batch_seq=self._seq, bucket=bucket)
         return Batch(requests=taken, rows=rows, bucket=bucket,
-                     prefilter=head.prefilter, seq=self._seq)
+                     prefilter=head.prefilter, seq=self._seq,
+                     linger_ms=linger_ms)
 
 
 def pad_rows(queries: np.ndarray, bucket: int) -> np.ndarray:

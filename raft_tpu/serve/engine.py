@@ -48,6 +48,7 @@ import numpy as np
 
 from raft_tpu import obs, tuning
 from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.distance.types import is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -429,6 +430,8 @@ class _IndexServing:
                 # single request: record the learned ceiling anyway
                 self._downshift(max(batch.bucket // 2, 1))
             for r in batch.requests:
+                obs_trace.finish(r.trace, status="error", kind=kind,
+                                 error=type(e).__name__)
                 if not r.future.done():
                     r.future.set_exception(e)
 
@@ -446,6 +449,11 @@ class _IndexServing:
         the batch as two ladder-shaped halves (requests are the atomic
         unit — row-independent searches make the split result-identical)."""
         self._downshift(batch.bucket // 2)
+        for r in batch.requests:
+            # a retry stage, not a finish: the split halves re-dispatch
+            # and each member trace completes at its half's delivery
+            obs_trace.stage(r.trace, "retry", status="retry",
+                            reason="oom_split", bucket=batch.bucket)
         half_rows = batch.rows // 2
         left: List = []
         rows = 0
@@ -464,6 +472,7 @@ class _IndexServing:
                 bucket=choose_bucket(self.batcher.ladder, prows,
                                      ceiling=self.batcher.ceiling),
                 prefilter=batch.prefilter, seq=batch.seq,
+                linger_ms=batch.linger_ms,
             )
             self._dispatch(sub)
 
@@ -542,15 +551,28 @@ class _IndexServing:
             ri = ext[row:row + r.rows, :r.k]
             row += r.rows
             r.future.generation = gen.version
+            # the shared device work, attributed to every member trace:
+            # batch_seq is the span LINK (one batch serves many traces),
+            # linger_ms the batching policy's share of the wait
+            obs_trace.stage(r.trace, "batch_search", ms=latency_ms,
+                            bucket=batch.bucket, batch_seq=batch.seq,
+                            linger_ms=round(batch.linger_ms, 3),
+                            generation=gen.version)
             if r.future.done():
+                obs_trace.finish(r.trace, status="error",
+                                 error="already_done")
                 continue
             if rd.shape[1] < r.k:
                 # a swap shrank the index below this request's k after
                 # admission: fail loudly, never hand back fewer columns
                 # than asked
+                obs_trace.finish(r.trace, status="failed",
+                                 error="k_exceeds_rows")
                 r.future.set_exception(ValueError(
                     f"k={r.k} exceeds index rows={h.rows} after swap"))
             else:
+                obs_trace.finish(r.trace, status="ok",
+                                 generation=gen.version)
                 r.future.set_result((rd, ri))
         obs.counter("serve.queries_total", batch.rows, index=self.name)
         obs.observe("serve.batch_latency_ms", latency_ms,

@@ -72,6 +72,8 @@ import numpy as np
 from raft_tpu import obs, tuning
 from raft_tpu.analysis import lockwatch
 from raft_tpu.comms.procgroup import LocalGroup, ProcGroup, is_no_gen
+from raft_tpu.obs import federation as obs_federation
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.resilience import ShardDropoutError
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.serve.registry import Registry
@@ -366,22 +368,44 @@ class Fabric:
             raise RuntimeError("fabric is closed")
         m = int(q.shape[0])
         k = int(k)
+        # graft-trace (ISSUE 13): one trace id for the whole query path
+        # — ALWAYS minted here (the serving entry owns its waterfall;
+        # adopting an ambient context would collide with — or, cross-
+        # process, miss — the record keyed under that id). An enclosing
+        # caller's context is kept as a link attr instead.
+        ambient = obs_trace.current()
+        ctx = obs_trace.start_trace(
+            "fabric.search", index=self.name, rows=m, k=k,
+            **({"parent_trace": ambient.trace_id} if ambient else {}))
         with obs.entry_span("search", "fabric", queries=m, k=k):
-            gen = self.registry.pin(self.name)
             try:
-                h: _ClusterGen = gen.handle
-                if k > h.rows:
-                    raise ValueError(f"k={k} exceeds fabric rows={h.rows}")
-                futs = {
-                    s: self._pool.submit(self._search_shard, h, s, q, k)
-                    for s in range(h.n_shards)
-                }
-                results = {s: f.result() for s, f in futs.items()}
-                gen_id = h.gen_id
-                n_shards = h.n_shards
-            finally:
-                gen.release()
-            d, i, validity = merge_shard_results(n_shards, results, m, k)
+                gen = self.registry.pin(self.name)
+                try:
+                    h: _ClusterGen = gen.handle
+                    if k > h.rows:
+                        raise ValueError(
+                            f"k={k} exceeds fabric rows={h.rows}")
+                    futs = {
+                        s: self._pool.submit(self._search_shard, h, s, q,
+                                             k, ctx)
+                        for s in range(h.n_shards)
+                    }
+                    results = {s: f.result() for s, f in futs.items()}
+                    gen_id = h.gen_id
+                    n_shards = h.n_shards
+                finally:
+                    gen.release()
+                t_merge = time.perf_counter()
+                d, i, validity = merge_shard_results(n_shards, results, m,
+                                                     k)
+                obs_trace.stage(
+                    ctx, "merge",
+                    ms=(time.perf_counter() - t_merge) * 1e3,
+                    t_start=t_merge, shards=n_shards)
+            except BaseException as e:  # noqa: BLE001 — re-raised below; caught only to complete the waterfall honestly
+                obs_trace.finish(ctx, status="failed",
+                                 error=type(e).__name__)
+                raise
             coverage = (validity.mean(axis=0, dtype=np.float32) if m
                         else np.ones((0,), np.float32))
             cov_min = float(coverage.min()) if m else 1.0
@@ -393,6 +417,22 @@ class Fabric:
                 obs.counter("fabric.dropouts_total", len(uncovered))
                 obs.event("fabric_shard_dropout", shards=uncovered,
                           coverage=cov_min, gen=gen_id)
+            covered = sorted(s for s, r in results.items()
+                             if r is not None)
+            # the status must tell the truth about what the CALLER got:
+            # a coverage shortfall that is about to raise is a FAILED
+            # query (no answer delivered), not a degraded answer — the
+            # loadgen's answered/complete columns and the chaos >=99%
+            # acceptance count ok/degraded only
+            will_raise = ((not partial and cov_min < 1.0)
+                          or (partial and cov_min < p.coverage_floor))
+            obs_trace.finish(
+                ctx,
+                status=("failed" if will_raise
+                        else "degraded" if cov_min < 1.0 else "ok"),
+                gen=gen_id, coverage_min=round(cov_min, 5),
+                covered_shards=covered,
+                **({"error": "ShardDropoutError"} if will_raise else {}))
             if not partial and cov_min < 1.0:
                 raise ShardDropoutError(
                     f"fabric[{self.name}]: coverage {cov_min:.3f} < 1 "
@@ -422,7 +462,9 @@ class Fabric:
         return closed + half
 
     def _search_shard(self, h: _ClusterGen, shard: int, q: np.ndarray,
-                      k: int) -> Optional[tuple]:
+                      k: int,
+                      ctx: Optional[obs_trace.TraceContext] = None,
+                      ) -> Optional[tuple]:
         """One shard's routed search: deadline-bounded, classified
         retry/backoff across owners, hedged duplicate past the latency
         percentile. Returns ``(worker, d, i)`` or ``None`` (shard
@@ -430,8 +472,9 @@ class Fabric:
         coverage event, not an exception."""
         p = self.params
         deadline = time.monotonic() + p.rpc_deadline_s
-        payload = {"gen": h.gen_id, "shard": int(shard), "q": q,
-                   "k": int(k)}
+        payload = obs_trace.traced_payload(
+            {"gen": h.gen_id, "shard": int(shard), "q": q, "k": int(k)},
+            ctx)
         tried: List[int] = []
         attempt = 0
         while True:
@@ -440,7 +483,7 @@ class Fabric:
                 return None
             primary = owners[0]
             out = self._rpc_hedged(primary, owners[1:], payload, deadline,
-                                   shard)
+                                   shard, ctx)
             if out is not None:
                 return out
             tried.append(primary)
@@ -452,17 +495,24 @@ class Fabric:
                 return None
             self._count("retries")
             obs.counter("fabric.rpc_retries_total")
+            obs_trace.stage(ctx, "retry", status="retry", shard=shard,
+                            worker=primary, attempt=attempt,
+                            backoff_ms=round(backoff * 1e3, 3))
             time.sleep(backoff)
 
     def _rpc_hedged(self, primary: int, alternates: Sequence[int],
-                    payload: dict, deadline: float,
-                    shard: int) -> Optional[tuple]:
+                    payload: dict, deadline: float, shard: int,
+                    ctx: Optional[obs_trace.TraceContext] = None,
+                    ) -> Optional[tuple]:
         """One routed attempt: RPC the primary; once it is slower than
         the hedge threshold, duplicate the request to the first
         alternate and take whichever valid answer lands first. The
-        loser's late response is discarded by the transport."""
+        loser's late response is discarded by the transport. Every
+        attempt — winner, hedge loser, failure, timeout — lands in the
+        query's waterfall as an ``rpc`` stage with its status."""
         p = self.params
         outstanding: List[Tuple[int, Future]] = [
+            # graft-lint: allow-untraced-rpc payload pre-threaded by _search_shard via obs.trace.traced_payload
             (primary, self.group.call(primary, "search", payload))
         ]
         hedge_s = self._hedge_delay_ms() / 1e3
@@ -481,6 +531,11 @@ class Fabric:
                     self.health[rank].record_failure(kind)
                     obs.counter("fabric.rpc_timeouts_total", worker=rank,
                                 kind=kind)
+                    obs_trace.stage(
+                        ctx, "rpc",
+                        ms=(time.perf_counter() - sent[rank]) * 1e3,
+                        t_start=sent[rank], worker=rank, shard=shard,
+                        status="timeout", kind=kind)
                     # abandon the request at the transport so a reply
                     # that never comes (dropped RPC, hung worker) does
                     # not pin its Future + query payload forever
@@ -497,6 +552,7 @@ class Fabric:
                     alt = alternates[0]
                     sent[alt] = time.perf_counter()
                     outstanding.append(
+                        # graft-lint: allow-untraced-rpc payload pre-threaded by _search_shard via obs.trace.traced_payload
                         (alt, self.group.call(alt, "search", payload)))
                     hedged = True
                     self._count("hedges")
@@ -508,6 +564,7 @@ class Fabric:
                 if f not in done:
                     continue
                 outstanding.remove((rank, f))
+                rpc_ms = (time.perf_counter() - sent[rank]) * 1e3
                 try:
                     res = f.result()
                 except BaseException as e:  # noqa: BLE001 — classified right here, per worker
@@ -521,10 +578,18 @@ class Fabric:
                         # breaker
                         obs.counter("fabric.stale_worker_total",
                                     worker=rank)
+                        obs_trace.stage(ctx, "rpc", ms=rpc_ms,
+                                        t_start=sent[rank], worker=rank,
+                                        shard=shard, status="failed",
+                                        kind="stale")
                     else:
                         self.health[rank].record_failure(kind)
                         obs.counter("fabric.rpc_errors_total",
                                     worker=rank, kind=kind)
+                        obs_trace.stage(ctx, "rpc", ms=rpc_ms,
+                                        t_start=sent[rank], worker=rank,
+                                        shard=shard, status="failed",
+                                        kind=kind)
                     continue
                 if int(res["gen"]) != int(payload["gen"]):
                     # structurally impossible (workers answer from the
@@ -535,14 +600,38 @@ class Fabric:
                     obs.counter("fabric.mixed_generation_total",
                                 worker=rank)
                     continue
-                self._observe_latency(
-                    rank, (time.perf_counter() - sent[rank]) * 1e3)
+                self._observe_latency(rank, rpc_ms)
                 self.health[rank].record_success()
+                obs_trace.stage(
+                    ctx, "rpc", ms=rpc_ms, t_start=sent[rank],
+                    worker=rank, shard=shard,
+                    status="hedge_win" if hedged and rank != primary
+                    else "ok")
+                # the worker's span summary piggybacked on the reply:
+                # its device-complete scan time becomes the trace's
+                # worker_scan stage (positioned by subtracting its
+                # duration from the arrival time — worker clocks are
+                # not comparable across processes)
+                for s in res.get("spans", ()):
+                    if not isinstance(s, dict):
+                        continue
+                    s_ms = float(s.get("ms", 0.0))
+                    obs_trace.stage(
+                        ctx, s.get("name", "worker_scan"), ms=s_ms,
+                        t_start=time.perf_counter() - s_ms / 1e3,
+                        worker=s.get("worker", rank), shard=shard,
+                        device_complete=bool(
+                            s.get("device_complete", False)))
                 for loser, lf in outstanding:
                     # hedge loser: drop its pending entry now — a slow
                     # reply cleans itself up on arrival, but a reply
                     # that never comes would leak the Future
                     self.group.forget(loser, lf)
+                    obs_trace.stage(
+                        ctx, "rpc",
+                        ms=(time.perf_counter() - sent[loser]) * 1e3,
+                        t_start=sent[loser], worker=loser, shard=shard,
+                        status="hedge_loser")
                 return rank, np.asarray(res["d"]), np.asarray(res["i"])
         return None
 
@@ -551,6 +640,15 @@ class Fabric:
             return (_rerrors.TRANSIENT if self.group.alive(rank)
                     else _rerrors.DEAD_BACKEND)
         return _rerrors.classify(exc)
+
+    def _call_control(self, rank: int, method: str,
+                      payload: Optional[dict] = None) -> Future:
+        """The control plane's ONE transport call site (ping / prepare /
+        publish / abort / retire / collect_metrics). Deliberately
+        untraced: control RPCs belong to no query, so threading a trace
+        context would stamp whatever query happens to be ambient on the
+        calling thread onto cluster management noise."""
+        return self.group.call(rank, method, payload)  # graft-lint: allow-untraced-rpc control-plane RPC — belongs to no query trace (GL019 scopes the data plane)
 
     # -- hedge-delay measurement --------------------------------------------
 
@@ -632,7 +730,7 @@ class Fabric:
             deadline = time.monotonic() + p.swap_deadline_s
             # phase 1: prepare-and-warm everywhere, or roll back
             futs = {
-                r: self.group.call(r, "prepare",
+                r: self._call_control(r, "prepare",
                                    {"gen": gen_id,
                                     "shards": per_worker[r]})
                 for r in live
@@ -655,7 +753,7 @@ class Fabric:
             # and the half-open resync path re-publishes the staged
             # generation (publish is idempotent), so live workers are
             # never mixed-generation.
-            futs = {r: self.group.call(r, "publish", {"gen": gen_id})
+            futs = {r: self._call_control(r, "publish", {"gen": gen_id})
                     for r in live}
             failed = self._await_all(futs, deadline)
             for r in failed:
@@ -702,7 +800,7 @@ class Fabric:
 
     def _abort_generation(self, gen_id: int,
                           ranks: Sequence[int]) -> None:
-        futs = [(r, self.group.call(r, "abort", {"gen": gen_id}))
+        futs = [(r, self._call_control(r, "abort", {"gen": gen_id}))
                 for r in ranks]
         for r, f in futs:
             try:
@@ -716,7 +814,7 @@ class Fabric:
             if not self.group.alive(r):
                 continue
             try:
-                self.group.call(r, "retire", {"gen": gen_id})
+                self._call_control(r, "retire", {"gen": gen_id})
             except BaseException as e:  # noqa: BLE001 — classified: retire is best-effort GC of a drained generation
                 _rerrors.classify(e)
 
@@ -743,7 +841,7 @@ class Fabric:
     def _probe_worker(self, rank: int) -> bool:
         p = self.params
         self._count("probes")
-        fut = self.group.call(rank, "ping", {})
+        fut = self._call_control(rank, "ping", {})
         try:
             res = fut.result(timeout=p.probe_timeout_s)
         except BaseException as e:  # noqa: BLE001 — classified via _fail_kind
@@ -787,10 +885,10 @@ class Fabric:
         }
         fut = None
         try:
-            fut = self.group.call(rank, "prepare",
+            fut = self._call_control(rank, "prepare",
                                   {"gen": gen_id, "shards": shards})
             fut.result(timeout=self.params.swap_deadline_s)
-            fut = self.group.call(rank, "publish", {"gen": gen_id})
+            fut = self._call_control(rank, "publish", {"gen": gen_id})
             fut.result(timeout=self.params.probe_timeout_s)
         except BaseException as e:  # noqa: BLE001 — classified via _fail_kind; the breaker records the verdict
             self.health[rank].record_failure(self._fail_kind(e, rank))
@@ -831,6 +929,77 @@ class Fabric:
         if cur is None or cur.handle is None:
             return 0
         return cur.handle.gen_id
+
+    def collect_metrics(self, include_router: bool = True,
+                        timeout_s: Optional[float] = None) -> dict:
+        """Fleet metrics federation (ISSUE 13): scrape every live
+        worker's metrics registry over the ``collect_metrics`` RPC and
+        merge the snapshots — each worker's series under a
+        ``worker="w<rank>"`` label, the router's own registry under
+        ``worker="router"`` — into one snapshot-shaped dict
+        (:func:`raft_tpu.obs.federation.federated_snapshot`, plus
+        ``generation`` and per-worker ``health``). A worker that fails
+        the scrape is recorded against its circuit breaker and skipped;
+        the snapshot's ``workers`` list names exactly the workers that
+        answered."""
+        with obs.span("fabric.collect_metrics", index=self.name):
+            timeout = (float(timeout_s) if timeout_s is not None
+                       else self.params.probe_timeout_s)
+            futs = {
+                r: self._call_control(r, "collect_metrics", {})
+                for r in range(self.params.n_workers)
+                if self.group.alive(r)
+            }
+            # ONE shared deadline across the fleet, not timeout-per-rank:
+            # a scrape endpoint over N hung workers must answer in
+            # ~timeout, not N x timeout
+            deadline = time.monotonic() + timeout
+            parts: Dict[str, dict] = {}
+            answered: List[str] = []
+            shared = False
+            for r, f in futs.items():
+                try:
+                    res = f.result(
+                        timeout=max(deadline - time.monotonic(), 1e-3))
+                except BaseException as e:  # noqa: BLE001 — classified via _fail_kind; a mute worker degrades the snapshot, never fails it
+                    self.health[r].record_failure(self._fail_kind(e, r))
+                    obs.counter("fabric.federation_errors_total",
+                                worker=r)
+                    self.group.forget(r, f)
+                    continue
+                answered.append(f"w{r}")
+                if res.get("shared_registry"):
+                    # LocalGroup twin: the worker shares THIS process's
+                    # registry — it answered, but its series arrive
+                    # once, as the router's, or every fleet sum would
+                    # multiply (n_workers+1)x
+                    shared = True
+                    continue
+                parts[f"w{r}"] = res.get("metrics", {})
+            if include_router and obs.enabled():
+                parts["router"] = obs.snapshot(
+                    runtime_gauges=False)["metrics"]
+            obs.gauge("fabric.federation_workers", len(answered))
+            # the workers list names exactly the WORKERS that answered;
+            # the router's own series ride the metrics map under
+            # worker="router"
+            fed = obs_federation.federated_snapshot(
+                parts, workers=sorted(answered))
+            if shared:
+                fed["shared_registry"] = True
+            fed["generation"] = self.generation()
+            fed["worker_health"] = {
+                f"w{r}": self.health[r].state
+                for r in range(self.params.n_workers)
+            }
+            return fed
+
+    def export_federated_prometheus(self) -> str:
+        """One Prometheus text exposition for the whole fleet — the
+        scrape-endpoint body a router-side HTTP handler serves
+        (docs/observability.md §federation)."""
+        fed = self.collect_metrics()
+        return obs_federation.render_prometheus(fed["metrics"])
 
     def stats(self) -> dict:
         with self._stats_lock:

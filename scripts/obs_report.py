@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Render graft-trace waterfalls and federated snapshots from obs
+artifacts (ISSUE 13; docs/observability.md §distributed-tracing).
+
+Three subcommands over flight-recorder JSONL dumps and
+``obs.write_snapshot`` JSON sidecars:
+
+* ``waterfall FILE...`` — extract completed waterfalls
+  (``kind="waterfall"`` lines) and render each as an ASCII timeline
+  (stage bars positioned by ``t_off_ms``, hedge losers/failures
+  marked), plus the per-stage p50/p99 attribution table
+  (``raft_tpu.obs.trace.stage_stats``). ``--trace ID`` filters to one
+  trace; ``--summary`` prints only the table.
+* ``federate FILE...`` — merge metrics from snapshot sidecars (or the
+  final snapshot line of flight dumps) under per-source ``worker``
+  labels into one Prometheus exposition on stdout
+  (``raft_tpu.obs.federation``); ``--json PATH`` also writes the
+  merged JSON snapshot.
+* ``stitch FILE...`` — group span/error/waterfall events from MANY
+  dumps (router + each worker process) by trace id: the cross-process
+  post-mortem view one flight dump per process cannot give alone.
+
+Examples:
+    python scripts/obs_report.py waterfall OBS_r13/flight-*.jsonl
+    python scripts/obs_report.py federate OBS_r13/*.obs.json --json FED.json
+    python scripts/obs_report.py stitch OBS_r13/flight-*.jsonl --trace 1a2b.3c.4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+BAR_WIDTH = 48
+_STATUS_MARK = {"ok": "", "hedge_win": " *hedge-win*",
+                "hedge_loser": " (hedge loser)", "failed": " !FAILED",
+                "timeout": " !TIMEOUT", "retry": " ~retry"}
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse one flight JSONL dump (bad lines skipped, annotated with
+    their source file for the stitch view)."""
+    out: List[dict] = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evt = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(evt, dict):
+                evt["_source"] = os.path.basename(path)
+                out.append(evt)
+    return out
+
+
+def load_metrics(path: str) -> dict:
+    """The metrics map of one artifact: an ``obs.write_snapshot`` /
+    federated JSON sidecar (``{"metrics": ...}``), or a flight JSONL
+    dump (its final ``kind="snapshot"`` line)."""
+    if path.endswith(".jsonl"):
+        snaps = [e for e in load_events(path) if e.get("kind") == "snapshot"]
+        return snaps[-1].get("metrics", {}) if snaps else {}
+    with open(path) as fp:
+        data = json.load(fp)
+    return data.get("metrics", {}) if isinstance(data, dict) else {}
+
+
+def waterfalls_from_events(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("kind") == "waterfall"]
+
+
+# ---------------------------------------------------------------------------
+# waterfall rendering
+# ---------------------------------------------------------------------------
+
+
+def render_waterfall(wf: dict, width: int = BAR_WIDTH) -> str:
+    """One waterfall as an ASCII timeline: a bar per stage, positioned
+    by ``t_off_ms`` and scaled to the trace's total wall-clock."""
+    total = float(wf.get("ms") or 0.0)
+    stages = wf.get("stages", [])
+    span = max([total] + [
+        float(s.get("t_off_ms", 0.0)) + float(s.get("ms") or 0.0)
+        for s in stages
+    ]) or 1.0
+    head = (f"trace {wf.get('trace_id', '?')}  entry={wf.get('entry')}  "
+            f"status={wf.get('status')}  total={total:.3f} ms")
+    attrs = wf.get("attrs") or {}
+    if attrs:
+        head += "\n  " + "  ".join(f"{k}={v}" for k, v in attrs.items())
+    lines = [head]
+    for s in stages:
+        name = str(s.get("stage"))
+        who = "".join(
+            f" {k}={s[k]}" for k in ("worker", "shard", "bucket",
+                                     "batch_seq", "attempt", "kind")
+            if k in s)
+        ms = s.get("ms")
+        off = float(s.get("t_off_ms", 0.0))
+        if ms is None:
+            bar = "?"
+        else:
+            start = int(round(off / span * width))
+            length = max(1, int(round(float(ms) / span * width)))
+            bar = " " * min(start, width - 1) + "#" * min(
+                length, width - min(start, width - 1))
+        mark = _STATUS_MARK.get(str(s.get("status", "ok")), "")
+        ms_txt = f"{float(ms):9.3f}" if ms is not None else "        ?"
+        lines.append(f"  {name:<14}{ms_txt} ms |{bar:<{width}}|"
+                     f"{who}{mark}")
+    if wf.get("dropped_stages"):
+        lines.append(f"  ... {wf['dropped_stages']} stage(s) dropped "
+                     "(per-trace cap)")
+    return "\n".join(lines)
+
+
+def render_stage_table(stats: Dict[str, dict]) -> str:
+    lines = [f"{'stage':<14}{'count':>7}{'p50 ms':>10}{'p99 ms':>10}"
+             f"{'hedge_wins':>12}{'failed':>8}{'retries':>9}"]
+    for name, d in stats.items():
+        p50 = "-" if d["p50_ms"] is None else f"{d['p50_ms']:.3f}"
+        p99 = "-" if d["p99_ms"] is None else f"{d['p99_ms']:.3f}"
+        lines.append(f"{name:<14}{d['count']:>7}{p50:>10}{p99:>10}"
+                     f"{d['hedge_wins']:>12}{d['failed']:>8}"
+                     f"{d['retries']:>9}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+
+
+def cmd_waterfall(args) -> int:
+    from raft_tpu.obs.trace import stage_stats
+
+    wfs: List[dict] = []
+    for path in args.files:
+        wfs.extend(waterfalls_from_events(load_events(path)))
+    if args.trace:
+        wfs = [w for w in wfs if w.get("trace_id") == args.trace]
+    if not wfs:
+        print("no waterfall events found", file=sys.stderr)
+        return 1
+    if not args.summary:
+        for wf in wfs[-args.limit:]:
+            print(render_waterfall(wf))
+            print()
+    print(f"{len(wfs)} waterfall(s); per-stage attribution:")
+    print(render_stage_table(stage_stats(wfs)))
+    return 0
+
+
+def cmd_federate(args) -> int:
+    from raft_tpu.obs import federation
+
+    parts: Dict[str, dict] = {}
+    for path in args.files:
+        label = os.path.splitext(os.path.basename(path))[0]
+        if label.endswith(".obs"):
+            label = label[:-4]
+        parts[label] = load_metrics(path)
+    fed = federation.federated_snapshot(parts)
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(fed, fp, indent=1, default=str)
+            fp.write("\n")
+        print(f"wrote {args.json}", file=sys.stderr)
+    sys.stdout.write(federation.render_prometheus(fed["metrics"]))
+    return 0
+
+
+def _event_trace_id(evt: dict) -> Optional[str]:
+    kind = evt.get("kind")
+    if kind == "waterfall":
+        return evt.get("trace_id")
+    if kind == "span":
+        tree = evt.get("tree") or {}
+        return (tree.get("attrs") or {}).get("trace_id")
+    # breadcrumbs/errors that chose to carry one
+    tid = evt.get("trace_id")
+    return tid if isinstance(tid, str) else None
+
+
+def cmd_stitch(args) -> int:
+    by_trace: Dict[str, List[dict]] = {}
+    for path in args.files:
+        for evt in load_events(path):
+            tid = _event_trace_id(evt)
+            if tid is not None:
+                by_trace.setdefault(tid, []).append(evt)
+    if args.trace:
+        by_trace = {k: v for k, v in by_trace.items() if k == args.trace}
+    if not by_trace:
+        print("no trace-stamped events found", file=sys.stderr)
+        return 1
+    for tid in sorted(by_trace):
+        evts = sorted(by_trace[tid], key=lambda e: e.get("t", 0.0))
+        sources = sorted({e["_source"] for e in evts})
+        print(f"trace {tid}: {len(evts)} event(s) across "
+              f"{len(sources)} dump(s) {sources}")
+        for e in evts:
+            kind = e.get("kind")
+            if kind == "span":
+                tree = e.get("tree") or {}
+                detail = f"{tree.get('name')} {tree.get('ms', '?')} ms"
+            elif kind == "waterfall":
+                detail = (f"{e.get('entry')} status={e.get('status')} "
+                          f"{len(e.get('stages', []))} stages "
+                          f"{e.get('ms', '?')} ms")
+            else:
+                detail = e.get("event") or e.get("error_kind") or ""
+            print(f"  [{e['_source']}] {kind}: {detail}")
+        print()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_report", description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    wp = sub.add_parser("waterfall",
+                        help="render waterfalls from flight dumps")
+    wp.add_argument("files", nargs="+")
+    wp.add_argument("--trace", default=None, help="filter to one trace id")
+    wp.add_argument("--limit", type=int, default=16,
+                    help="render at most the newest N (table uses all)")
+    wp.add_argument("--summary", action="store_true",
+                    help="per-stage table only, no timelines")
+    wp.set_defaults(fn=cmd_waterfall)
+
+    fp = sub.add_parser("federate",
+                        help="merge snapshots into one exposition")
+    fp.add_argument("files", nargs="+")
+    fp.add_argument("--json", default=None,
+                    help="also write the merged JSON snapshot here")
+    fp.set_defaults(fn=cmd_federate)
+
+    st = sub.add_parser("stitch",
+                        help="group events across dumps by trace id")
+    st.add_argument("files", nargs="+")
+    st.add_argument("--trace", default=None)
+    st.set_defaults(fn=cmd_stitch)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,20 @@ OPTIONAL_STAGES = [
       "--duration-s", "45", "--k", "1,10,100",
       "--out", "FABRIC_r06.json",
       "--obs-snapshot", "FABRIC_r06.obs.json"], 900),
+    # graft-trace acceptance (ISSUE 13): chaos fabric loadgen with the
+    # tracing A/B (off-vs-on QPS recorded in FABRIC_r13.json), per-stage
+    # waterfall columns, and the federated fleet snapshot archived under
+    # OBS_r13/ (JSON + Prometheus text; flight dumps land there too when
+    # the battery runs --obs-snapshot)
+    ("fabric_trace",
+     [PY, "scripts/serve_loadgen.py", "--fabric", "--n", "120000",
+      "--dim", "96", "--fabric-workers", "4",
+      "--fabric-replication", "2", "--concurrency", "8",
+      "--duration-s", "45", "--k", "1,10,100",
+      "--fault", "dead@proc:2,slow@proc:1*3", "--swap-mid-run",
+      "--ab-obs", "--out", "FABRIC_r13.json",
+      "--federate-out", "OBS_r13/FEDERATED_r13.json",
+      "--obs-snapshot", "FABRIC_r13.obs.json"], 1200),
     # tiered-memory acceptance (ISSUE 12, ROADMAP item 3): host/mmap
     # originals + shortlist-only fetch vs the full-upload baseline,
     # then a Zipf(1.0) serve run whose hot-row hit-rate / zero-retrace

@@ -20,10 +20,20 @@ admission rejects, swap counts — docs/serving.md §7) next to it.
 ``--fabric`` stands up a :class:`raft_tpu.serve.Fabric` (N worker
 processes owning index shards, docs/serving.md §10) instead of the
 single-process Server and drives ``fab.search`` directly, emitting a
-``FABRIC_r06.json`` sidecar (QPS, latency percentiles, per-row
-coverage, hedge/retry/dropout counters, worker health). ``--fault``
-installs a process-level fault spec (e.g. ``slow@proc:1*50``) in the
-workers so degraded-mode numbers are measurable on demand.
+``FABRIC_r13.json`` sidecar (QPS, latency percentiles, per-row
+coverage, hedge/retry/dropout counters, worker health — plus the
+graft-trace columns, ISSUE 13: per-stage p50/p99 waterfall attribution
+for queue_wait / rpc / worker_scan / merge / rerank, hedge-win counts
+per stage, and the complete-waterfall fraction). ``--fault`` installs
+a process-level fault spec (e.g. ``slow@proc:1*50``) in the workers so
+degraded-mode numbers are measurable on demand. ``--ab-obs`` measures
+the tracing-overhead A/B the acceptance bar (<5% on-mode overhead)
+reads from the artifact: three swap-free probe legs (off / on / off,
+fresh fabrics, half duration each — the off bracket cancels machine
+drift) before the main instrumented run. ``--federate-out``
+scrapes every worker's metrics registry through the
+``collect_metrics`` RPC at the end of the run and archives the merged
+fleet snapshot (JSON + Prometheus text).
 
 Wired as the optional ``serve_loadgen`` / ``fabric_loadgen`` stages of
 ``scripts/r5_measure_all.py`` (pass ``--serve`` there, or select with
@@ -133,9 +143,17 @@ def main() -> int:
     ap.add_argument("--fault", default=None,
                     help="RAFT_TPU_FAULTS-grammar spec installed in the "
                          "fabric workers (e.g. 'slow@proc:1*50')")
+    ap.add_argument("--ab-obs", action="store_true",
+                    help="fabric only: run an uninstrumented "
+                         "(RAFT_TPU_OBS=off) leg first and record the "
+                         "off/on QPS pair as the tracing-overhead A/B")
+    ap.add_argument("--federate-out", default=None,
+                    help="fabric only: archive the end-of-run federated "
+                         "fleet metrics snapshot here (JSON; a .prom "
+                         "Prometheus exposition lands next to it)")
     ap.add_argument("--out", default=None,
                     help="report path (default SERVE_r05.json, or "
-                         "FABRIC_r06.json with --fabric)")
+                         "FABRIC_r13.json with --fabric)")
     ap.add_argument("--obs-snapshot", default=None,
                     help="also write the graft-scope metrics snapshot here")
     ap.add_argument("--seed", type=int, default=0)
@@ -157,12 +175,18 @@ def main() -> int:
         # forcing "on" here would silently downgrade that post-mortem
         obs.set_mode("on")
 
+    if args.fabric and obs.mode() == "off" \
+            and not os.environ.get("RAFT_TPU_OBS"):
+        # the waterfall stage columns need graft-trace recording; same
+        # env-wins contract as --obs-snapshot (r5 children run flight)
+        obs.set_mode("on")
+
     ks = sorted({max(1, int(s)) for s in args.k.split(",") if s.strip()})
     rng = np.random.default_rng(args.seed)
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
 
     if args.out is None:
-        args.out = "FABRIC_r06.json" if args.fabric else "SERVE_r05.json"
+        args.out = "FABRIC_r13.json" if args.fabric else "SERVE_r05.json"
     if args.fabric:
         return _run_fabric(args, ks, dataset, rng, obs, serve)
 
@@ -359,23 +383,11 @@ def main() -> int:
     return 0
 
 
-def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
-    """The --fabric leg: closed-loop/paced load against a
-    :class:`raft_tpu.serve.Fabric`, FABRIC_r06.json sidecar out."""
-    params = serve.FabricParams(
-        n_workers=args.fabric_workers,
-        replication=args.fabric_replication,
-        worker_algo=args.fabric_algo,
-    )
-    t_build = time.perf_counter()
-    fab = serve.Fabric(dataset, params=params, group=args.fabric_group,
-                       fault_spec=args.fault)
-    build_s = time.perf_counter() - t_build
-    print(f"fabric up: {args.fabric_workers} workers x "
-          f"{args.fabric_replication} replicas, {args.fabric_algo} "
-          f"n={args.n} d={args.dim} (spawn+load {build_s:.1f}s)",
-          flush=True)
-
+def _drive_fabric(fab, args, ks, duration_s, seed_base, serve,
+                  swap_mid_run=False, dataset=None):
+    """One closed-loop/paced measurement leg against ``fab``; returns
+    the raw counters/latencies so a leg can run twice (the --ab-obs
+    off/on pair) without duplicating the loop."""
     stop = threading.Event()
     lock = threading.Lock()
     lat_ms: list = []
@@ -386,7 +398,7 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
     interval = (args.concurrency / args.qps) if args.qps > 0 else 0.0
 
     def worker(wid: int):
-        wrng = np.random.default_rng(args.seed + 1000 + wid)
+        wrng = np.random.default_rng(seed_base + wid)
         next_t = time.monotonic()
         while not stop.is_set():
             if interval:
@@ -423,16 +435,16 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
     for t in threads:
         t.start()
     swap_generation = None
-    if args.swap_mid_run:
-        time.sleep(args.duration_s / 2)
+    if swap_mid_run:
+        time.sleep(duration_s / 2)
         print("mid-run cluster hot swap...", flush=True)
         try:
             swap_generation = fab.swap(dataset)
         except serve.FabricSwapError as e:
             print(f"swap rolled back: {e}", flush=True)
             swap_generation = "aborted"
-    deadline = t_run + (max(args.duration_s, 60.0) if args.requests
-                        else args.duration_s)
+    deadline = t_run + (max(duration_s, 60.0) if args.requests
+                        else duration_s)
     while not stop.is_set():
         if time.perf_counter() >= deadline:
             break
@@ -440,7 +452,136 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
     stop.set()
     for t in threads:
         t.join(timeout=60)
-    wall_s = time.perf_counter() - t_run
+    return {
+        "counts": counts, "lat_ms": lat_ms, "per_k": per_k,
+        "cov_sum": cov_sum[0], "cov_min": cov_min[0],
+        "wall_s": time.perf_counter() - t_run,
+        "swap_generation": swap_generation,
+    }
+
+
+def _waterfall_columns(obs):
+    """The graft-trace stage-attribution columns (ISSUE 13): per-stage
+    p50/p99 + hedge wins over the run's completed waterfalls, and the
+    complete-waterfall fraction — the SAME
+    ``obs.trace.waterfall_complete`` predicate the chaos acceptance
+    test asserts, so the artifact and the test cannot diverge. The
+    ring-eviction count rides along: a run faster than the bounded
+    ring's window must say so instead of presenting the tail as the
+    whole run."""
+    from raft_tpu.obs.trace import (ring_stats, stage_stats,
+                                    waterfall_complete)
+
+    wfs = [w for w in obs.trace_report()
+           if w.get("entry") == "fabric.search"]
+    answered = [w for w in wfs if w.get("status") in ("ok", "degraded")]
+    complete = sum(1 for w in answered if waterfall_complete(w))
+    ring = ring_stats()
+    return {
+        "waterfalls": len(wfs),
+        "answered": len(answered),
+        "complete": complete,
+        "complete_fraction": (round(complete / len(answered), 5)
+                              if answered else None),
+        "ring_evicted": ring["evicted"],
+        "window": ("ring_tail" if ring["evicted"] else "full_run"),
+        "stages": stage_stats(wfs),
+    }
+
+
+def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
+    """The --fabric leg: closed-loop/paced load against a
+    :class:`raft_tpu.serve.Fabric`, FABRIC_r13.json sidecar out."""
+    params = serve.FabricParams(
+        n_workers=args.fabric_workers,
+        replication=args.fabric_replication,
+        worker_algo=args.fabric_algo,
+    )
+    obs_ab = None
+    if args.ab_obs:
+        # the instrumentation-overhead A/B (the <5% acceptance bar):
+        # three swap-free, FAULT-FREE probe legs on fresh fabrics —
+        # off, on, off — each duration_s/2. Bracketing the instrumented
+        # leg between two uninstrumented ones cancels linear machine
+        # drift, and the probes deliberately skip --fault: injected
+        # deaths and hedge storms add per-leg randomness far above the
+        # few-percent effect being measured (single chaos off/on pairs
+        # measured anywhere from -15% to +13% run-to-run on this shared
+        # CPU host, r13). Workers inherit each leg's mode at spawn, so
+        # the whole path (router stages + worker spans + RPC trace
+        # field) flips with the leg. The swap/chaos columns come from
+        # the MAIN run below, which is not part of the A/B.
+        on_mode = obs.mode() if obs.mode() != "off" else "on"
+
+        def _ab_leg(idx: int, mode: str) -> float:
+            obs.set_mode(mode)
+            fab = serve.Fabric(dataset, params=params,
+                               group=args.fabric_group)
+            leg = _drive_fabric(fab, args, ks, args.duration_s / 2,
+                                args.seed + 5000 + 100 * idx, serve)
+            fab.close()
+            qps = leg["counts"]["completed"] / max(leg["wall_s"], 1e-9)
+            print(f"A/B leg {idx} ({mode}): {qps:.1f} QPS", flush=True)
+            return qps
+
+        off1 = _ab_leg(1, "off")
+        on1 = _ab_leg(2, on_mode)
+        off2 = _ab_leg(3, "off")
+        obs.set_mode(on_mode)
+        qps_off = (off1 + off2) / 2
+        obs_ab = {
+            "mode_off_qps": round(qps_off, 1),
+            "off_leg_qps": [round(off1, 1), round(off2, 1)],
+            "mode_on": on_mode,
+            "mode_on_qps": round(on1, 1),
+            "overhead_fraction": (round(1.0 - on1 / qps_off, 4)
+                                  if qps_off else None),
+        }
+        print(f"A/B: off {qps_off:.1f} (bracket {off1:.1f}/{off2:.1f}) "
+              f"vs {on_mode} {on1:.1f} QPS, overhead "
+              f"{obs_ab['overhead_fraction']}", flush=True)
+
+    t_build = time.perf_counter()
+    fab = serve.Fabric(dataset, params=params, group=args.fabric_group,
+                       fault_spec=args.fault)
+    build_s = time.perf_counter() - t_build
+    print(f"fabric up: {args.fabric_workers} workers x "
+          f"{args.fabric_replication} replicas, {args.fabric_algo} "
+          f"n={args.n} d={args.dim} (spawn+load {build_s:.1f}s)",
+          flush=True)
+    # FULL obs reset (metrics + spans + flight + trace): the A/B probe
+    # legs and the fabric build otherwise leave their counters and
+    # histograms in the router registry, and the --obs-snapshot /
+    # --federate-out artifacts would report ~1.5x the main run's
+    # traffic — the columns must describe the run they ship with
+    if obs.enabled():
+        obs.reset()
+
+    leg = _drive_fabric(fab, args, ks, args.duration_s, args.seed + 1000,
+                        serve, swap_mid_run=args.swap_mid_run,
+                        dataset=dataset)
+    counts, lat_ms, per_k = leg["counts"], leg["lat_ms"], leg["per_k"]
+    wall_s, swap_generation = leg["wall_s"], leg["swap_generation"]
+    cov_sum = [leg["cov_sum"]]
+    cov_min = [leg["cov_min"]]
+
+    waterfall = _waterfall_columns(obs) if obs.enabled() else None
+    federated = None
+    if args.federate_out:
+        fed = fab.collect_metrics()
+        fed_path = os.path.join(ROOT, args.federate_out)
+        os.makedirs(os.path.dirname(fed_path) or ".", exist_ok=True)
+        with open(fed_path, "w") as f:
+            json.dump(fed, f, indent=1, default=str)
+            f.write("\n")
+        prom_path = os.path.splitext(fed_path)[0] + ".prom"
+        with open(prom_path, "w") as f:
+            f.write(obs.federation.render_prometheus(fed["metrics"]))
+        federated = {"json": args.federate_out,
+                     "prom": os.path.relpath(prom_path, ROOT),
+                     "workers": fed["workers"],
+                     "worker_health": fed.get("worker_health")}
+        print(f"wrote federated snapshot {args.federate_out}", flush=True)
 
     stats = fab.stats()
     fab.close()
@@ -468,6 +609,9 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
         "hedges": stats["counters"].get("hedges", 0),
         "retries": stats["counters"].get("retries", 0),
         "dropouts": stats["counters"].get("dropouts", 0),
+        "waterfall": waterfall,
+        "obs_ab": obs_ab,
+        "federated": federated,
         "fabric": stats,
     }
     with open(os.path.join(ROOT, args.out), "w") as f:
@@ -480,6 +624,9 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
     print(json.dumps({**{k: report[k] for k in
                          ("throughput_qps", "completed", "coverage",
                           "hedges", "dropouts", "latency_ms")},
+                      "waterfall_complete_fraction":
+                          (waterfall or {}).get("complete_fraction"),
+                      "obs_ab": obs_ab,
                       "artifact": args.out, "date": report["date"]}),
           flush=True)
     print(f"wrote {args.out} (measured {report['date']})", flush=True)

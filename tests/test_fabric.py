@@ -243,6 +243,229 @@ def test_fabric_dropped_rpc_does_not_leak_pending():
 
 
 # ---------------------------------------------------------------------------
+# graft-trace: waterfall assembly under faults (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _obs_on():
+    from raft_tpu import obs
+
+    obs.set_mode("on")
+    obs.reset()
+    yield obs
+    obs.reset()
+    obs.set_mode(None)
+
+
+def test_fabric_trace_complete_waterfall_full_coverage(_obs_on):
+    obs = _obs_on
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        fab.search(q, 5)                      # warm (compile noise)
+        obs.trace.reset()
+        fab.search(q, 5)
+        (wf,) = obs.trace_report()
+        assert wf["entry"] == "fabric.search" and wf["status"] == "ok"
+        assert wf["attrs"]["coverage_min"] == 1.0
+        assert wf["attrs"]["covered_shards"] == [0, 1, 2]
+        # one ok rpc + one device-complete worker_scan per shard, then
+        # the merge closes the waterfall
+        for s in range(3):
+            assert any(st["stage"] == "rpc" and st["shard"] == s
+                       and st["status"] == "ok"
+                       for st in wf["stages"])
+            assert any(st["stage"] == "worker_scan" and st["shard"] == s
+                       and st["device_complete"]
+                       for st in wf["stages"])
+        assert wf["stages"][-1]["stage"] == "merge"
+        # every stage is time-positioned inside the trace
+        assert all("t_off_ms" in st for st in wf["stages"]
+                   if st.get("ms") is not None)
+
+
+def test_fabric_trace_partial_waterfall_carries_failure(_obs_on):
+    """dead@proc mid-query (no replica): the waterfall completes as
+    DEGRADED, carrying the failed rpc attempt for the lost shard while
+    the surviving shards' spans are intact — the partial-visibility
+    contract."""
+    obs = _obs_on
+    ds, q = _data()
+    p = _params(replication=1, rpc_deadline_s=0.5)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        obs.trace.reset()
+        with faultinject.inject("dead@proc:1"):
+            d, i, cov = fab.search(q, 5)
+        np.testing.assert_allclose(cov, 2 / 3)
+        (wf,) = obs.trace_report()
+        assert wf["status"] == "degraded"
+        assert wf["attrs"]["covered_shards"] == [0, 2]
+        fails = [st for st in wf["stages"]
+                 if st.get("shard") == 1 and st["stage"] == "rpc"]
+        assert fails and all(
+            st["status"] in ("failed", "timeout") for st in fails)
+        assert any(st.get("kind") == "dead_backend" for st in fails)
+        # the survivors' scans still ride the same waterfall
+        assert {st["shard"] for st in wf["stages"]
+                if st["stage"] == "worker_scan"} == {0, 2}
+
+
+def test_fabric_trace_failover_replica_spans_in_waterfall(_obs_on):
+    """dead@proc with a replica: the dead primary's failed attempt AND
+    the failover replica's rpc + worker_scan land in ONE waterfall, and
+    the answer stays fully covered."""
+    obs = _obs_on
+    ds, q = _data()
+    p = _params(rpc_deadline_s=2.0)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        obs.trace.reset()
+        with faultinject.inject("dead@proc:0"):
+            d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        (wf,) = obs.trace_report()
+        assert wf["status"] == "ok"
+        # shard 0's primary owner is worker 0 (died); its replica
+        # (worker 1) answered — both attempts visible
+        s0 = [st for st in wf["stages"] if st.get("shard") == 0]
+        assert any(st["stage"] == "rpc" and st["worker"] == 0
+                   and st["status"] in ("failed", "timeout")
+                   for st in s0)
+        assert any(st["stage"] == "rpc" and st["worker"] == 1
+                   and st["status"] == "ok" for st in s0)
+        assert any(st["stage"] == "worker_scan" and st["worker"] == 1
+                   for st in s0)
+
+
+def test_fabric_trace_hedged_race_records_both_attempts(_obs_on):
+    """A hedged race records BOTH attempts as sibling rpc stages with
+    the winner marked hedge_win and the loser hedge_loser."""
+    obs = _obs_on
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        fab.search(q, 5)
+        obs.trace.reset()
+        with faultinject.inject("slow@proc:0*1"):
+            d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        (wf,) = obs.trace_report()
+        s0 = [st for st in wf["stages"]
+              if st.get("shard") == 0 and st["stage"] == "rpc"]
+        assert {st["status"] for st in s0} == {"hedge_win",
+                                               "hedge_loser"}
+        win = next(st for st in s0 if st["status"] == "hedge_win")
+        lose = next(st for st in s0 if st["status"] == "hedge_loser")
+        assert win["worker"] == 1 and lose["worker"] == 0
+        # the hedge fired AFTER the primary (time-positioned later)
+        assert win["t_off_ms"] > lose["t_off_ms"]
+
+
+def test_fabric_trace_raised_query_finishes_failed(_obs_on):
+    """A coverage shortfall that RAISES to the caller must complete its
+    waterfall as failed, not degraded/ok — the answered/complete
+    columns and the chaos >=99% bar count only queries the caller
+    actually got an answer for."""
+    obs = _obs_on
+    ds, q = _data()
+    p = _params(replication=1, rpc_deadline_s=0.5)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.search(q, 5)
+        obs.trace.reset()
+        with faultinject.inject("dead@proc:1"):
+            with pytest.raises(ShardDropoutError):
+                fab.search(q, 5, partial_ok=False)
+        (wf,) = obs.trace_report()
+        assert wf["status"] == "failed"
+        assert wf["attrs"]["error"] == "ShardDropoutError"
+        assert not obs.trace.waterfall_complete(wf)
+        # same contract for the coverage floor under partial_ok=True
+        fab.params.coverage_floor = 0.9
+        obs.trace.reset()
+        with faultinject.inject("dead@proc:1"):
+            with pytest.raises(ShardDropoutError):
+                fab.search(q, 5)
+        assert obs.trace_report()[-1]["status"] == "failed"
+
+
+def test_fabric_trace_ambient_context_linked_not_adopted(_obs_on):
+    """An enclosing ambient context must not be adopted as the search's
+    own waterfall id (cross-process ids have no local record; a local
+    one would be stolen from the caller) — the entry mints its own and
+    links the parent."""
+    obs = _obs_on
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        fab.search(q, 5)
+        obs.trace.reset()
+        outer = obs.start_trace("caller.op")
+        with obs.trace.activate(outer):
+            fab.search(q, 5)
+        (wf,) = obs.trace_report()             # the fabric's record
+        assert wf["trace_id"] != outer.trace_id
+        assert wf["attrs"]["parent_trace"] == outer.trace_id
+        # the caller's own record is untouched and still completable
+        done = obs.trace.finish(outer)
+        assert done is not None and done["status"] == "ok"
+
+
+def test_fabric_trace_rpc_payload_carries_context(_obs_on):
+    """The propagation contract GL019 enforces, observed live: the
+    search RPC payload crossing the transport carries the minted
+    (trace_id, parent_span_id) field."""
+    obs = _obs_on
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        seen = []
+        orig = fab.group.call
+
+        def spy(rank, method, payload=None):
+            if method == "search":
+                seen.append(payload.get(obs.trace.WIRE_FIELD))
+            return orig(rank, method, payload)
+
+        fab.group.call = spy
+        fab.search(q, 5)
+        assert seen and all(
+            w and set(w) == {"trace_id", "parent_span_id"}
+            for w in seen)
+        tid = {w["trace_id"] for w in seen}
+        assert len(tid) == 1                  # one id names the query
+        assert obs.trace_report()[-1]["trace_id"] == tid.pop()
+
+
+def test_fabric_federation_local_group_shared_registry_not_duplicated(
+        _obs_on):
+    """LocalGroup workers share the ROUTER's registry: they answer the
+    scrape (listed in ``workers``) but hand back NO metrics — the
+    shared series arrive once, under worker="router", instead of
+    (n_workers+1)x-ing every fleet sum."""
+    obs = _obs_on
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        fab.search(q, 5)
+        fed = fab.collect_metrics()
+        assert fed["mode"] == "federated"
+        assert fed["workers"] == ["w0", "w1", "w2"]   # all answered
+        assert fed["shared_registry"] is True
+        assert fed["generation"] == 1
+        assert fed["worker_health"] == {"w0": "closed", "w1": "closed",
+                                        "w2": "closed"}
+        # every series appears exactly ONCE, as the router's
+        labels = {p["labels"]["worker"]
+                  for m in fed["metrics"].values()
+                  for p in m.get("points", ())}
+        assert labels == {"router"}
+        pts = fed["metrics"]["fabric.worker_rpcs_total"]["points"]
+        assert len([p for p in pts
+                    if p["labels"].get("method") == "search"]) == 1
+        # and the whole thing renders as one valid exposition
+        text = fab.export_federated_prometheus()
+        assert "raft_tpu_fabric_worker_rpcs_total_total" not in text
+        assert 'raft_tpu_fabric_worker_rpcs_total{' in text
+
+
+# ---------------------------------------------------------------------------
 # real multiprocessing: SIGKILL kill-and-resume + chaos acceptance
 # ---------------------------------------------------------------------------
 
@@ -300,6 +523,7 @@ def test_fabric_chaos_acceptance_multiprocess():
                 hedge_after_ms=25.0, probe_timeout_s=10.0,
                 swap_deadline_s=60.0)
     obs.set_mode("on")
+    obs.reset()        # earlier tests' waterfalls must not ride along
     fab = serve.Fabric(ds1, params=p, group="proc",
                        fault_spec="dead@proc:2,slow@proc:1*2")
     datasets[1] = ds1
@@ -341,6 +565,17 @@ def test_fabric_chaos_acceptance_multiprocess():
         assert (covF == 1.0).all()
         counters = fab.stats()["counters"]
         health = fab.stats()["health"]
+        # federation over REAL worker processes (each owns its own
+        # registry): every live worker answers and its series arrive
+        # under its own label — the per-worker half LocalGroup's
+        # shared-registry twin cannot exercise
+        fed = fab.collect_metrics()
+        assert fed["workers"] == ["w0", "w1", "w2"]
+        assert "shared_registry" not in fed
+        pts = fed["metrics"]["fabric.worker_rpcs_total"]["points"]
+        per_worker = {p["labels"]["worker"] for p in pts
+                      if p["labels"].get("method") == "search"}
+        assert {"w0", "w1", "w2"} <= per_worker
     finally:
         fab.close()
         obs.set_mode(None)
@@ -371,6 +606,27 @@ def test_fabric_chaos_acceptance_multiprocess():
     assert counters.get("swaps", 0) == 2      # initial load + mid-run
     assert counters.get("swap_aborts", 0) == 0
     assert health == {0: "closed", 1: "closed", 2: "closed"}
+    # --- graft-trace acceptance (ISSUE 13): under the same chaos, the
+    # trace layer assembled a COMPLETE end-to-end waterfall for >=99%
+    # of answered queries: every shard the answer reports covered
+    # contributed a device-complete worker_scan stage from the worker
+    # that actually served it, and a merge stage closed the record
+    from raft_tpu.obs.trace import waterfall_complete
+
+    wfs = [w for w in obs.trace_report()
+           if w["entry"] == "fabric.search"
+           and w["status"] in ("ok", "degraded")]
+    assert len(wfs) >= len(recorded)          # one per answered query
+    complete = sum(1 for w in wfs if waterfall_complete(w))
+    assert complete / len(wfs) >= 0.99, (complete, len(wfs))
+    # hedge attempts recorded as sibling stages with a marked winner
+    all_stages = [s for w in wfs for s in w["stages"]]
+    assert any(s["status"] == "hedge_win" for s in all_stages)
+    # the dead worker's mid-query failures are visible as failed/timeout
+    # rpc attempts inside otherwise-complete waterfalls
+    assert any(s["stage"] == "rpc"
+               and s["status"] in ("failed", "timeout")
+               for s in all_stages)
 
 
 # ---------------------------------------------------------------------------

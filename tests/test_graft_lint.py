@@ -1168,6 +1168,106 @@ def test_gl009_serve_module_function_positive():
 
 
 # ---------------------------------------------------------------------------
+# GL019 untraced-rpc (ISSUE 13; path-scoped: serve/ + comms/ modules)
+# ---------------------------------------------------------------------------
+
+
+def test_gl019_literal_payload_positive():
+    rules = _serve_rules("""
+        def fan(group, q, k):
+            return group.call(0, "search", {"q": q, "k": k})
+    """)
+    assert "GL019" in rules
+
+
+def test_gl019_missing_payload_and_forwarded_method_positive():
+    # no payload at all, and a wrapper forwarding a method NAME — both
+    # still transport call sites that dropped the context
+    rules = _serve_rules("""
+        def probe(group, rank):
+            return group.call(rank, "ping")
+
+        def forward(group, rank, method, payload=None):
+            return group.call(rank, method, payload)
+    """)
+    assert rules.count("GL019") == 2
+
+
+def test_gl019_traced_payload_negative():
+    rules = _serve_rules("""
+        from raft_tpu.obs import trace as obs_trace
+
+        def fan_inline(group, q, ctx):
+            return group.call(0, "search",
+                              obs_trace.traced_payload({"q": q}, ctx))
+
+        def fan_named(group, q, ctx):
+            payload = obs_trace.traced_payload({"q": q}, ctx)
+            return group.call(0, "search", payload)
+
+        def fan_literal_field(group, q, wire):
+            return group.call(0, "search", {"q": q, "trace": wire})
+    """)
+    assert "GL019" not in rules
+
+
+def test_gl019_param_passthrough_still_fires():
+    """A payload forwarded through a function parameter is NOT
+    evidence: the pass-through site must say where the threading
+    happened with a reasoned suppression (fabric._rpc_hedged's shape),
+    so the audit trail stays explicit."""
+    rules = _serve_rules("""
+        def hedged(group, rank, payload):
+            return group.call(rank, "search", payload)
+    """)
+    assert "GL019" in rules
+    rules = _serve_rules("""
+        def hedged(group, rank, payload):
+            # graft-lint: allow-untraced-rpc payload pre-threaded upstream
+            return group.call(rank, "search", payload)
+    """)
+    assert "GL019" not in rules
+
+
+def test_gl019_non_transport_calls_and_other_paths_exempt():
+    # a .call() without the (rank, method) transport shape, and the
+    # same code outside serve//comms/ — neither is a finding
+    rules = _serve_rules("""
+        def other(fn, cb):
+            fn.call(cb)
+            return cb.call()
+    """)
+    assert "GL019" not in rules
+    findings = lint_source(textwrap.dedent("""
+        def fan(group, q):
+            return group.call(0, "search", {"q": q})
+    """), "raft_tpu/matrix/fixture.py")
+    assert "GL019" not in [f.rule for f in findings]
+
+
+def test_gl019_fires_in_comms_modules_too():
+    findings = lint_source(textwrap.dedent("""
+        def resync(group, rank, gen):
+            return group.call(rank, "publish", {"gen": gen})
+    """), "raft_tpu/comms/fixture.py")
+    assert "GL019" in [f.rule for f in findings if not f.suppressed]
+
+
+def test_cli_gl019_acceptance_seed(tmp_path, capsys):
+    """ISSUE 13 acceptance seed: a planted untraced data-plane RPC in a
+    serve/ module exits rc 1 naming GL019."""
+    serve_dir = tmp_path / "serve"
+    serve_dir.mkdir()
+    (serve_dir / "seeded.py").write_text(
+        'def fan(group, q, k):\n'
+        '    return group.call(0, "search", {"q": q, "k": k})\n')
+    rc = cli_main(["--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "GL019" for f in out["findings"]), out
+
+
+# ---------------------------------------------------------------------------
 # graft-race engine: GL010-GL014 (ISSUE 7)
 # ---------------------------------------------------------------------------
 

@@ -21,9 +21,11 @@ import pytest
 import jax.numpy as jnp
 
 from raft_tpu import obs, resilience, tuning
+from raft_tpu.obs import federation as obs_federation
 from raft_tpu.obs import flight as obs_flight
 from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.obs import spans as obs_spans
+from raft_tpu.obs import trace as obs_trace
 from raft_tpu.resilience import faultinject
 
 pytestmark = pytest.mark.obs
@@ -85,6 +87,15 @@ def test_off_path_is_shared_singleton_and_registry_silent():
     obs.event("nope_e")
     with obs.span("quiet") as sp:
         sp.set(a=1).sync(None)
+    # graft-trace off contract (ISSUE 13): no ids minted, payloads hand
+    # back UNCHANGED (identity, not a copy), stages/finishes silent
+    assert obs.start_trace("e") is None
+    p = {"q": 1}
+    assert obs.traced_payload(p) is p
+    obs.trace.stage(None, "rpc", ms=1.0)
+    assert obs.trace.finish(None) is None
+    assert obs.trace.current() is None
+    assert obs.trace_report() == []
     assert obs.snapshot(runtime_gauges=False)["metrics"] == {}
     assert obs.recent() == []
     assert obs.flight_events() == []
@@ -99,11 +110,17 @@ def test_off_path_retains_no_allocations():
     tracemalloc.start()
     try:
         base = tracemalloc.take_snapshot()
+        payload = {"q": 1}
         for _ in range(500):
             obs.counter("x", 1, algo="y")
             obs.observe("h", 1.0, stage="s")
             with obs.span("s", a=1) as sp:
                 sp.set(b=2)
+            # graft-trace joins the off-path contract (ISSUE 13)
+            obs.start_trace("e", k=4)
+            obs.traced_payload(payload)
+            obs.trace.stage(None, "rpc", ms=1.0)
+            obs.trace.finish(None)
         after = tracemalloc.take_snapshot()
     finally:
         tracemalloc.stop()
@@ -113,11 +130,12 @@ def test_off_path_retains_no_allocations():
         if st.traceback and st.traceback[0].filename.startswith(obs_dir)
     )
     # the enabled-check must be the whole story: a real off-path leak
-    # (a Span/point per call surviving into a registry or tree) retains
-    # tens of KB over 1500 calls; the sub-KB tolerance absorbs
-    # tracemalloc's cross-thread/freelist attribution noise under the
-    # full suite
-    assert retained < 1024, f"off path retained {retained} bytes"
+    # (a Span/point/waterfall per call surviving into a registry, tree,
+    # or ring) retains tens of KB over 3500 calls; the 2 KB tolerance
+    # absorbs tracemalloc's cross-thread/freelist attribution noise
+    # under the full suite (the r13 trace calls grew the loop from 3 to
+    # 7 obs touches per iteration, and the noise floor with it)
+    assert retained < 2048, f"off path retained {retained} bytes"
     assert obs.snapshot(runtime_gauges=False)["metrics"] == {}
     assert obs.recent() == []
 
@@ -414,6 +432,247 @@ def test_flight_dump_on_injected_dead_stage_search(tmp_path, monkeypatch):
                for e in lines)
     snap = obs.snapshot(runtime_gauges=False)
     assert _value(snap, "retries", kind="dead_backend") >= 1
+
+
+# ---------------------------------------------------------------------------
+# graft-trace: context, wire format, waterfalls (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_wire_round_trip():
+    obs.set_mode("on")
+    ctx = obs.start_trace("fabric.search", index="default", k=4)
+    assert ctx is not None and ctx.trace_id != ctx.parent_span_id
+    wire = obs.trace.to_wire(ctx)
+    assert wire == {"trace_id": ctx.trace_id,
+                    "parent_span_id": ctx.parent_span_id}
+    back = obs.trace.adopt(wire)
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_span_id == ctx.parent_span_id
+    # malformed wire degrades to untraced, never raises
+    assert obs.trace.adopt(None) is None
+    assert obs.trace.adopt("garbage") is None
+    assert obs.trace.adopt({"trace_id": 7}) is None
+
+
+def test_trace_ids_unique_across_mints():
+    obs.set_mode("on")
+    ids = {obs.start_trace("e").trace_id for _ in range(64)}
+    assert len(ids) == 64
+
+
+def test_traced_payload_injects_wire_field():
+    obs.set_mode("on")
+    ctx = obs.start_trace("e")
+    p = obs.traced_payload({"q": 1}, ctx)
+    assert p["q"] == 1 and p["trace"]["trace_id"] == ctx.trace_id
+    # ambient context used when none passed
+    with obs.trace.activate(ctx):
+        p2 = obs.traced_payload({"k": 2})
+    assert p2["trace"]["trace_id"] == ctx.trace_id
+    # no context anywhere: payload unchanged
+    p3 = {"k": 3}
+    assert obs.traced_payload(p3) is p3
+
+
+def test_trace_activate_is_thread_local_and_restores():
+    obs.set_mode("on")
+    ctx = obs.start_trace("e")
+    seen = []
+
+    def worker():
+        seen.append(obs.trace.current())
+
+    with obs.trace.activate(ctx):
+        assert obs.trace.current() is ctx
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=10)
+        with obs.span("inner"):
+            pass
+    assert obs.trace.current() is None
+    assert seen == [None]            # ambient context never leaks threads
+    # the span opened under the activated context adopted its trace id
+    assert obs.recent()[-1][1]["attrs"]["trace_id"] == ctx.trace_id
+
+
+def test_waterfall_assembly_and_report():
+    obs.set_mode("on")
+    ctx = obs.start_trace("fabric.search", k=4)
+    obs.trace.stage(ctx, "rpc", ms=2.0, worker=0, shard=0)
+    obs.trace.stage(ctx, "rpc", ms=3.0, worker=1, shard=0,
+                    status="hedge_win")
+    obs.trace.stage(ctx, "worker_scan", ms=1.5, worker=1, shard=0,
+                    device_complete=True)
+    obs.trace.stage(ctx, "merge", ms=0.5)
+    wf = obs.trace.finish(ctx, coverage_min=1.0)
+    assert wf["status"] == "ok" and wf["ms"] >= 0
+    assert [s["stage"] for s in wf["stages"]] == [
+        "rpc", "rpc", "worker_scan", "merge"]
+    assert wf["stages"][1]["status"] == "hedge_win"
+    assert wf["attrs"]["coverage_min"] == 1.0
+    # the report finds it, by id and in bulk; late stages are dropped
+    assert obs.trace_report(trace_id=wf["trace_id"]) == [wf]
+    assert obs.trace_report() == [wf]
+    obs.trace.stage(ctx, "rpc", ms=9.0)        # after finish: ignored
+    assert len(wf["stages"]) == 4
+    assert obs.trace.finish(ctx) is None       # double finish: no-op
+
+
+def test_waterfall_stage_cap_records_drops():
+    obs.set_mode("on")
+    ctx = obs.start_trace("e")
+    for i in range(obs_trace.MAX_STAGES + 7):
+        obs.trace.stage(ctx, "rpc", ms=1.0)
+    wf = obs.trace.finish(ctx)
+    assert len(wf["stages"]) == obs_trace.MAX_STAGES
+    assert wf["dropped_stages"] == 7
+
+
+def test_waterfall_flight_record_and_counter(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_OBS_DIR", str(tmp_path))
+    obs.set_mode("flight")
+    ctx = obs.start_trace("fabric.search")
+    obs.trace.stage(ctx, "merge", ms=0.1)
+    obs.trace.finish(ctx)
+    evts = [e for e in obs.flight_events() if e["kind"] == "waterfall"]
+    assert len(evts) == 1 and evts[0]["trace_id"] == ctx.trace_id
+    snap = obs.snapshot(runtime_gauges=False)
+    assert _value(snap, "trace.waterfalls_total", status="ok") == 1.0
+
+
+def test_ring_stats_counts_evictions_honestly():
+    obs.set_mode("on")
+    for _ in range(5):
+        obs.trace.finish(obs.start_trace("e"))
+    s = obs_trace.ring_stats()
+    assert s == {"completed_total": 5, "retained": 5, "evicted": 0}
+    # shrink the window to force eviction (restored after)
+    import collections as _c
+
+    orig = obs_trace._done
+    obs_trace._done = _c.deque(orig, maxlen=3)
+    try:
+        obs.trace.finish(obs.start_trace("e"))
+        s = obs_trace.ring_stats()
+        assert s["completed_total"] == 6 and s["retained"] == 3
+        assert s["evicted"] == 3          # truncation is VISIBLE
+    finally:
+        obs_trace._done = _c.deque(obs_trace._done, maxlen=obs_trace.MAX_DONE)
+    obs.reset()
+    assert obs_trace.ring_stats()["completed_total"] == 0
+
+
+def test_waterfall_complete_predicate():
+    """The ONE completeness definition the chaos acceptance and the
+    loadgen columns share."""
+    base = {"status": "ok",
+            "attrs": {"covered_shards": [0, 1]},
+            "stages": [
+                {"stage": "worker_scan", "shard": 0,
+                 "device_complete": True},
+                {"stage": "worker_scan", "shard": 1,
+                 "device_complete": True},
+                {"stage": "merge"},
+            ]}
+    assert obs_trace.waterfall_complete(base)
+    import copy
+
+    failed = copy.deepcopy(base)
+    failed["status"] = "failed"
+    assert not obs_trace.waterfall_complete(failed)
+    no_merge = copy.deepcopy(base)
+    no_merge["stages"] = no_merge["stages"][:2]
+    assert not obs_trace.waterfall_complete(no_merge)
+    missing_scan = copy.deepcopy(base)
+    missing_scan["stages"][1]["shard"] = 0
+    assert not obs_trace.waterfall_complete(missing_scan)
+    not_device = copy.deepcopy(base)
+    not_device["stages"][0]["device_complete"] = False
+    assert not obs_trace.waterfall_complete(not_device)
+    # a degraded answer with all ITS covered shards scanned is complete
+    degraded = copy.deepcopy(base)
+    degraded["status"] = "degraded"
+    degraded["attrs"]["covered_shards"] = [0]
+    degraded["stages"] = [base["stages"][0], {"stage": "merge"}]
+    assert obs_trace.waterfall_complete(degraded)
+
+
+def test_stage_stats_percentiles_and_hedge_counts():
+    obs.set_mode("on")
+    for i in range(10):
+        ctx = obs.start_trace("e")
+        obs.trace.stage(ctx, "rpc", ms=float(i + 1), worker=0)
+        obs.trace.finish(ctx)
+    ctx = obs.start_trace("e")
+    obs.trace.stage(ctx, "rpc", ms=100.0, status="hedge_win")
+    obs.trace.stage(ctx, "rpc", status="hedge_loser")
+    obs.trace.stage(ctx, "rpc", ms=5.0, status="failed", kind="transient")
+    obs.trace.stage(ctx, "retry", status="retry")
+    obs.trace.finish(ctx)
+    stats = obs_trace.stage_stats(obs.trace_report())
+    rpc = stats["rpc"]
+    assert rpc["count"] == 13
+    assert rpc["hedge_wins"] == 1 and rpc["hedge_losers"] == 1
+    assert rpc["failed"] == 1
+    # percentiles over ok + hedge_win samples only (failed ms excluded)
+    assert rpc["p50_ms"] == 6.0 and rpc["p99_ms"] == 100.0
+    assert stats["retry"]["retries"] == 1
+    assert stats["retry"]["p50_ms"] is None
+
+
+def test_flight_dump_same_second_paths_do_not_collide(tmp_path,
+                                                      monkeypatch):
+    """ISSUE 13 satellite: two dumps from one process in the same
+    wall-clock second used to compute the SAME default path and the
+    second silently overwrote the first — the monotonic per-process
+    sequence suffix keeps every default path distinct."""
+    monkeypatch.setenv("RAFT_TPU_OBS_DIR", str(tmp_path))
+    obs.set_mode("flight")
+    # pin the clock so both paths share the <unix> component for sure
+    monkeypatch.setattr(obs_flight.time, "time", lambda: 1234567890.0)
+    obs.counter("queries_total", 1, algo="a")
+    p1 = obs.flight_dump()
+    obs.counter("queries_total", 1, algo="b")
+    p2 = obs.flight_dump()
+    assert p1 != p2
+    assert os.path.exists(p1) and os.path.exists(p2)
+    # both artifacts intact (the first was NOT overwritten)
+    first = [json.loads(ln) for ln in open(p1)]
+    second = [json.loads(ln) for ln in open(p2)]
+    assert first[-1]["kind"] == "snapshot"
+    assert len(second) > len(first)
+
+
+def test_federation_merge_and_prometheus_render():
+    obs.set_mode("on")
+    obs.counter("queries_total", 4, algo="x")
+    obs.observe("search_latency_ms", 2.0, algo="x")
+    m = obs.snapshot(runtime_gauges=False)["metrics"]
+    fed = obs_federation.federated_snapshot({"w0": m, "w1": m})
+    assert fed["workers"] == ["w0", "w1"]
+    pts = fed["metrics"]["queries_total"]["points"]
+    assert {p["labels"]["worker"] for p in pts} == {"w0", "w1"}
+    assert all(p["labels"]["algo"] == "x" for p in pts)
+    text = obs_federation.render_prometheus(fed["metrics"])
+    _parse_prometheus(text)          # valid exposition format
+    assert 'raft_tpu_queries_total{algo="x",worker="w0"} 4' in text
+    # histogram rendered cumulatively with +Inf == count per worker
+    assert text.count('le="+Inf"') == 2
+
+
+def test_federation_kind_conflict_kept_out_of_exposition():
+    fed = obs_federation.merge_metric_maps({
+        "a": {"m": {"kind": "counter",
+                    "points": [{"labels": {}, "value": 1.0}]}},
+        "b": {"m": {"kind": "gauge",
+                    "points": [{"labels": {}, "value": 2.0}]}},
+    })
+    assert len(fed["m"]["points"]) == 1          # first kind wins
+    assert "_conflicts" in fed
+    text = obs_federation.render_prometheus(fed)
+    assert "conflicts" not in text               # meta never exported
+    _parse_prometheus(text)
 
 
 # ---------------------------------------------------------------------------

@@ -834,6 +834,49 @@ def test_add_on_drain_during_drain_still_fires():
     assert fired == ["late"], fired
 
 
+def test_serve_trace_waterfalls_share_batch_span_link(data):
+    """graft-trace on the single-process path (ISSUE 13): each submit
+    mints a trace at the serving entry; two requests coalesced into ONE
+    batch complete as two waterfalls (queue_wait + batch_search) whose
+    batch stages carry the SAME batch_seq — the span link tying the
+    traces one dispatch served."""
+    from raft_tpu import obs
+
+    dataset, queries = data
+    obs.set_mode("on")
+    try:
+        srv = serve.Server(serve.ServeParams(
+            max_batch_rows=16, max_wait_ms=150.0, max_k=8))
+        srv.create_index("default", dataset, algo="brute_force")
+        obs.trace.reset()                 # drop warmup-era records
+        f1 = srv.submit(queries[:1], 4)
+        f2 = srv.submit(queries[1:2], 4)
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+        wfs = obs.trace_report()
+        assert len(wfs) == 2
+        seqs = set()
+        for wf in wfs:
+            assert wf["entry"] == "serve.submit"
+            assert wf["status"] == "ok"
+            names = [s["stage"] for s in wf["stages"]]
+            assert names == ["queue_wait", "batch_search"]
+            batch = wf["stages"][1]
+            assert batch["bucket"] >= 2 and "linger_ms" in batch
+            seqs.add(batch["batch_seq"])
+        assert len(seqs) == 1             # one batch served both traces
+        # a rejected submit still completes a (tiny) waterfall saying why
+        srv.close()
+        with pytest.raises(serve.Overloaded):
+            srv.submit(queries[:1], 4)
+        tail = obs.trace_report()[-1]
+        assert tail["status"] == "rejected"
+        assert tail["attrs"]["reason"] == "closed"
+    finally:
+        obs.set_mode(None)
+        obs.reset()
+
+
 def test_threadsan_suite_verdict_zzz():
     """Suite-level ISSUE-7 acceptance (runs last in file order): every
     serve test above constructed its locks through the sanitizer, and
