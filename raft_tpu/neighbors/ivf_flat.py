@@ -376,6 +376,51 @@ def extend(index: Index, new_vectors, new_ids=None) -> Index:
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _coarse_margins(queries, centers, metric_val: int, p: int):
+    """Normalized coarse-selection margin per query: the top-1 vs top-p
+    centroid-distance gap in min-close space, scaled into [0, 1].
+
+    This is the same queries x centers GEMM + select the coarse phase
+    of ``_ivf_search`` runs (and ``ivf_pq._pq_search`` mirrors) — the
+    difficulty signal is already paid for there; this standalone entry
+    exposes it to the serving policy (serve/adaptive.py), which must
+    pick the probe rung BEFORE the shape-static search dispatches."""
+    metric = DistanceType(metric_val)
+    q32 = queries.astype(jnp.float32)
+    cdot = dist_dot(q32, centers.T)
+    if metric == DistanceType.InnerProduct:
+        coarse = -cdot                           # min-close space
+    elif metric == DistanceType.CosineExpanded:
+        qn = jnp.linalg.norm(q32, axis=1, keepdims=True)
+        cn = jnp.linalg.norm(centers, axis=1)
+        coarse = 1.0 - cdot / jnp.maximum(qn * cn[None, :], 1e-30)
+    else:
+        qn2 = jnp.sum(q32 * q32, axis=1, keepdims=True)
+        cn2 = jnp.sum(centers * centers, axis=1)
+        coarse = qn2 + cn2[None, :] - 2.0 * cdot
+    vals, _ = select_k(coarse, p, select_min=True)      # ascending
+    d1 = vals[:, 0]
+    dp = vals[:, p - 1]
+    return jnp.clip((dp - d1) / (jnp.abs(d1) + jnp.abs(dp) + 1e-12),
+                    0.0, 1.0)
+
+
+def coarse_margins(index, queries, p: int = 2) -> jax.Array:
+    """Per-query difficulty margin [m] in [0, 1] from the coarse
+    quantizer: ~0 means the best ``p`` centroids are indistinguishable
+    (hard/ambiguous query — probe wide), large means the query sits
+    firmly in one list's basin (easy — few probes recover its
+    neighbors). Shared by ivf_flat and ivf_pq (both coarse phases run
+    the identical queries x centers selection)."""
+    queries = jnp.asarray(queries)
+    C = int(index.centers.shape[0])
+    if C < 2:
+        return jnp.ones((queries.shape[0],), jnp.float32)
+    return _coarse_margins(queries, index.centers, int(index.metric),
+                           int(max(2, min(int(p), C))))
+
+
 def adaptive_query_group(m: int, n_probes: int, n_lists: int,
                          base: int) -> int:
     """Pick the per-list query-group size for a batch.

@@ -2315,6 +2315,16 @@ def search(
         )
 
 
+def coarse_margins(index: Index, queries, p: int = 2) -> jax.Array:
+    """Per-query difficulty margin from the coarse quantizer (see
+    ``ivf_flat.coarse_margins`` — the ivf_pq coarse phase runs the same
+    queries x centers selection, so the signal and the jitted kernel
+    are shared)."""
+    from raft_tpu.neighbors.ivf_flat import coarse_margins as _cm
+
+    return _cm(index, queries, p=p)
+
+
 def _decode_slots(slots, recon_cache, cache_scales, centers_rot,
                   recon_scale):
     """Decode flattened list slots (``list * cap + slot``) [m, c] from the

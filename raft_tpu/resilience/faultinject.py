@@ -15,6 +15,12 @@ Grammar (env ``RAFT_TPU_FAULTS``, comma-separated)::
                            response) at its next data-plane RPC
     slow@proc:1*3          worker 1 stalls its next 3 data-plane RPCs
                            (the late-answer / hedging failure mode)
+    slow@stage:serve.dispatch*4   the named stage's next 4 checks STALL
+                           (sleep SLOW_STAGE_SLEEP_S, default 0.25s;
+                           env RAFT_TPU_FAULTS_SLOW_MS overrides) —
+                           the SLO deadline-pressure failure mode
+                           (docs/serving.md §13): work is late, not
+                           failed
     drop@rpc:search        the next "search" RPC's response is dropped —
                            the router sees only a timeout
 
@@ -59,12 +65,26 @@ ENV_VAR = "RAFT_TPU_FAULTS"
 _KINDS = ("oom", "dead", "transient", "shard", "slow", "drop")
 _SCOPES = ("chunk", "stage", "rank", "proc", "rpc")
 
-# kind/scope compatibility for the process-level grammar: "slow" only
-# makes sense against a worker process, "drop" only against an RPC
-# response, and a process can only die or stall (an OOM inside a worker
-# surfaces as a normal classified exception via dead/oom@stage instead)
+# kind/scope compatibility for the process-level grammar: "slow"
+# stalls a worker process's RPCs or a named stage's checkpoints, "drop"
+# only targets an RPC response, and a process can only die or stall (an
+# OOM inside a worker surfaces as a normal classified exception via
+# dead/oom@stage instead)
 _SCOPE_KINDS = {"proc": ("dead", "slow"), "rpc": ("drop",)}
-_KIND_SCOPES = {"slow": ("proc",), "drop": ("rpc",)}
+_KIND_SCOPES = {"slow": ("proc", "stage"), "drop": ("rpc",)}
+
+# how long one fired slow@stage spec stalls its checkpoint (seconds);
+# RAFT_TPU_FAULTS_SLOW_MS overrides for tests that need a tighter or
+# looser squeeze
+SLOW_STAGE_SLEEP_S = 0.25
+
+
+def _slow_stage_sleep_s() -> float:
+    ms = os.environ.get("RAFT_TPU_FAULTS_SLOW_MS", "").strip()
+    try:
+        return float(ms) / 1e3 if ms else SLOW_STAGE_SLEEP_S
+    except ValueError:
+        return SLOW_STAGE_SLEEP_S
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z]+)@(?P<scope>[a-z]+):(?P<arg>[^*]+?)(?:\*(?P<count>\d+))?$"
@@ -244,13 +264,20 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
                 break
     if fired is None:
         return
-    cls, msg = _EXC[fired.kind]
     from raft_tpu import obs
 
     obs.counter("faults_injected", kind=fired.kind, stage=stage)
     obs.event("fault_injected",
               spec=f"{fired.kind}@{fired.scope}:{fired.arg}",
               stage=stage, chunk=chunk)
+    if fired.kind == "slow":
+        # a stall, not a failure: the checkpoint is late — exactly the
+        # shape deadline-driven serving must shed/downshift around
+        import time
+
+        time.sleep(_slow_stage_sleep_s())
+        return
+    cls, msg = _EXC[fired.kind]
     raise cls(f"{msg} ({fired.kind}@{fired.scope}:{fired.arg} at "
               f"stage={stage!r} chunk={chunk})")
 

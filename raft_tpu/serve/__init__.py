@@ -34,6 +34,7 @@ lives in, and the piece TPU-KNN's peak-FLOP/s numbers quietly assume
   hot-swap barrier over the registry (docs/serving.md §10).
 """
 
+from raft_tpu.serve.adaptive import AdaptivePolicy, probe_ladder
 from raft_tpu.serve.batcher import (
     Batch,
     MicroBatcher,
@@ -59,6 +60,7 @@ from raft_tpu.serve.registry import Generation, Registry
 TRACKED_JITS = (
     ("raft_tpu.neighbors.brute_force", "_search"),
     ("raft_tpu.neighbors.ivf_flat", "_ivf_search"),
+    ("raft_tpu.neighbors.ivf_flat", "_coarse_margins"),
     ("raft_tpu.neighbors.ivf_pq", "_pq_search"),
     ("raft_tpu.neighbors.cagra", "_beam_search"),
     ("raft_tpu.neighbors.cagra", "_beam_search_pallas"),
@@ -102,9 +104,9 @@ def total_trace_count() -> int:
 
 
 __all__ = [
-    "Batch", "Fabric", "FabricParams", "FabricSwapError", "Generation",
-    "MicroBatcher", "MutableState", "Overloaded", "Registry", "Request",
-    "ServeParams", "Server", "TRACKED_JITS", "WorkerHealth",
-    "bucket_ladder", "choose_bucket", "total_trace_count",
-    "trace_cache_sizes",
+    "AdaptivePolicy", "Batch", "Fabric", "FabricParams",
+    "FabricSwapError", "Generation", "MicroBatcher", "MutableState",
+    "Overloaded", "Registry", "Request", "ServeParams", "Server",
+    "TRACKED_JITS", "WorkerHealth", "bucket_ladder", "choose_bucket",
+    "probe_ladder", "total_trace_count", "trace_cache_sizes",
 ]

@@ -1,0 +1,207 @@
+"""SLO-aware adaptive execution: per-query probe rungs + deadline
+budgets (ISSUE 14; docs/serving.md §13).
+
+One global ``n_probes`` burns the whole latency budget on easy queries
+and starves hard ones (JUNO, PAPERS.md). This module is the policy half
+of the fix, consumed by :mod:`raft_tpu.serve.engine`:
+
+* **difficulty estimation** — the coarse scan's centroid-distance
+  margin (``ivf_flat.coarse_margins`` / ``ivf_pq.coarse_margins``): the
+  normalized gap between the best and the p-th coarse centroid. A large
+  margin means the query sits firmly inside one list's basin — few
+  probes recover its neighbors; a vanishing margin means the coarse
+  quantizer cannot tell the candidate lists apart and only exhaustive
+  probing is safe;
+* **the pow2 probe-rung ladder** — ``n_probes`` is only ever served at
+  :func:`probe_ladder` values (powers of two plus the ceiling), so the
+  set of traced shapes stays finite and warmable: the engine's warmup
+  drives every (bucket, k, rung) combination once and steady-state
+  serving never retraces (the GL007 bar, extended to the rung axis);
+* **the recall-floor escape hatch** — a margin below ``floor_margin``
+  maps to the TOP rung (the exhaustive ceiling), which dispatches the
+  exact same program as the non-adaptive path: ambiguous queries are
+  served bitwise-identically to today's exhaustive serving;
+* **deadline budgets** — :func:`service_estimate_ms` reads the
+  per-(bucket, rung) service-time medians that
+  ``scripts/capture_dispatch_tables.py`` captures into the dispatch
+  table (op ``serve_service``), so the batcher's slack test and the
+  engine's shed/downshift decisions run on measured numbers instead of
+  a hardcoded guess.
+
+Thresholds come from ``tuning.budget`` (integer basis points, so they
+ride the same table plumbing as the byte budgets):
+``serve_probe_margin`` (the easy threshold — at or above it the
+minimum feasible rung serves), ``serve_probe_floor`` (the escape
+hatch — below it the exhaustive rung serves), and
+``serve_deadline_headroom_ms`` (slack the batcher reserves on top of
+the service estimate before a deadline request skips linger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+# thresholds in integer BASIS POINTS (1e-4), the unit tuning budgets
+# can carry; defaults validated on the clustered easy/hard mix in
+# tests/test_serve_adaptive.py and the SLO_r14.json capture
+DEFAULT_EASY_MARGIN_BP = 2000      # margin >= 0.20: min feasible rung
+DEFAULT_FLOOR_MARGIN_BP = 200      # margin <  0.02: exhaustive escape
+DEFAULT_HEADROOM_MS = 5            # slack reserve for deadline linger
+
+# the fused Pallas scan caps per-list extraction at 256 candidates; the
+# rung floor must keep rung * min(cap, 256) >= k or the probed pool
+# cannot hold a full top-k (ivf_flat.search raises exactly then)
+_KERNEL_LIST_CAP = 256
+
+ADAPTIVE_ALGOS = ("ivf_flat", "ivf_pq")
+
+
+def probe_ladder(ceiling: int) -> Tuple[int, ...]:  # graft-lint: allow-unspanned-entry pure host math (pow2 ladder shape); the serving spans live on the engine's dispatch path
+    """The pow2 probe-rung ladder under ``ceiling``: powers of two below
+    it plus ``ceiling`` itself as the top rung (mirrors the serve
+    k-ladder — the ceiling need not be a power of two, but must be a
+    rung, because it is the escape hatch's exhaustive target)."""
+    ceiling = max(int(ceiling), 1)
+    out, b = [], 1
+    while b < ceiling:
+        out.append(b)
+        b <<= 1
+    out.append(ceiling)
+    return tuple(out)
+
+
+def margin_thresholds() -> Tuple[float, float]:
+    """(easy, floor) margin thresholds from the tuning budgets (basis
+    points -> fractions). floor is clamped strictly below easy so the
+    interpolation below never divides by zero."""
+    from raft_tpu import tuning
+
+    easy = tuning.budget("serve_probe_margin", DEFAULT_EASY_MARGIN_BP) / 1e4
+    floor = tuning.budget("serve_probe_floor", DEFAULT_FLOOR_MARGIN_BP) / 1e4
+    easy = max(easy, 1e-4)
+    floor = min(max(floor, 0.0), easy * 0.99)
+    return easy, floor
+
+
+def deadline_headroom_ms() -> float:
+    """Slack reserve (ms) the deadline-aware linger keeps on top of the
+    measured service estimate."""
+    from raft_tpu import tuning
+
+    return float(tuning.budget("serve_deadline_headroom_ms",
+                               DEFAULT_HEADROOM_MS))
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptivePolicy:
+    """One generation's margin -> probe-rung mapping.
+
+    ``ladder`` tops at the generation's exhaustive ceiling (the resolved
+    ``n_probes`` — the caller's explicit value, else ``n_lists``: the
+    old ``_default_search_params`` pin, demoted from "the" probe count
+    to the policy's ceiling). ``list_cap`` is the index's padded list
+    capacity — the rung floor keeps ``rung * min(cap, 256) >= k`` so a
+    downshifted query can still fill its top-k.
+    """
+
+    ladder: Tuple[int, ...]
+    list_cap: int
+    easy_margin: float
+    floor_margin: float
+    refine_ratio: int = 1          # the rabitq pipeline's serving rr
+    margin_p: int = 2              # the "top-1 vs top-p" gap's p
+
+    @classmethod
+    def build(cls, ceiling: int, list_cap: int,  # graft-lint: allow-unspanned-entry policy constructor, no device work; engine warmup/dispatch spans cover the serving surface
+              refine_ratio: int = 1) -> "AdaptivePolicy":
+        easy, floor = margin_thresholds()
+        return cls(ladder=probe_ladder(ceiling), list_cap=int(list_cap),
+                   easy_margin=easy, floor_margin=floor,
+                   refine_ratio=int(refine_ratio))
+
+    # -- rung selection ----------------------------------------------------
+
+    def min_idx(self, k: int) -> int:
+        """Smallest ladder index whose probed candidate pool can hold a
+        full top-``k`` (rung * min(cap, 256) >= k)."""
+        cap = min(max(self.list_cap, 1), _KERNEL_LIST_CAP)
+        for i, rung in enumerate(self.ladder):
+            if rung * cap >= int(k):
+                return i
+        return len(self.ladder) - 1
+
+    def choose_idx(self, margin: float, k: int = 1) -> int:
+        """Map a difficulty margin to a ladder index.
+
+        margin >= easy  -> the minimum feasible rung;
+        margin <  floor -> the TOP rung (exhaustive escape hatch,
+        bitwise-identical to the non-adaptive path);
+        in between      -> linear interpolation across the ladder.
+        """
+        top = len(self.ladder) - 1
+        m = float(margin)
+        if not math.isfinite(m) or m < self.floor_margin:
+            idx = top
+        elif m >= self.easy_margin:
+            idx = 0
+        else:
+            frac = ((self.easy_margin - m)
+                    / (self.easy_margin - self.floor_margin))
+            idx = min(top, int(math.ceil(frac * top)))
+        return max(idx, self.min_idx(k))
+
+    def rung(self, idx: int) -> int:
+        return self.ladder[max(0, min(int(idx), len(self.ladder) - 1))]
+
+    def refine_for(self, idx: int) -> int:
+        """Per-rung rabitq refine_ratio (ROADMAP item 2b): the easiest
+        rung halves the over-fetch (its shortlist already comes from the
+        query's own basin), every other rung — including the exhaustive
+        escape — keeps the serving default, so the escape hatch stays
+        bitwise-identical to the non-adaptive pipeline."""
+        if self.refine_ratio <= 1:
+            return self.refine_ratio
+        if int(idx) == 0 and len(self.ladder) > 1:
+            return max(2, self.refine_ratio // 2)
+        return self.refine_ratio
+
+    def refine_ladder(self) -> Tuple[int, ...]:
+        """Distinct refine_ratio values the ladder can dispatch (what
+        warmup must trace)."""
+        return tuple(sorted({self.refine_for(i)
+                             for i in range(len(self.ladder))}))
+
+
+def service_estimate_ms(bucket: int,
+                        rung: Optional[int] = None) -> Optional[float]:
+    """Measured service-time median for a (bucket[, rung]) shape from
+    the dispatch table's ``serve_service`` op (captured by
+    ``scripts/capture_dispatch_tables.py --ops serve_service``), or
+    None when no table entry is near the key — callers fall back to
+    their own live measurements."""
+    from raft_tpu import tuning
+
+    t = tuning.get_table()
+    if t is None:
+        return None
+    key: Dict[str, int] = {"bucket": int(bucket)}
+    if rung is not None:
+        key["rung"] = int(rung)
+    entry = t.lookup_entry("serve_service", key)
+    if entry is None:
+        return None
+    times = entry.get("times_ms") or {}
+    try:
+        return float(min(times.values()))
+    except (TypeError, ValueError):
+        return None
+
+
+__all__ = [
+    "ADAPTIVE_ALGOS", "AdaptivePolicy", "DEFAULT_EASY_MARGIN_BP",
+    "DEFAULT_FLOOR_MARGIN_BP", "DEFAULT_HEADROOM_MS",
+    "deadline_headroom_ms", "margin_thresholds", "probe_ladder",
+    "service_estimate_ms",
+]

@@ -47,15 +47,17 @@ FILL_BUCKETS: Tuple[float, ...] = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
 
 
 class Overloaded(RuntimeError):
-    """Admission rejection. ``reason="queue_full"`` (bounded queue) and
-    ``reason="not_ready"`` (first generation still building/warming)
-    carry ``fault_kind = "transient"`` so
-    :func:`raft_tpu.resilience.classify` files them with the retryable
-    kinds — both are backoff-and-retry signals, not errors in the
-    request. ``reason="closed"`` is the opposite contract: the server
-    can never accept again, so it classifies ``fatal`` and
-    resilience-aware clients fail fast instead of retrying a shutdown
-    forever."""
+    """Admission rejection. ``reason="queue_full"`` (bounded queue),
+    ``reason="not_ready"`` (first generation still building/warming),
+    ``reason="quota"`` (per-index admission quota, docs/serving.md §13),
+    and ``reason="deadline"`` (the request's SLO deadline cannot be met
+    — shed instead of served late) carry ``fault_kind = "transient"``
+    so :func:`raft_tpu.resilience.classify` files them with the
+    retryable kinds — all are backoff-and-retry (or re-budget) signals,
+    not errors in the request. ``reason="closed"`` is the opposite
+    contract: the server can never accept again, so it classifies
+    ``fatal`` and resilience-aware clients fail fast instead of
+    retrying a shutdown forever."""
 
     def __init__(self, msg: str, reason: str = "queue_full"):
         super().__init__(msg)
@@ -121,6 +123,11 @@ class Request:
     prefilter: object             # user filter (batch-grouping key)
     future: Future
     t_enqueue: float = 0.0
+    # SLO deadline as an ABSOLUTE time.monotonic() value (ISSUE 14):
+    # deadline-carrying requests ride the priority lane, skip linger
+    # when their slack drops under the measured service estimate, and
+    # are shed/downshifted at dispatch when they would certainly miss
+    deadline: Optional[float] = None
     # graft-trace context (ISSUE 13): minted at submit, carried by the
     # batch as a span LINK (one batch serves many traces), completed at
     # delivery — None when obs is off
@@ -144,6 +151,10 @@ class Batch:
     # the head request's formation wait — the linger attribution every
     # member trace's batch stage carries
     linger_ms: float = 0.0
+    # the probe rung this batch dispatches at (ISSUE 14): None = the
+    # non-adaptive/exhaustive path; set by the engine's split-by-rung
+    # partition (and by warmup, which forces each ladder rung once)
+    rung: Optional[int] = None
 
     @property
     def k_max(self) -> int:
@@ -178,6 +189,15 @@ class MicroBatcher:
         self.name = name
         self._dispatch = dispatch_fn
         self._q: "collections.deque[Request]" = collections.deque()
+        # the priority lane (ISSUE 14): deadline-carrying requests queue
+        # here and are drained ahead of the normal lane — an SLO-bound
+        # request must not wait behind a backlog of best-effort work
+        self._qp: "collections.deque[Request]" = collections.deque()
+        # per-bucket service-time samples (ms), fed back by the engine
+        # after each dispatch; the deadline-aware linger reads their p95
+        # (falling back to the dispatch table's serve_service medians —
+        # never a hardcoded guess)
+        self._svc: dict = {}
         self._pending_rows = 0
         self._ceiling = self.max_batch_rows
         self._closed = False
@@ -194,9 +214,11 @@ class MicroBatcher:
     # -- admission ---------------------------------------------------------
 
     def submit(self, queries: np.ndarray, k: int,
-               prefilter=None) -> Future:
+               prefilter=None, deadline: Optional[float] = None) -> Future:
         """Enqueue ``queries`` ([rows, dim]) at ``k``; returns the Future
         the dispatcher resolves with ``(distances, ids)`` host arrays.
+        ``deadline`` (absolute ``time.monotonic()``) routes the request
+        through the priority lane with deadline-aware linger.
 
         Raises :class:`Overloaded` (classified transient) when admission
         would push the queue past ``max_queue_rows`` — bounded queues
@@ -205,7 +227,7 @@ class MicroBatcher:
         with obs.span("serve.submit", index=self.name,
                       rows=int(queries.shape[0]), k=int(k)):
             req = Request(queries=queries, k=int(k), prefilter=prefilter,
-                          future=Future())
+                          future=Future(), deadline=deadline)
             # the serving entry mints the trace (ISSUE 13): the id is
             # minted BEFORE admission so a rejection still completes a
             # (tiny) waterfall naming why the query died at the door
@@ -228,7 +250,8 @@ class MicroBatcher:
                     pending = self._pending_rows
                 else:
                     req.t_enqueue = time.monotonic()
-                    self._q.append(req)
+                    (self._qp if req.deadline is not None
+                     else self._q).append(req)
                     self._pending_rows += req.rows
                     depth = self._pending_rows
                     self._cond.notify_all()
@@ -287,6 +310,76 @@ class MicroBatcher:
         with self._lock:
             return self._pending_rows
 
+    # -- service-time feedback (the deadline slack test's estimate) --------
+
+    def note_service_ms(self, bucket: int, ms: float,
+                        rung: Optional[int] = None) -> None:
+        """Record one dispatch's service time for the (bucket, rung)
+        shape (called by the engine after every batch); the
+        deadline-aware linger and the engine's shed/downshift decisions
+        read the p95. Keyed per RUNG on purpose: an exhaustive-rung
+        batch costs a multiple of a floor-rung one, and a pooled
+        estimate would neither shed the former nor spare the latter.
+
+        A shape's FIRST sample is discarded: without warmup it is the
+        XLA compile, a 10-100x outlier that would poison the tail
+        estimate and shed healthy requests until the ring ages it
+        out."""
+        with self._lock:
+            ring = self._svc.get((int(bucket), rung))
+            if ring is None:
+                self._svc[(int(bucket), rung)] = collections.deque(
+                    maxlen=64)
+                return
+            ring.append(float(ms))
+
+    def service_p95_ms(self, bucket: int,
+                       rung: Optional[int] = None) -> float:
+        """The (bucket, rung) shape's measured p95 service time (ms).
+        Falls back: exact-shape samples -> the bucket's samples across
+        all rungs -> the dispatch table's captured ``serve_service``
+        median (scripts/capture_dispatch_tables.py --ops
+        serve_service) -> the deadline headroom budget — never a
+        hardcoded guess."""
+        with self._lock:
+            xs, pooled = self._svc_samples_locked(bucket, rung)
+        return self._p95_from(xs, pooled, bucket, rung)
+
+    def _service_p95_locked(self, bucket: int,
+                            rung: Optional[int] = None) -> float:
+        """:meth:`service_p95_ms` for callers already holding ``_cond``
+        (the dispatcher's linger) — ``_cond`` wraps the SAME lock, and
+        re-acquiring it from the public entry deadlocks the loop."""
+        xs, pooled = self._svc_samples_locked(bucket, rung)
+        return self._p95_from(xs, pooled, bucket, rung)
+
+    def _svc_samples_locked(self, bucket: int, rung: Optional[int]):
+        xs = sorted(self._svc.get((int(bucket), rung), ()))
+        pooled = sorted(
+            v for (b, _r), ring in self._svc.items()
+            if b == int(bucket) for v in ring)
+        return xs, pooled
+
+    @staticmethod
+    def _p95_from(xs, pooled, bucket: int, rung: Optional[int]) -> float:
+        from raft_tpu.serve import adaptive as _adaptive
+
+        if len(xs) >= 8:
+            return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+        # pooled LIVE samples of this index beat the dispatch table's
+        # capture (measured on a fixed toy index, keyed only by
+        # (bucket, rung)) — a much bigger served index would otherwise
+        # be gated by the toy's far smaller medians and admit work
+        # that certainly misses its SLO
+        if len(pooled) >= 8:
+            return pooled[min(len(pooled) - 1, int(0.95 * len(pooled)))]
+        est = _adaptive.service_estimate_ms(bucket, rung)
+        if est is not None:
+            return est
+        if pooled:
+            return pooled[-1]
+        return _adaptive.deadline_headroom_ms()
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -317,34 +410,54 @@ class MicroBatcher:
     def _next_batch(self) -> Optional[Batch]:
         with self._cond:
             while True:
-                while not self._q and not self._closed:
+                while not self._q and not self._qp and not self._closed:
                     self._cond.wait(timeout=0.1)
-                if not self._q:
+                lane = self._qp if self._qp else self._q
+                if not lane:
                     return None                  # closed and drained
                 # linger: let the queue fill toward the ceiling, but
-                # never hold the head request past max_wait_ms
-                head = self._q[0]
+                # never hold the head request past max_wait_ms — and
+                # never past a deadline request's slack: when the head's
+                # remaining budget minus the measured service estimate
+                # (p95 at the ceiling bucket, plus the headroom budget)
+                # is already spent, it skips linger entirely
+                head = lane[0]
                 deadline = head.t_enqueue + self.max_wait_s
-                while (not self._closed and self._q
-                       and self._head_run_rows_locked() < self._ceiling):
+                if head.deadline is not None:
+                    from raft_tpu.serve import adaptive as _adaptive
+
+                    # reserve TWICE the headroom the dispatch gate
+                    # keeps: a request released at exactly the gate's
+                    # margin would be sheddable by the time it drains
+                    est_s = (self._service_p95_locked(self._ceiling)
+                             + 2 * _adaptive.deadline_headroom_ms()) / 1e3
+                    deadline = min(deadline, head.deadline - est_s)
+                while (not self._closed and lane
+                       and self._head_run_rows_locked(lane)
+                       < self._ceiling):
+                    if lane is self._q and self._qp:
+                        break        # a priority request arrived: yield
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=remaining)
-                if not self._q:                  # close raced the linger
+                lane = self._qp if self._qp else self._q
+                if not lane:                     # close raced the linger
                     continue
-                return self._drain_locked()
+                return self._drain_locked(lane)
 
-    def _head_run_rows_locked(self) -> int:
+    def _head_run_rows_locked(self, lane=None) -> int:
         """Rows in the longest filter-homogeneous run at the queue head
         (only those can coalesce into one batch); caller holds
         ``_cond``."""
-        if not self._q:
+        if lane is None:
+            lane = self._qp if self._qp else self._q
+        if not lane:
             return 0
-        key = id(self._q[0].prefilter) if self._q[0].prefilter is not None \
+        key = id(lane[0].prefilter) if lane[0].prefilter is not None \
             else None
         rows = 0
-        for r in self._q:
+        for r in lane:
             rk = id(r.prefilter) if r.prefilter is not None else None
             if rk != key:
                 break
@@ -357,18 +470,20 @@ class MicroBatcher:
                 break
         return rows
 
-    def _drain_locked(self) -> Batch:
-        head = self._q[0]
+    def _drain_locked(self, lane=None) -> Batch:
+        if lane is None:
+            lane = self._qp if self._qp else self._q
+        head = lane[0]
         key = id(head.prefilter) if head.prefilter is not None else None
         cap = max(self._ceiling, head.rows)   # oversized head still goes
         taken: List[Request] = []
         rows = 0
-        while self._q:
-            r = self._q[0]
+        while lane:
+            r = lane[0]
             rk = id(r.prefilter) if r.prefilter is not None else None
             if rk != key or (taken and rows + r.rows > cap):
                 break
-            taken.append(self._q.popleft())
+            taken.append(lane.popleft())
             rows += r.rows
         self._pending_rows -= rows
         obs.gauge("serve.queue_depth", self._pending_rows, index=self.name)

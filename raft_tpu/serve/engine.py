@@ -56,6 +56,7 @@ from raft_tpu.neighbors.common import BitsetFilter, merge_topk
 from raft_tpu.neighbors.refine import refine as _exact_refine
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.resilience import faultinject
+from raft_tpu.serve import adaptive as _adaptive
 from raft_tpu.serve.batcher import (
     Batch,
     MicroBatcher,
@@ -77,6 +78,11 @@ RABITQ_DEFAULT_REFINE_RATIO = 4
 
 # latency histogram edges tuned for ms-scale online serving
 _LAT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000)
+
+# difficulty-margin histogram edges: the policy thresholds live in the
+# low decades (floor ~0.02, easy ~0.20), so the mass needs resolution
+# there (docs/serving.md §13)
+_MARGIN_BUCKETS = (0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 1.0)
 
 
 @dataclasses.dataclass
@@ -107,6 +113,30 @@ class ServeParams:
     # (query bytes, k) x generation x mutation epoch so hot-swap and
     # delete/upsert invalidate correctly. 0 = off.
     result_cache_entries: int = 0
+    # SLO-aware adaptive execution (ISSUE 14, docs/serving.md §13):
+    # per-query difficulty (coarse centroid-distance margin) chooses a
+    # pow2 probe rung for ivf_flat/ivf_pq; the resolved n_probes (the
+    # old exhaustive pin) becomes the ladder's CEILING. Ambiguous
+    # queries escape to the top rung — bitwise-identical to the
+    # non-adaptive path — so correctness-first deployments lose
+    # nothing by leaving this off (the default).
+    adaptive_probes: bool = False
+    # default per-request SLO deadline (ms from submit); per-call
+    # submit(deadline_ms=...) overrides. None = no deadline.
+    deadline_ms: Optional[float] = None
+    # what to do with a request whose slack no longer covers the
+    # measured service estimate: "downshift" drops it one probe rung at
+    # a time (adaptive indexes only; sheds when the floor rung still
+    # misses), "shed" fails it immediately with
+    # Overloaded(reason="deadline") — both counted in
+    # serve.deadline_shed_total{action}
+    deadline_action: str = "downshift"
+    # multi-tenant admission: per-index pending-row quotas atop the
+    # shared max_queue_rows backpressure ({index_name: rows}); and an
+    # optional server-wide pending-row bound across all indexes. Both
+    # reject with Overloaded(reason="quota") (transient).
+    admission_quotas: Optional[Dict[str, int]] = None
+    max_total_queue_rows: Optional[int] = None
 
 
 class _Handle:
@@ -117,21 +147,24 @@ class _Handle:
                  "user_search_params", "build_params",
                  "refine_ratio", "metric", "select_min", "dtype", "dim",
                  "rows", "raw_dataset", "_raw_dev", "_side_cache",
-                 "tiered_source")
+                 "tiered_source", "adaptive")
 
     def __init__(self, algo: str, index, state: MutableState,
                  search_params, build_params, refine_ratio: int,
                  raw_dataset: Optional[np.ndarray],
-                 user_search_params=None, tiered_source=None):
+                 user_search_params=None, tiered_source=None,
+                 adaptive=None):
         self.algo = algo
         self.index = index
         self.state = state
         self.search_params = search_params
         # the params the CALLER supplied (None when defaulted): a swap
         # inherits these, not the resolved ones — the serving defaults
-        # (n_probes = n_lists) must be re-derived against the NEW
-        # index, or a bigger successor silently serves the old index's
-        # probe count
+        # (n_probes = n_lists, now the adaptive ladder's exhaustive
+        # CEILING) must be re-derived against the NEW index, or a
+        # bigger successor silently serves the old index's probe count
+        # — and the whole probe-rung ladder, not just the ceiling,
+        # re-derives with it (ISSUE 14)
         self.user_search_params = user_search_params
         self.build_params = build_params
         self.refine_ratio = int(refine_ratio)
@@ -150,6 +183,11 @@ class _Handle:
         # hot-row cache, so stale rows can never serve after a content
         # change.
         self.tiered_source = tiered_source
+        # SLO-aware adaptive policy (ISSUE 14): per-generation, like
+        # everything shape-bearing — its ladder tops at THIS index's
+        # resolved n_probes ceiling, so a swap re-derives the whole
+        # ladder (not just the ceiling) against the successor index
+        self.adaptive = adaptive
 
     def pipeline_rr(self) -> int:
         """The refine_ratio the multi-stage pipeline dispatches at:
@@ -160,6 +198,31 @@ class _Handle:
         return (self.refine_ratio if self.refine_ratio > 1
                 else RABITQ_DEFAULT_REFINE_RATIO)
 
+    def margins(self, qdev) -> jax.Array:
+        """Per-query difficulty margins from the coarse quantizer (the
+        adaptive policy's input); only called when ``adaptive`` is
+        set. One jitted shape per query bucket — warmup traces it."""
+        mod = ivf_flat if self.algo == "ivf_flat" else ivf_pq
+        return mod.coarse_margins(self.index, qdev,
+                                  p=self.adaptive.margin_p)
+
+    def rung_params(self, rung: Optional[int]):
+        """(search_params, rabitq refine_ratio) for a probe rung.
+
+        ``rung=None`` (the non-adaptive path, and the escape hatch's
+        target when it equals the ceiling) returns the resolved params
+        verbatim. A rung override replaces only ``n_probes`` — the
+        trace key is the VALUE, so the top rung dispatches the exact
+        program the non-adaptive path compiled (bitwise escape
+        hatch)."""
+        if rung is None or self.adaptive is None:
+            return self.search_params, self.pipeline_rr()
+        pol = self.adaptive
+        idx = pol.ladder.index(rung) if rung in pol.ladder \
+            else len(pol.ladder) - 1
+        sp = dataclasses.replace(self.search_params, n_probes=int(rung))
+        return sp, pol.refine_for(idx)
+
     def raw_dev(self):
         """Device-resident raw row store (refine operand) — transferred
         once per generation, not per batch."""
@@ -169,11 +232,17 @@ class _Handle:
 
     # -- the per-algo search adapters -------------------------------------
 
-    def search_main(self, qdev, k: int, filt: BitsetFilter):
+    def search_main(self, qdev, k: int, filt: BitsetFilter,
+                    rung: Optional[int] = None):
+        """Search the main index; ``rung`` (an adaptive probe-ladder
+        value) overrides the resolved ``n_probes`` — and, on the rabitq
+        pipeline, the per-rung refine_ratio. ``rung=None`` is the
+        exhaustive/non-adaptive path, byte-for-byte today's."""
+        sp, rr = self.rung_params(rung)
         if self.algo == "brute_force":
             return brute_force.search(self.index, qdev, k, prefilter=filt)
         if self.algo == "ivf_flat":
-            return ivf_flat.search(self.search_params, self.index, qdev, k,
+            return ivf_flat.search(sp, self.index, qdev, k,
                                    prefilter=filt)
         if self.algo == "ivf_pq":
             kind = getattr(self.index, "cache_kind", "none")
@@ -185,8 +254,8 @@ class _Handle:
                 # rows (hot rows served from the HBM cache). Bitwise
                 # identical to the raw_dev() full-upload paths below.
                 return ivf_pq.search_refined(
-                    self.search_params, self.index, qdev, k,
-                    refine_ratio=self.pipeline_rr(), prefilter=filt,
+                    sp, self.index, qdev, k,
+                    refine_ratio=rr, prefilter=filt,
                     dataset=self.tiered_source)
             if kind == "rabitq" and (
                     self.raw_dataset is not None
@@ -198,16 +267,16 @@ class _Handle:
                 # Rerank source: the generation's raw row store when
                 # serving kept it, else the index's own PQ codes.
                 return ivf_pq.search_refined(
-                    self.search_params, self.index, qdev, k,
-                    refine_ratio=self.pipeline_rr(), prefilter=filt,
+                    sp, self.index, qdev, k,
+                    refine_ratio=rr, prefilter=filt,
                     dataset=self.raw_dev())
             if self.refine_ratio > 1 and self.raw_dataset is not None:
                 kc = min(k * self.refine_ratio, self.rows)
-                d, i = ivf_pq.search(self.search_params, self.index, qdev,
+                d, i = ivf_pq.search(sp, self.index, qdev,
                                      kc, prefilter=filt)
                 return _exact_refine(self.raw_dev(), qdev, i, k,
                                      self.metric)
-            return ivf_pq.search(self.search_params, self.index, qdev, k,
+            return ivf_pq.search(sp, self.index, qdev, k,
                                  prefilter=filt)
         if self.algo == "cagra":
             return cagra.search(self.search_params, self.index, qdev, k,
@@ -413,8 +482,183 @@ class _IndexServing:
         st.lock.acquire()
         return gen, st
 
+    # -- SLO-aware partition: deadline shed/downshift + split-by-rung ------
+
+    def _partition(self, batch: Batch) -> List[Batch]:
+        """Pre-dispatch policy pass (ISSUE 14, docs/serving.md §13):
+
+        1. shed requests whose deadline can no longer be met (counted
+           in ``serve.deadline_shed_total{action="shed"}``, failed with
+           ``Overloaded(reason="deadline")`` — transient: the client's
+           correct move is to re-budget and retry);
+        2. on an adaptive handle, estimate each request's difficulty
+           from the coarse margins and split the batch by chosen probe
+           rung (the split-by-rung analog of the batcher's
+           filter-homogeneous grouping); deadline pressure downshifts
+           a request's rung before shedding it when
+           ``deadline_action="downshift"``.
+
+        Rung decisions happen on a PINNED generation but the pin drops
+        before dispatch; a swap landing in between is safe — dispatch
+        clamps ``n_probes`` to the new index's ``n_lists`` exactly like
+        the non-adaptive path does.
+        """
+        if not batch.requests:
+            return []
+        gen = self.registry.pin(self.name)
+        try:
+            h: _Handle = gen.handle
+            now = time.monotonic()
+            live = self._shed_missed(batch, h, now)
+            if not live:
+                return []
+            if batch.rung is not None or h.adaptive is None:
+                # already rung-partitioned (a later part re-gated while
+                # it queued behind its siblings, or an OOM re-split) /
+                # non-adaptive: shed-only pass
+                if len(live) == len(batch.requests):
+                    return [batch]
+                return [self._sub_batch(batch, live, rung=batch.rung)]
+            return self._split_by_rung(h, batch, live, now)
+        finally:
+            gen.release()
+
+    def _shed_missed(self, batch: Batch, h: "_Handle",
+                     now: float) -> List[Request]:
+        """Drop requests that would certainly miss their SLO: expired
+        deadlines always; predicted misses (slack below the bucket's
+        measured p95 service time) when ``deadline_action="shed"`` or
+        when no adaptive ladder exists to downshift instead. Returns
+        the surviving requests."""
+        est_ms = None
+        head_ms = _adaptive.deadline_headroom_ms()
+        live: List[Request] = []
+        for r in batch.requests:
+            if r.future.done() or r.deadline is None:
+                live.append(r)
+                continue
+            slack_ms = (r.deadline - now) * 1e3
+            if (slack_ms > 0 and batch.rung is None
+                    and h.adaptive is not None):
+                # the rung assignment handles pressure (either mode:
+                # _deadline_adjust downshifts or sheds at the rung the
+                # policy actually chose, not the exhaustive estimate)
+                live.append(r)
+                continue
+            if est_ms is None:
+                est_ms = self.batcher.service_p95_ms(batch.bucket,
+                                                     batch.rung)
+            if slack_ms <= 0 or slack_ms < est_ms + head_ms:
+                self._shed(r, slack_ms)
+            else:
+                live.append(r)
+        return live
+
+    def _shed(self, r: Request, slack_ms: float) -> None:
+        obs.counter("serve.deadline_shed_total", index=self.name,
+                    action="shed")
+        obs_trace.finish(r.trace, status="rejected", reason="deadline",
+                         deadline_slack_ms=round(slack_ms, 3))
+        exc = Overloaded(
+            f"serve[{self.name}]: deadline "
+            f"(slack {slack_ms:.1f} ms cannot cover the measured "
+            "service estimate)", reason="deadline")
+        _rerrors.classify(exc)
+        if not r.future.done():
+            r.future.set_exception(exc)
+
+    def _split_by_rung(self, h: "_Handle", batch: Batch,
+                       live: List[Request], now: float) -> List[Batch]:
+        """Assign each request a probe rung from its coarse margin and
+        regroup the batch rung-homogeneously. The margins run at the
+        batch's already-formed bucket shape (warmed), so the estimate
+        itself adds no retrace."""
+        pol = h.adaptive
+        q = np.concatenate([r.queries for r in live], axis=0)
+        q = pad_rows(np.ascontiguousarray(q, dtype=h.dtype), batch.bucket)
+        # graft-lint: allow-host-sync rung choice regroups the batch on the host — the margins must land here before dispatch
+        margins = np.asarray(h.margins(jax.device_put(q)))
+        kq = h.k_pad(batch.k_max, self.params.max_k)
+        groups: Dict[int, List[Request]] = {}
+        row = 0
+        for r in live:
+            m = float(margins[row:row + r.rows].min())
+            row += r.rows
+            obs.observe("serve.difficulty_margin", m,
+                        buckets=_MARGIN_BUCKETS, index=self.name)
+            idx = pol.choose_idx(m, kq)
+            if r.deadline is not None:
+                idx = self._deadline_adjust(r, pol, idx, kq,
+                                            batch.bucket, now)
+                if idx is None:
+                    continue
+            groups.setdefault(idx, []).append(r)
+        out: List[Batch] = []
+        for idx in sorted(groups):
+            rung = pol.rung(idx)
+            obs.counter("serve.probe_rung", len(groups[idx]),
+                        index=self.name, rung=str(rung))
+            out.append(self._sub_batch(batch, groups[idx], rung=rung))
+        return out
+
+    def _deadline_adjust(self, r: Request, pol, idx: int, kq: int,
+                         bucket: int, now: float) -> Optional[int]:
+        """Fit a deadline request's rung to its slack: with
+        ``deadline_action="downshift"``, drop one rung at a time while
+        the (bucket, rung) service estimate exceeds the remaining
+        budget, shedding when even the floor rung cannot make it; with
+        ``"shed"``, never trade recall — shed as soon as the
+        margin-chosen rung's estimate misses. Returns the adjusted
+        ladder index, or None if the request was shed."""
+        slack_ms = (r.deadline - now) * 1e3
+        budget = slack_ms - _adaptive.deadline_headroom_ms()
+        floor = pol.min_idx(kq)
+        shifted = False
+        if self.params.deadline_action == "downshift":
+            while (idx > floor and
+                   self.batcher.service_p95_ms(bucket, pol.rung(idx))
+                   > budget):
+                idx -= 1
+                shifted = True
+        if (slack_ms <= 0 or
+                self.batcher.service_p95_ms(bucket, pol.rung(idx))
+                > budget):
+            self._shed(r, slack_ms)
+            return None
+        if shifted:
+            obs.counter("serve.deadline_shed_total", index=self.name,
+                        action="downshift")
+        return idx
+
+    def _sub_batch(self, batch: Batch, requests: List[Request],
+                   rung: Optional[int]) -> Batch:
+        rows = sum(r.rows for r in requests)
+        return Batch(
+            requests=requests, rows=rows,
+            bucket=choose_bucket(self.batcher.ladder, rows,
+                                 ceiling=self.batcher.ceiling),
+            prefilter=batch.prefilter, seq=batch.seq,
+            linger_ms=batch.linger_ms, rung=rung,
+        )
+
     def _dispatch(self, batch: Batch) -> None:
-        """Batcher callback: resilience-wrapped dispatch + OOM ladder."""
+        """Batcher callback: deadline shed + adaptive rung partition,
+        then resilience-wrapped dispatch + OOM ladder per part. Each
+        part retries/splits independently — a failure in one rung's
+        sub-batch must not re-dispatch requests another rung already
+        delivered."""
+        for i, part in enumerate(self._partition(batch)):
+            if i:
+                # later parts queued behind their siblings' device time:
+                # re-gate so work whose budget the earlier parts burned
+                # is shed instead of served certainly-late
+                regated = self._partition(part)
+                if not regated:
+                    continue
+                part = regated[0]
+            self._dispatch_part(part)
+
+    def _dispatch_part(self, batch: Batch) -> None:
         try:
             _rerrors.run(
                 self._dispatch_once, batch,
@@ -466,15 +710,11 @@ class _IndexServing:
         for part in (left, right):
             if not part:
                 continue
-            prows = sum(r.rows for r in part)
-            sub = Batch(
-                requests=part, rows=prows,
-                bucket=choose_bucket(self.batcher.ladder, prows,
-                                     ceiling=self.batcher.ceiling),
-                prefilter=batch.prefilter, seq=batch.seq,
-                linger_ms=batch.linger_ms,
-            )
-            self._dispatch(sub)
+            # rung rides along: the halves must re-dispatch at the rung
+            # the policy already chose, not re-partition (the member
+            # futures' policy decisions are final)
+            self._dispatch_part(
+                self._sub_batch(batch, part, rung=batch.rung))
 
     def _dispatch_once(self, batch: Batch) -> None:
         gen, st = self._pin_consistent()
@@ -499,15 +739,21 @@ class _IndexServing:
             t0 = time.perf_counter()
             with obs.span("serve.batch", index=self.name,
                           bucket=batch.bucket, rows=batch.rows,
-                          generation=gen.version) as sp:
+                          rung=batch.rung, generation=gen.version) as sp:
                 # fault point: where a real device failure would surface
                 faultinject.check(stage="serve.dispatch", chunk=batch.seq)
                 d, i = self._run_search(
                     h, batch, main_bits, side_bits, side_idx, side_ids)
                 jax.block_until_ready((d, i))
                 sp.set(k_pad=int(d.shape[1]))
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            # feed the deadline machinery's service estimate (the
+            # batcher's linger slack test and _shed_missed read the
+            # p95, keyed per rung — rungs differ by multiples)
+            self.batcher.note_service_ms(batch.bucket, latency_ms,
+                                         rung=batch.rung)
             self._deliver(batch, gen, h, np.asarray(d), np.asarray(i),
-                          (time.perf_counter() - t0) * 1e3)
+                          latency_ms)
         finally:
             gen.release()
 
@@ -522,7 +768,8 @@ class _IndexServing:
         q = pad_rows(np.ascontiguousarray(q, dtype=h.dtype), batch.bucket)
         qdev = jax.device_put(q)
         kq = h.k_pad(batch.k_max, self.params.max_k)
-        d, i = h.search_main(qdev, kq, BitsetFilter(main_bits))
+        d, i = h.search_main(qdev, kq, BitsetFilter(main_bits),
+                             rung=batch.rung)
         if side_idx is not None:
             k_side = min(kq, side_idx.size)
             sd, sp = brute_force.search(
@@ -546,17 +793,31 @@ class _IndexServing:
         # to the client
         sent = np.inf if h.select_min else -np.inf
         ext = np.where(d == sent, np.asarray(-1, ext.dtype), ext)
+        now = time.monotonic()
         for r in batch.requests:
             rd = d[row:row + r.rows, :r.k]
             ri = ext[row:row + r.rows, :r.k]
             row += r.rows
             r.future.generation = gen.version
+            # remaining SLO budget at delivery: negative = a miss (the
+            # request was served late rather than shed — counted so the
+            # SLO harness can tell the two apart)
+            slack_ms = None
+            if r.deadline is not None:
+                slack_ms = round((r.deadline - now) * 1e3, 3)
+                if slack_ms < 0:
+                    obs.counter("serve.deadline_miss_total",
+                                index=self.name)
             # the shared device work, attributed to every member trace:
             # batch_seq is the span LINK (one batch serves many traces),
-            # linger_ms the batching policy's share of the wait
+            # linger_ms the batching policy's share of the wait; rung /
+            # deadline_slack_ms are the ISSUE-14 waterfall columns
+            # (obs_report.py renders them per stage)
             obs_trace.stage(r.trace, "batch_search", ms=latency_ms,
                             bucket=batch.bucket, batch_seq=batch.seq,
                             linger_ms=round(batch.linger_ms, 3),
+                            rung=batch.rung,
+                            deadline_slack_ms=slack_ms,
                             generation=gen.version)
             if r.future.done():
                 obs_trace.finish(r.trace, status="error",
@@ -582,9 +843,11 @@ class _IndexServing:
     # -- warmup ------------------------------------------------------------
 
     def warmup_handle(self, h: _Handle) -> int:
-        """Trace every (bucket, k-rung) combination through the REAL
-        dispatch core so steady-state serving never compiles. Returns
-        the number of (bucket, k) shapes warmed."""
+        """Trace every (bucket, k-rung[, probe-rung]) combination
+        through the REAL dispatch core so steady-state serving never
+        compiles — the adaptive ladder (ISSUE 14) adds the probe-rung
+        axis, and the margin estimator itself is traced once per
+        bucket. Returns the number of shapes warmed."""
         with obs.span("serve.warmup", index=self.name):
             st = h.state
             with st.lock:
@@ -593,62 +856,81 @@ class _IndexServing:
             side_idx, side_ids = h.side_index()
             warmed = 0
             oom = False
+            # rung=None is today's exhaustive program, and the ladder's
+            # TOP rung dispatches the identical trace (same n_probes
+            # value -> same program, the bitwise escape hatch) — skip
+            # it outright so warmup pays for each distinct program
+            # once, not the most expensive one twice per (bucket, k)
+            rungs: List[Optional[int]] = [None]
+            if h.adaptive is not None:
+                rungs += list(h.adaptive.ladder[:-1])
             for bucket in self.batcher.ladder:
                 if oom:
                     break
                 q = np.zeros((bucket, h.dim), h.dtype)
+                if h.adaptive is not None:
+                    # the difficulty estimator's own trace (per bucket)
+                    jax.block_until_ready(
+                        h.margins(jax.device_put(q)))
                 for kq in h.k_ladder(self.params.max_k):
-                    fake = Batch(requests=[], rows=bucket, bucket=bucket,
-                                 prefilter=None)
-                    fake.requests = [_warm_request(q, kq)]
-                    try:
-                        out = self._run_search(h, fake, main_bits,
-                                               side_bits, side_idx,
-                                               side_ids)
-                        jax.block_until_ready(out)
-                        warmed += 1
-                        if (h.tiered_source is not None
-                                and h.algo == "ivf_pq"
-                                and (h.refine_ratio > 1 or getattr(
-                                    h.index, "cache_kind", "none")
-                                    == "rabitq")):
-                            # tiered rerank: the fetched-block rung is
-                            # data-dependent (unique shortlist rows),
-                            # so trace the whole pow2 rung ladder for
-                            # this (bucket, k) — steady state then
-                            # never compiles whatever the miss mix is
-                            kc = ivf_pq.refined_shortlist_width(
-                                h.search_params, h.index, kq,
-                                h.pipeline_rr())
-                            h.tiered_source.warm(bucket, kc, kq,
-                                                 h.metric)
-                    except ValueError as e:
-                        # a rung this index cannot serve (e.g. k beyond
-                        # the probed candidate pool) fails identically at
-                        # dispatch — nothing to warm, but a silently
-                        # skipped rung voids the zero-recompile
-                        # guarantee for that shape, so leave a signal
-                        # naming which one and why
-                        obs.counter("serve.warmup_skipped",
-                                    index=self.name)
-                        obs.event("serve_warmup_rung_skipped",
-                                  index=self.name, bucket=bucket, k=kq,
-                                  error=str(e))
-                        continue
-                    except Exception as e:  # noqa: BLE001 — only the classified-OOM kind is handled; the rest re-raise
-                        if _rerrors.classify(e) != _rerrors.OOM:
-                            raise
-                        # device OOM tracing this rung: at dispatch the
-                        # ladder would halve the ceiling and keep
-                        # serving — do the same here, so a server whose
-                        # top bucket doesn't fit still comes up serving
-                        # the buckets that do (larger rungs can only
-                        # OOM harder)
-                        self._downshift(bucket // 2)
-                        obs.event("serve_warmup_oom", index=self.name,
-                                  bucket=bucket, k=kq)
-                        oom = True
-                        break
+                    for rung in rungs:
+                        if oom:
+                            break
+                        fake = Batch(requests=[], rows=bucket,
+                                     bucket=bucket, prefilter=None,
+                                     rung=rung)
+                        fake.requests = [_warm_request(q, kq)]
+                        try:
+                            out = self._run_search(h, fake, main_bits,
+                                                   side_bits, side_idx,
+                                                   side_ids)
+                            jax.block_until_ready(out)
+                            warmed += 1
+                            if (h.tiered_source is not None
+                                    and h.algo == "ivf_pq"
+                                    and (h.refine_ratio > 1 or getattr(
+                                        h.index, "cache_kind", "none")
+                                        == "rabitq")):
+                                # tiered rerank: the fetched-block rung
+                                # is data-dependent (unique shortlist
+                                # rows), so trace the whole pow2 rung
+                                # ladder for this (bucket, k, rung) —
+                                # steady state then never compiles
+                                # whatever the miss mix is
+                                sp_r, rr_r = h.rung_params(rung)
+                                kc = ivf_pq.refined_shortlist_width(
+                                    sp_r, h.index, kq, rr_r)
+                                h.tiered_source.warm(bucket, kc, kq,
+                                                     h.metric)
+                        except ValueError as e:
+                            # a rung this index cannot serve (e.g. k
+                            # beyond the probed candidate pool) fails
+                            # identically at dispatch — nothing to
+                            # warm, but a silently skipped rung voids
+                            # the zero-recompile guarantee for that
+                            # shape, so leave a signal naming which
+                            # one and why
+                            obs.counter("serve.warmup_skipped",
+                                        index=self.name)
+                            obs.event("serve_warmup_rung_skipped",
+                                      index=self.name, bucket=bucket,
+                                      k=kq, rung=rung, error=str(e))
+                            continue
+                        except Exception as e:  # noqa: BLE001 — only the classified-OOM kind is handled; the rest re-raise
+                            if _rerrors.classify(e) != _rerrors.OOM:
+                                raise
+                            # device OOM tracing this rung: at dispatch
+                            # the ladder would halve the ceiling and
+                            # keep serving — do the same here, so a
+                            # server whose top bucket doesn't fit
+                            # still comes up serving the buckets that
+                            # do (larger rungs can only OOM harder)
+                            self._downshift(bucket // 2)
+                            obs.event("serve_warmup_oom",
+                                      index=self.name, bucket=bucket,
+                                      k=kq)
+                            oom = True
+                            break
             obs.counter("serve.warmup_shapes", warmed, index=self.name)
             return warmed
 
@@ -728,11 +1010,13 @@ class Server:
             side_capacity=self.params.side_capacity,
         )
         raw = _raw_dataset(algo, index, dataset)
-        h = _Handle(algo, index, state,
-                    _default_search_params(algo, index, search_params),
+        sp = _default_search_params(algo, index, search_params)
+        h = _Handle(algo, index, state, sp,
                     build_params, refine_ratio, raw,
                     user_search_params=search_params,
-                    tiered_source=self._make_tiered(algo, raw))
+                    tiered_source=self._make_tiered(algo, raw),
+                    adaptive=self._make_adaptive(algo, index, sp,
+                                                 refine_ratio))
         with self._lock:
             # checked under the SAME lock that registers the serving: a
             # close() racing the unlocked gap would snapshot _servings
@@ -764,6 +1048,35 @@ class Server:
         return tiered.HostArraySource(
             raw, hot_rows=self.params.tiered_hot_rows)
 
+    def _make_adaptive(self, algo: str, index, search_params,
+                       refine_ratio: int):
+        """Build the per-generation adaptive policy (ISSUE 14;
+        docs/serving.md §13) — None unless ``adaptive_probes`` is on
+        and the algo has a coarse quantizer to read margins from.
+
+        The ladder's CEILING is the generation's resolved ``n_probes``:
+        the ``_default_search_params`` pin (``n_probes = n_lists``) is
+        thereby demoted from "the" probe count to the exhaustive top
+        rung, and an explicit user ``n_probes`` caps the ladder at the
+        user's own budget. Derived per generation, so a swap re-derives
+        the whole LADDER against the new index — not just the ceiling
+        (the regression test pins top-rung == new ``n_lists``)."""
+        if (not self.params.adaptive_probes
+                or algo not in _adaptive.ADAPTIVE_ALGOS):
+            return None
+        ceiling = int(min(int(search_params.n_probes), index.n_lists))
+        if ceiling < 2:
+            return None              # a 1-list index has nothing to adapt
+        if algo == "ivf_flat":
+            list_cap = int(index.storage.shape[1])
+        else:
+            list_cap = int(index.indices.shape[1])
+        rr = (int(refine_ratio) if int(refine_ratio) > 1
+              else RABITQ_DEFAULT_REFINE_RATIO
+              if getattr(index, "cache_kind", "none") == "rabitq" else 1)
+        return _adaptive.AdaptivePolicy.build(ceiling, list_cap,
+                                              refine_ratio=rr)
+
     def _publish_guarded(self, name: str, h: "_Handle"):
         """Publish under the server lock: a background build finishing
         after :meth:`close` must not resurrect the name — a generation
@@ -777,12 +1090,19 @@ class Server:
     # -- the data plane ----------------------------------------------------
 
     def submit(self, queries, k: int, *, index: str = "default",
-               prefilter=None) -> Future:
+               prefilter=None,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue a search; returns a Future resolving to host
         ``(distances [rows, k], external ids [rows, k])``. ``queries``
         is one query ``[dim]`` or a block ``[rows, dim]`` answered
-        together. Raises :class:`Overloaded` when the bounded queue is
-        full (classified transient — back off and retry)."""
+        together. ``deadline_ms`` (or ``ServeParams.deadline_ms``)
+        attaches an SLO deadline: the request rides the batcher's
+        priority lane, skips linger when its slack runs out, and is
+        shed with ``Overloaded(reason="deadline")`` — or downshifted a
+        probe rung — when it would certainly miss (docs/serving.md
+        §13). Raises :class:`Overloaded` when the bounded queue (or a
+        per-index admission quota) is full (classified transient —
+        back off and retry)."""
         with obs.span("serve.request", index=index):
             q = np.asarray(queries, dtype=np.float32)
             if q.ndim == 1:
@@ -830,14 +1150,52 @@ class Server:
                 # requests down with it — reject it at the door
                 raise ValueError(
                     f"query dim {q.shape[1]} != index dim {handle.dim}")
+            self._check_quota(serving, index, int(q.shape[0]))
+            if deadline_ms is None:
+                deadline_ms = self.params.deadline_ms
+            deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                        if deadline_ms is not None else None)
             if (serving.result_cache is not None and prefilter is None
                     and handle is not None):
                 return self._submit_cached(serving, handle, gen, q,
-                                           int(k), index)
-            return serving.batcher.submit(q, int(k), prefilter=prefilter)
+                                           int(k), index,
+                                           deadline=deadline)
+            return serving.batcher.submit(q, int(k), prefilter=prefilter,
+                                          deadline=deadline)
+
+    def _check_quota(self, serving: "_IndexServing", index: str,
+                     rows: int) -> None:
+        """Multi-tenant admission (docs/serving.md §13): per-index
+        pending-row quotas and the server-wide total bound, both atop
+        the batcher's own max_queue_rows backpressure. Advisory
+        check-then-act (the hard bound stays the batcher's bounded
+        queue): two racing submits can both pass a nearly-full quota —
+        by at most one batch's rows, which the hard bound still caps."""
+        p = self.params
+        quota = (p.admission_quotas or {}).get(index)
+        if quota is not None and \
+                serving.batcher.depth_rows() + rows > int(quota):
+            self._reject_quota(index, rows, f"index quota {quota}")
+        if p.max_total_queue_rows is not None:
+            with self._lock:
+                servings = list(self._servings.values())
+            total = sum(s.batcher.depth_rows() for s in servings)
+            if total + rows > int(p.max_total_queue_rows):
+                self._reject_quota(
+                    index, rows,
+                    f"server-wide quota {p.max_total_queue_rows}")
+
+    def _reject_quota(self, index: str, rows: int, detail: str) -> None:
+        obs.counter("serve.rejects_total", index=index, reason="quota")
+        exc = Overloaded(
+            f"serve[{index}]: quota ({rows} rows would exceed {detail})",
+            reason="quota")
+        _rerrors.classify(exc)
+        raise exc
 
     def _submit_cached(self, serving: "_IndexServing", handle: "_Handle",
-                       gen, q: np.ndarray, k: int, index: str) -> Future:
+                       gen, q: np.ndarray, k: int, index: str,
+                       deadline: Optional[float] = None) -> Future:
         """The result-cache front (docs/serving.md §12): answer a
         repeated (query, k) from host memory when nothing changed since
         it was computed; otherwise submit and install the answer once
@@ -859,7 +1217,8 @@ class Server:
             fut.set_result((hit[0].copy(), hit[1].copy()))
             return fut
         obs.counter("serve.result_cache_misses_total", index=index)
-        fut = serving.batcher.submit(q, k, prefilter=None)
+        fut = serving.batcher.submit(q, k, prefilter=None,
+                                     deadline=deadline)
 
         def _install(f: Future) -> None:
             if f.exception() is not None:
@@ -883,10 +1242,12 @@ class Server:
         return fut
 
     def search(self, queries, k: int, *, index: str = "default",
-               prefilter=None, timeout_s: Optional[float] = None):
+               prefilter=None, timeout_s: Optional[float] = None,
+               deadline_ms: Optional[float] = None):
         """Blocking convenience over :meth:`submit`."""
         with obs.span("serve.search", index=index):
-            fut = self.submit(queries, k, index=index, prefilter=prefilter)
+            fut = self.submit(queries, k, index=index, prefilter=prefilter,
+                              deadline_ms=deadline_ms)
             return fut.result(timeout=timeout_s
                               if timeout_s is not None
                               else self.params.request_timeout_s)
@@ -989,7 +1350,10 @@ class Server:
                                 h.build_params, h.refine_ratio, new_raw,
                                 user_search_params=h.user_search_params,
                                 tiered_source=self._make_tiered(
-                                    h.algo, new_raw))
+                                    h.algo, new_raw),
+                                adaptive=self._make_adaptive(
+                                    h.algo, new_index, h.search_params,
+                                    h.refine_ratio))
                 if serving.warmup_enabled:
                     serving.warmup_handle(new_h)
                 # commit + publish under the mutation lock: a dispatcher
@@ -1075,20 +1439,27 @@ class Server:
                     # re-derived from the NEW index, or a swap to a
                     # bigger dataset silently clamps probing at the old
                     # index's n_lists and serves non-exhaustive results
+                    # — and with adaptive_probes on, the whole probe
+                    # LADDER re-derives from the re-resolved ceiling
+                    # (not just the ceiling itself), so a bigger
+                    # successor's top rung is its own n_lists
                     sp_user = (search_params if search_params is not None
                                else h.user_search_params
                                if a == h.algo else None)
                     new_raw = _raw_dataset(a, new_index, ds)
+                    sp_new = _default_search_params(a, new_index, sp_user)
+                    rr_new = (refine_ratio if refine_ratio is not None
+                              else h.refine_ratio)
                     new_h = _Handle(
-                        a, new_index, state,
-                        _default_search_params(a, new_index, sp_user),
+                        a, new_index, state, sp_new,
                         build_params if build_params is not None
                         else h.build_params,
-                        refine_ratio if refine_ratio is not None
-                        else h.refine_ratio,
+                        rr_new,
                         new_raw,
                         user_search_params=sp_user,
-                        tiered_source=self._make_tiered(a, new_raw))
+                        tiered_source=self._make_tiered(a, new_raw),
+                        adaptive=self._make_adaptive(a, new_index,
+                                                     sp_new, rr_new))
                     if serving.warmup_enabled:
                         serving.warmup_handle(new_h)
                     gen = self._publish_guarded(name, new_h)
@@ -1137,6 +1508,9 @@ class Server:
             "tombstoned_rows": st.deleted_rows() if st else 0,
             "side_rows": st.side_rows_live() if st else 0,
             "generations_live": len(self.registry.live_generations()),
+            "probe_ladder": (list(handle.adaptive.ladder)
+                             if handle is not None
+                             and handle.adaptive is not None else None),
         }
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -1220,7 +1594,11 @@ def _default_search_params(algo: str, index, search_params):
     if algo == "ivf_flat":
         # serving default: exhaustive probing — exact recall over the
         # tombstone-filtered index, the contract the correctness
-        # acceptance tests pin; drop n_probes for throughput
+        # acceptance tests pin. With ServeParams.adaptive_probes this
+        # pin is the adaptive ladder's exhaustive CEILING, not the
+        # per-query probe count: easy queries serve from lower rungs
+        # and ambiguous ones escape back up to exactly this program
+        # (ISSUE 14; docs/serving.md §13)
         return ivf_flat.SearchParams(n_probes=index.n_lists,
                                      compute_dtype="f32",
                                      local_recall_target=1.0)

@@ -39,9 +39,16 @@ cpp/scripts/heuristics/select_k). Ops:
     keeps the finest rung whenever it fits); i8's time is captured for
     the record.
 
-Index-building ops (ivf_scan, pq_scan) are only captured by
-``scripts/capture_dispatch_tables.py``; measuring them at dispatch time
-would build an index inside a search call.
+``serve_service``
+    end-to-end ``ivf_flat.search`` medians per (bucket, probe-rung)
+    shape — not a dispatch race but a TIMING table: the serve layer's
+    deadline machinery (batcher slack test, shed/downshift estimates)
+    reads these through ``serve.adaptive.service_estimate_ms`` instead
+    of guessing (ISSUE 14, docs/serving.md §13).
+
+Index-building ops (ivf_scan, pq_scan, serve_service) are only
+captured by ``scripts/capture_dispatch_tables.py``; measuring them at
+dispatch time would build an index inside a search call.
 """
 
 from __future__ import annotations
@@ -527,6 +534,46 @@ def fused_topk_grid(quick: bool = True) -> List[Dict]:
             for k in (10, 100, 256)]
 
 
+def serve_grid(quick: bool = True) -> List[Dict]:
+    """(bucket, rung) grid for the serve_service capture — the bucket
+    ladder the micro-batcher dispatches at crossed with the adaptive
+    probe-rung ladder (docs/serving.md §13). The medians feed the
+    batcher's deadline slack test and the engine's shed/downshift
+    estimates through ``serve.adaptive.service_estimate_ms``."""
+    buckets = [8, 32, 128] if quick else [1, 8, 32, 128, 256]
+    rungs = [1, 4, 16, 64] if quick else [1, 2, 4, 8, 16, 32, 64]
+    return [{"bucket": b, "rung": r} for b in buckets for r in rungs]
+
+
+def bench_serve_service(keys: List[Dict], reps: int = _DEF_REPS,
+                        n: int = 20_000, dim: int = 64,
+                        n_lists: int = 64):
+    """Median end-to-end ``ivf_flat.search`` service time per
+    (bucket, rung) shape over ONE shared index — the per-rung
+    service-time table the serve deadline machinery reads instead of a
+    hardcoded guess. Yields (key, {"search": median_ms})."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(17)
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=5), x)
+    for key in keys:
+        bucket = int(key["bucket"])
+        rung = int(min(key["rung"], n_lists))
+        q = jnp.asarray(rng.standard_normal(
+            (bucket, dim)).astype(np.float32))
+        sp = ivf_flat.SearchParams(n_probes=rung, compute_dtype="f32",
+                                   local_recall_target=1.0)
+
+        def run(q=q, sp=sp):
+            return ivf_flat.search(sp, index, q, 10)
+
+        yield dict(key, rung=rung), {"search": _median_ms(run, reps)}
+
+
 def default_budgets() -> Dict[str, int]:
     """Measured-environment byte budgets. The CAGRA inline budget tracks
     the device HBM actually present (packed table + dataset + transients
@@ -573,7 +620,7 @@ def capture(backend: Optional[str] = None, quick: bool = True,
 
     want = set(ops) if ops else {"select_k", "merge_topk", "ivf_scan",
                                  "pq_scan", "ivf_scan_extract",
-                                 "fused_topk_tile"}
+                                 "fused_topk_tile", "serve_service"}
     if "select_k" in want:
         for key in select_grid(quick):
             times = bench_select(key, reps=reps)
@@ -622,6 +669,22 @@ def capture(backend: Optional[str] = None, quick: bool = True,
             if times:
                 log(f"fused_topk_tile {key} -> "
                     f"{t.record('fused_topk_tile', key, times)} {times}")
+    if "serve_service" in want:
+        # single-candidate op: the entry's TIMES are the product (the
+        # serve deadline machinery reads the per-(bucket, rung) median
+        # through adaptive.service_estimate_ms), the winner is moot
+        medians = []
+        for key, times in bench_serve_service(serve_grid(quick),
+                                              reps=reps):
+            log(f"serve_service {key} -> {times}")
+            t.record("serve_service", key, times)
+            medians.append(times["search"])
+        # the deadline headroom budget scales with THIS host's service
+        # times (a p95-based shed gate needs slack to absorb the
+        # service distribution's own tail; the median-of-medians is a
+        # robust proxy that shrinks to ~nothing on a real chip)
+        t.set_budget("serve_deadline_headroom_ms",
+                     max(5, int(round(float(np.median(medians))))))
     for name, val in default_budgets().items():
         t.set_budget(name, val)
     return t

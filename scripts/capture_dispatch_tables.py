@@ -10,7 +10,9 @@ ivf_scan_extract (in-kernel extraction arms incl. the unextracted
 fold), fused_topk_tile (brute-force scan vs fused kernel per
 variant/row-tile), pq_scan (i8/i4/pq4/rabitq cache kinds — the rabitq
 arm races its whole rerank pipeline at matched recall, and arms that
-cannot hit the recall band are filtered before timing) — over a shape
+cannot hit the recall band are filtered before timing), and
+serve_service (per-(bucket, probe-rung) end-to-end service medians the
+serve deadline machinery reads, ISSUE 14) — over a shape
 grid, plus the environment byte budgets, and writes
 ``raft_tpu/tuning/tables/<backend>.json``. Consumers pick these
 winners up automatically through ``raft_tpu.tuning.choose`` (knob:
@@ -46,9 +48,11 @@ def main(argv=None):
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--ops", default=None,
                     help="comma list: select_k,merge_topk,ivf_scan,"
-                         "pq_scan,ivf_scan_extract,fused_topk_tile "
-                         "(kernel arms need a TPU, or --interpret on "
-                         "CPU)")
+                         "pq_scan,ivf_scan_extract,fused_topk_tile,"
+                         "serve_service (kernel arms need a TPU, or "
+                         "--interpret on CPU). A subset capture MERGES "
+                         "into the existing table at --out instead of "
+                         "clobbering the other ops' entries")
     ap.add_argument("--interpret", action="store_true",
                     help="on CPU, also time the Pallas kernels in "
                          "interpret mode (debug-only numbers)")
@@ -81,6 +85,20 @@ def main(argv=None):
         retry_on=(resilience.TRANSIENT,),
     )
     out = args.out or os.path.join(tuning.tables_dir(), backend + ".json")
+    if args.ops and os.path.exists(out):
+        # subset re-capture (e.g. --ops serve_service after the serve
+        # layer grows a rung): fold the fresh entries into the existing
+        # table — a partial capture must never throw away the other
+        # ops' measured winners
+        from raft_tpu.tuning.table import DispatchTable
+
+        prior = DispatchTable.load(out)
+        prior.data["captured"] = table.data["captured"]
+        prior.data["device"] = table.data["device"]
+        for op, body in table.data["ops"].items():
+            prior.data["ops"][op] = body
+        prior.data["budgets"].update(table.data["budgets"])
+        table = prior
     table.save(out)
     print(f"wrote {out}: ops={table.ops()} entries={table.n_entries()} "
           f"budgets={table.data['budgets']}", flush=True)

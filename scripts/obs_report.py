@@ -107,7 +107,8 @@ def render_waterfall(wf: dict, width: int = BAR_WIDTH) -> str:
         name = str(s.get("stage"))
         who = "".join(
             f" {k}={s[k]}" for k in ("worker", "shard", "bucket",
-                                     "batch_seq", "attempt", "kind")
+                                     "batch_seq", "attempt", "kind",
+                                     "rung", "deadline_slack_ms")
             if k in s)
         ms = s.get("ms")
         off = float(s.get("t_off_ms", 0.0))

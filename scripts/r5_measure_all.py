@@ -98,6 +98,18 @@ OPTIONAL_STAGES = [
     ("tiered_deep100m",
      [PY, "scripts/deep100m.py", "--tiered-only", "--n", "1000000",
       "--tiered-out", "TIERED_r12.json"], 2700),
+    # SLO acceptance (ISSUE 14, ROADMAP item 5): the closed-loop
+    # deadline harness — calibrate capacity, hold the p99 target under
+    # 1x and 2x overload with adaptive probe rungs, recall band vs the
+    # exhaustive baseline, mean probed-list reduction. Flags match the
+    # committed SLO_r14.json so the stage REPRODUCES the artifact (on
+    # chip day the same run re-captures it at TPU service times)
+    ("slo_loadgen",
+     [PY, "scripts/serve_loadgen.py", "--slo-p99-ms", "250",
+      "--n", "20000", "--dim", "64", "--n-lists", "16", "--k", "10",
+      "--query-pool", "512", "--max-batch-rows", "8",
+      "--max-wait-ms", "2", "--concurrency", "8", "--duration-s", "10",
+      "--out", "SLO_r14.json"], 1200),
     # flags match the committed SERVE_TIERED_r12.json exactly, so the
     # stage REPRODUCES the artifact (result cache off on purpose: with
     # it on, repeats never reach the engine and the hot-ROW tier idles

@@ -151,6 +151,31 @@ def main() -> int:
                     help="fabric only: archive the end-of-run federated "
                          "fleet metrics snapshot here (JSON; a .prom "
                          "Prometheus exposition lands next to it)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="serve with SLO-aware adaptive probing "
+                         "(ServeParams.adaptive_probes; docs/serving.md "
+                         "§13)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request SLO deadline (ms); late work is "
+                         "shed/downshifted and counted in obs")
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0,
+                    help="closed-loop SLO mode (ISSUE 14): clustered "
+                         "easy/hard query mix, a calibration leg, then "
+                         "paced legs at 1x and 2x the measured capacity "
+                         "with this p99 target as every request's "
+                         "deadline — emits the SLO_r14.json acceptance "
+                         "artifact (p99-vs-target, recall band, mean "
+                         "probed-list reduction)")
+    ap.add_argument("--slo-recall-band", type=float, default=0.01,
+                    help="allowed recall loss vs the exhaustive "
+                         "baseline in SLO mode")
+    ap.add_argument("--easy-frac", type=float, default=0.85,
+                    help="fraction of the SLO-mode query pool drawn "
+                         "near dataset rows (easy); the rest sit at "
+                         "cluster midpoints (ambiguous)")
+    ap.add_argument("--n-lists", type=int, default=16,
+                    help="IVF lists for the SLO-mode index (the "
+                         "exhaustive baseline probes all of them)")
     ap.add_argument("--out", default=None,
                     help="report path (default SERVE_r05.json, or "
                          "FABRIC_r13.json with --fabric)")
@@ -183,6 +208,10 @@ def main() -> int:
 
     ks = sorted({max(1, int(s)) for s in args.k.split(",") if s.strip()})
     rng = np.random.default_rng(args.seed)
+    if args.slo_p99_ms > 0:
+        if obs.mode() == "off" and not os.environ.get("RAFT_TPU_OBS"):
+            obs.set_mode("on")    # rung/shed/miss counters feed the report
+        return _run_slo(args, ks, rng, obs, serve)
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
 
     if args.out is None:
@@ -198,6 +227,8 @@ def main() -> int:
         tiered_rerank=args.tiered,
         tiered_hot_rows=args.hot_rows,
         result_cache_entries=args.result_cache,
+        adaptive_probes=args.adaptive,
+        deadline_ms=args.deadline_ms,
     )
     srv = serve.Server(params)
     t_build = time.perf_counter()
@@ -380,6 +411,256 @@ def main() -> int:
                       "artifact": args.out, "date": report["date"]}),
           flush=True)
     print(f"wrote {args.out} (measured {report['date']})", flush=True)
+    return 0
+
+
+def _slo_pool(args, rng):
+    """Clustered dataset + easy/hard query pool for the SLO harness.
+
+    Rows sit in tight clusters (the regime where the coarse margin is
+    informative — JUNO's observation that real embeddings are locally
+    concentrated); "easy" pool queries perturb dataset rows (large
+    margin, low rungs suffice), "hard" ones sit at cluster midpoints
+    (ambiguous margin, the policy escapes them to the exhaustive
+    rung)."""
+    n_centers = max(args.n_lists, 8)
+    centers = rng.uniform(-5, 5, (n_centers, args.dim)).astype(np.float32)
+    dataset = (centers[rng.integers(0, n_centers, args.n)]
+               + 0.2 * rng.standard_normal((args.n, args.dim))
+               ).astype(np.float32)
+    n_easy = int(round(args.query_pool * args.easy_frac))
+    easy = (dataset[rng.integers(0, args.n, n_easy)]
+            + 0.05 * rng.standard_normal((n_easy, args.dim)))
+    a, b = (rng.integers(0, n_centers, args.query_pool - n_easy)
+            for _ in range(2))
+    hard = ((centers[a] + centers[b]) / 2
+            + 0.2 * rng.standard_normal((args.query_pool - n_easy,
+                                         args.dim)))
+    pool = np.concatenate([easy, hard]).astype(np.float32)
+    return dataset, pool, n_easy
+
+
+def _drive_slo(srv, serve, pool, oracle, k, args, duration_s,
+               qps, deadline_ms, seed):
+    """One measurement leg against the adaptive server: closed loop
+    when qps=0, paced open loop otherwise; every request carries
+    ``deadline_ms`` when set. Returns latencies of COMPLETED requests,
+    per-request recall, and the shed/reject/miss split."""
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms, recalls = [], []
+    counts = {"completed": 0, "shed_deadline": 0, "rejected_queue": 0,
+              "errors": 0}
+    interval = (args.concurrency / qps) if qps > 0 else 0.0
+
+    def worker(wid):
+        wrng = np.random.default_rng(seed + wid)
+        next_t = time.monotonic()
+        while not stop.is_set():
+            if interval:
+                next_t += interval
+                pause = next_t - time.monotonic()
+                if pause > 0:
+                    time.sleep(pause)
+            j = int(wrng.integers(pool.shape[0]))
+            t0 = time.perf_counter()
+            try:
+                _, ids = srv.search(pool[j], k, timeout_s=60.0,
+                                    deadline_ms=deadline_ms)
+            except serve.Overloaded as e:
+                with lock:
+                    counts["shed_deadline" if e.reason == "deadline"
+                           else "rejected_queue"] += 1
+                if e.reason != "deadline":
+                    time.sleep(0.002 * (1 + wrng.random()))
+                continue
+            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow loadgen accounting only; the server already classified the failure
+                with lock:
+                    counts["errors"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            hit = len(set(ids[0].tolist()) & oracle[j]) / k
+            with lock:
+                counts["completed"] += 1
+                lat_ms.append(ms)
+                recalls.append(hit)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    wall = time.perf_counter() - t0
+    return {"counts": counts, "lat_ms": lat_ms, "recalls": recalls,
+            "wall_s": wall,
+            "qps": round(counts["completed"] / max(wall, 1e-9), 1)}
+
+
+def _counter_points(obs, name):
+    snap = obs.snapshot(runtime_gauges=False)["metrics"]
+    return {tuple(sorted(p["labels"].items())): p["value"]
+            for p in snap.get(name, {}).get("points", [])}
+
+
+def _mean_probed(before, after):
+    """Mean probed lists per request from the serve.probe_rung counter
+    delta (labels carry the rung value)."""
+    total = probes = 0.0
+    for key, v in after.items():
+        d = v - before.get(key, 0.0)
+        if d <= 0:
+            continue
+        rung = int(dict(key)["rung"])
+        total += d
+        probes += d * rung
+    return (probes / total) if total else None
+
+
+def _run_slo(args, ks, rng, obs, serve) -> int:
+    """The closed-loop SLO harness (ISSUE 14; ROADMAP item 5
+    acceptance): calibrate capacity, then hold a p99 target under 1x
+    and 2x overload with per-request deadlines, while tracking recall
+    against the exhaustive baseline and the mean probed-list
+    reduction. Artifact: SLO_r14.json."""
+    from raft_tpu.neighbors import brute_force, ivf_flat
+
+    k = max(ks)
+    slo = float(args.slo_p99_ms)
+    dataset, pool, n_easy = _slo_pool(args, rng)
+    t_build = time.perf_counter()
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=args.n_lists, kmeans_n_iters=10),
+        dataset)
+    # the exhaustive baseline: the same resolved params serving's
+    # non-adaptive default uses (n_probes = n_lists, f32, exact local
+    # top-k) — the recall band is measured against THIS
+    sp_exh = ivf_flat.SearchParams(n_probes=args.n_lists,
+                                   compute_dtype="f32",
+                                   local_recall_target=1.0)
+    _, gt = brute_force.knn(pool, dataset, k)
+    gt = np.asarray(gt)
+    oracle = {j: set(gt[j].tolist()) for j in range(pool.shape[0])}
+    _, exh_ids = ivf_flat.search(sp_exh, index, pool, k)
+    exh_ids = np.asarray(exh_ids)
+    recall_exh = float(np.mean([
+        len(set(exh_ids[j].tolist()) & oracle[j]) / k
+        for j in range(pool.shape[0])]))
+
+    params = serve.ServeParams(
+        max_batch_rows=args.max_batch_rows,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_rows=args.max_queue_rows,
+        max_k=k,
+        adaptive_probes=True,
+        deadline_action="downshift",
+    )
+    srv = serve.Server(params)
+    srv.add_index("default", index, algo="ivf_flat", dataset=dataset)
+    build_s = time.perf_counter() - t_build
+    print(f"SLO harness up: ivf_flat n={args.n} d={args.dim} "
+          f"n_lists={args.n_lists} ladder="
+          f"{srv.stats()['probe_ladder']} pool={pool.shape[0]} "
+          f"(easy {n_easy}) recall_exh={recall_exh:.4f} "
+          f"(build+warmup {build_s:.1f}s)", flush=True)
+    traces_before = serve.total_trace_count()
+
+    # leg 0: calibration — closed loop, no deadlines, measures capacity
+    cal = _drive_slo(srv, serve, pool, oracle, k, args,
+                     max(args.duration_s / 2, 3.0), qps=0.0,
+                     deadline_ms=None, seed=args.seed + 100)
+    capacity = max(cal["qps"], 1.0)
+    print(f"calibration: {capacity} QPS closed-loop "
+          f"(p99 {_percentiles(cal['lat_ms']).get('p99')} ms)",
+          flush=True)
+
+    legs = {}
+    for factor in (1.0, 2.0):
+        before_rung = _counter_points(obs, "serve.probe_rung")
+        before_miss = _counter_points(obs, "serve.deadline_miss_total")
+        before_shed = _counter_points(obs, "serve.deadline_shed_total")
+        leg = _drive_slo(srv, serve, pool, oracle, k, args,
+                         args.duration_s, qps=capacity * factor,
+                         deadline_ms=slo,
+                         seed=args.seed + 1000 * int(factor * 10))
+        after_rung = _counter_points(obs, "serve.probe_rung")
+        lat = _percentiles(leg["lat_ms"])
+        shed_d = {
+            dict(kk).get("action"): vv - before_shed.get(kk, 0.0)
+            for kk, vv in _counter_points(
+                obs, "serve.deadline_shed_total").items()}
+        miss = sum(_counter_points(
+            obs, "serve.deadline_miss_total").values()) - sum(
+            before_miss.values())
+        mean_probed = _mean_probed(before_rung, after_rung)
+        legs[f"{factor:g}x"] = {
+            "offered_qps": round(capacity * factor, 1),
+            "achieved_qps": leg["qps"],
+            **leg["counts"],
+            "latency_ms": lat,
+            "p99_le_slo": (lat.get("p99") is not None
+                           and lat["p99"] <= slo),
+            "deadline_miss": int(miss),
+            "downshifts": int(shed_d.get("downshift", 0)),
+            "recall": (round(float(np.mean(leg["recalls"])), 4)
+                       if leg["recalls"] else None),
+            "mean_probed_lists": (round(mean_probed, 3)
+                                  if mean_probed else None),
+        }
+        print(f"leg {factor:g}x: {legs[f'{factor:g}x']}", flush=True)
+
+    traces_after = serve.total_trace_count()
+    srv.close()
+    two = legs["2x"]
+    probed_1x = legs["1x"]["mean_probed_lists"]
+    reduction = (round(args.n_lists / probed_1x, 2)
+                 if probed_1x else None)
+    report = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "algo": "ivf_flat", "n": args.n, "dim": args.dim,
+            "n_lists": args.n_lists, "k": k,
+            "query_pool": int(pool.shape[0]), "easy": n_easy,
+            "easy_frac": args.easy_frac,
+            "concurrency": args.concurrency,
+            "max_batch_rows": args.max_batch_rows,
+            "max_wait_ms": args.max_wait_ms,
+            "slo_p99_ms": slo, "recall_band": args.slo_recall_band,
+            "duration_s": args.duration_s, "seed": args.seed,
+        },
+        "exhaustive": {"recall": round(recall_exh, 4),
+                       "probed_lists": args.n_lists},
+        "capacity_qps": capacity,
+        "legs": legs,
+        "steady_state_retraces": int(traces_after - traces_before),
+        "acceptance": {
+            "slo_held_2x_overload": bool(two["p99_le_slo"]),
+            "recall_within_band": bool(
+                two["recall"] is not None
+                and two["recall"] >= recall_exh - args.slo_recall_band),
+            "probed_reduction_vs_exhaustive": reduction,
+            "probed_reduction_ge_4x": bool(reduction is not None
+                                           and reduction >= 4.0),
+            "zero_retraces": traces_after == traces_before,
+        },
+    }
+    out = args.out or "SLO_r14.json"
+    with open(os.path.join(ROOT, out), "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    if args.obs_snapshot:
+        obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    # GL005 contract: every number this prints is citable with its
+    # artifact + capture date
+    print(json.dumps({"acceptance": report["acceptance"],
+                      "capacity_qps": capacity,
+                      "p99_2x": two["latency_ms"].get("p99"),
+                      "artifact": out, "date": report["date"]}),
+          flush=True)
+    print(f"wrote {out} (measured {report['date']})", flush=True)
     return 0
 
 

@@ -13,6 +13,9 @@ the real Mosaic-compiled kernels on the TPU:
   estimator scan + the multi-stage rerank pipeline vs the i4 band
   (check_rabitq — chip day picks the ISSUE-11 rung up with no code
   change),
+* the SLO-aware adaptive rung policy (check_adaptive, ISSUE 14):
+  coarse-margin easy/hard separation + the rung ladder's recall band
+  at a real probed-work reduction, on the compiled coarse scan,
 * fused_topk.fused_topk (exact + fold brute-force kernel) vs the
   hardware-top_k oracle (ids bitwise on the exact arm),
 * beam_step.beam_merge_step (scored + packed variants) vs the numpy
@@ -152,6 +155,64 @@ def check_rabitq(results):
     }
 
 
+def check_adaptive(results):
+    """The SLO-aware adaptive rung policy on real hardware (ISSUE 14):
+    chip day re-validates that the coarse-margin thresholds captured on
+    the CPU host still separate easy from ambiguous queries on the
+    compiled coarse scan, and that the rung ladder holds the recall
+    band at a real probed-work reduction (docs/serving.md §13)."""
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve.adaptive import AdaptivePolicy
+    from tests.oracles import naive_knn, eval_recall
+
+    rng = np.random.default_rng(14)
+    n, d, m, k, n_lists = 20_000, 64, 256, 10, 16
+    centers = rng.uniform(-5, 5, (n_lists, d)).astype(np.float32)
+    x = (centers[rng.integers(0, n_lists, n)]
+         + 0.2 * rng.standard_normal((n, d))).astype(np.float32)
+    easy = (x[rng.integers(0, n, m)]
+            + 0.05 * rng.standard_normal((m, d))).astype(np.float32)
+    a, b = (rng.integers(0, n_lists, m) for _ in range(2))
+    hard = ((centers[a] + centers[b]) / 2
+            + 0.2 * rng.standard_normal((m, d))).astype(np.float32)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=10), x)
+    m_easy = np.asarray(ivf_flat.coarse_margins(index, easy))
+    m_hard = np.asarray(ivf_flat.coarse_margins(index, hard))
+    pol = AdaptivePolicy.build(ceiling=n_lists,
+                               list_cap=int(index.storage.shape[1]))
+    # serve the mix per-rung exactly like the engine's split-by-rung
+    q = np.concatenate([easy, hard])
+    margins = np.concatenate([m_easy, m_hard])
+    rungs = np.asarray([pol.rung(pol.choose_idx(float(mm), k))
+                        for mm in margins])
+    out = np.full((q.shape[0], k), -1, np.int64)
+    for rung in np.unique(rungs):
+        sel = rungs == rung
+        sp = ivf_flat.SearchParams(n_probes=int(rung),
+                                   compute_dtype="f32",
+                                   local_recall_target=1.0)
+        _, ii = ivf_flat.search(sp, index, q[sel], k)
+        out[sel] = np.asarray(ii)
+    _, want = naive_knn(q, x, k)
+    sp_exh = ivf_flat.SearchParams(n_probes=n_lists, compute_dtype="f32",
+                                   local_recall_target=1.0)
+    _, exh = ivf_flat.search(sp_exh, index, q, k)
+    r_adapt = eval_recall(out, want)
+    r_exh = eval_recall(np.asarray(exh), want)
+    mean_probed = float(rungs.mean())
+    results["adaptive"] = {
+        "margin_easy_p50": round(float(np.median(m_easy)), 4),
+        "margin_hard_p50": round(float(np.median(m_hard)), 4),
+        "recall_adaptive": round(r_adapt, 4),
+        "recall_exhaustive": round(r_exh, 4),
+        "mean_probed_lists": round(mean_probed, 3),
+        "ok": bool(np.median(m_easy) > np.median(m_hard) * 2
+                   and r_adapt >= r_exh - 0.01
+                   and mean_probed <= n_lists / 2),
+    }
+
+
 def check_fused_topk(results):
     from raft_tpu.ops.fused_topk import L2, fused_topk
     from tests.oracles import naive_knn, eval_recall
@@ -287,8 +348,8 @@ def main():
     results = {"platform": jax.devices()[0].platform,
                "device": str(jax.devices()[0])}
     for fn in (check_ivf_scan, check_ivf_pq_scan, check_rabitq,
-               check_fused_topk, check_beam_step, check_cagra,
-               check_kernel_contracts):
+               check_adaptive, check_fused_topk, check_beam_step,
+               check_cagra, check_kernel_contracts):
         try:
             fn(results)
         except Exception as e:  # noqa: BLE001 - record, keep going

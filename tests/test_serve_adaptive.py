@@ -1,0 +1,398 @@
+"""SLO-aware adaptive execution tests (ISSUE 14, marker ``serve``).
+
+Covers the ISSUE-14 acceptance surface: the margin -> probe-rung
+policy units, difficulty-margin separation on clustered data, the
+recall-band + probed-work acceptance (adaptive rungs within 0.01 of
+exhaustive recall at a >= 4x mean probed-list reduction on the
+easy-dominated mix), trace stability over the full (bucket, k, rung)
+ladder, the exhaustive escape hatch served bitwise vs the non-adaptive
+path (tombstones + user prefilters composed), deadline-driven serving
+(priority-lane linger skip, shed under an injected
+``slow@stage:serve.dispatch`` stall), per-index admission quotas, and
+the swap-re-derives-the-ladder regression."""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve, tuning
+from raft_tpu.analysis import lockwatch
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.resilience import faultinject
+from raft_tpu.serve.adaptive import (
+    AdaptivePolicy,
+    probe_ladder,
+    service_estimate_ms,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.threadsan]
+
+DIM = 16
+N_LISTS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    faultinject.clear()
+    yield
+    faultinject.clear()
+    tuning.reload()
+
+
+@pytest.fixture(scope="module")
+def clustered():
+    """Tight clusters + easy (perturbed-row) and hard (cluster-midpoint)
+    query pools — the regime where the coarse margin is informative."""
+    rng = np.random.default_rng(21)
+    centers = rng.uniform(-5, 5, (N_LISTS, DIM)).astype(np.float32)
+    x = (centers[rng.integers(0, N_LISTS, 512)]
+         + 0.05 * rng.standard_normal((512, DIM))).astype(np.float32)
+    easy = (x[rng.integers(0, 512, 24)]
+            + 0.02 * rng.standard_normal((24, DIM))).astype(np.float32)
+    a, b = (rng.integers(0, N_LISTS, 8) for _ in range(2))
+    hard = ((centers[a] + centers[b]) / 2
+            + 0.1 * rng.standard_normal((8, DIM))).astype(np.float32)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=N_LISTS, kmeans_n_iters=6), x)
+    return x, easy, hard, index
+
+
+def _params(**kw):
+    kw.setdefault("max_batch_rows", 4)
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("max_k", 4)
+    kw.setdefault("adaptive_probes", True)
+    return serve.ServeParams(**kw)
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+
+
+def test_probe_ladder_shape():
+    assert probe_ladder(16) == (1, 2, 4, 8, 16)
+    assert probe_ladder(10) == (1, 2, 4, 8, 10)   # non-pow2 ceiling rides
+    assert probe_ladder(1) == (1,)
+
+
+def test_policy_margin_mapping():
+    pol = AdaptivePolicy(ladder=probe_ladder(16), list_cap=128,
+                         easy_margin=0.2, floor_margin=0.02)
+    assert pol.choose_idx(0.5) == 0                   # easy: min rung
+    assert pol.choose_idx(0.2) == 0
+    # the escape hatch: ambiguous margins serve the exhaustive TOP rung
+    assert pol.rung(pol.choose_idx(0.001)) == 16
+    assert pol.rung(pol.choose_idx(float("nan"))) == 16
+    # interpolation is monotone: harder -> deeper
+    idxs = [pol.choose_idx(m) for m in (0.18, 0.12, 0.06, 0.03)]
+    assert idxs == sorted(idxs)
+    assert all(0 < i < len(pol.ladder) for i in idxs)
+
+
+def test_policy_k_floor_and_refine_rungs():
+    pol = AdaptivePolicy(ladder=probe_ladder(16), list_cap=8,
+                         easy_margin=0.2, floor_margin=0.02,
+                         refine_ratio=4)
+    # a rung must keep rung * cap >= k: k=20 with cap=8 needs >= 4 probes
+    assert pol.rung(pol.choose_idx(0.9, k=20)) == 4
+    assert pol.min_idx(1) == 0
+    # per-rung rabitq refine: the easiest rung halves the over-fetch,
+    # everything else (incl. the escape hatch) keeps the default —
+    # bitwise vs the non-adaptive pipeline
+    assert pol.refine_for(0) == 2
+    assert pol.refine_for(len(pol.ladder) - 1) == 4
+    assert pol.refine_ladder() == (2, 4)
+    assert AdaptivePolicy(ladder=(1, 2), list_cap=8, easy_margin=0.2,
+                          floor_margin=0.02).refine_for(0) == 1
+
+
+def test_service_estimate_reads_captured_table():
+    # the committed cpu.json carries serve_service (bucket, rung)
+    # medians (captured 2026-08-04) — the batcher's slack test reads
+    # THESE, not a hardcoded guess
+    est = service_estimate_ms(8, 1)
+    assert est is not None and est > 0
+
+
+# ---------------------------------------------------------------------------
+# margins
+# ---------------------------------------------------------------------------
+
+
+def test_margins_separate_easy_from_hard(clustered):
+    x, easy, hard, index = clustered
+    m_easy = np.asarray(ivf_flat.coarse_margins(index, easy))
+    m_hard = np.asarray(ivf_flat.coarse_margins(index, hard))
+    assert ((0 <= m_easy) & (m_easy <= 1)).all()
+    assert ((0 <= m_hard) & (m_hard <= 1)).all()
+    assert np.median(m_easy) > 2 * np.median(m_hard), (
+        f"margins do not separate: easy {np.median(m_easy):.3f} vs "
+        f"hard {np.median(m_hard):.3f}")
+
+
+def test_margins_shared_with_ivf_pq(clustered):
+    x, easy, _, _ = clustered
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=N_LISTS, pq_dim=DIM,
+                           kmeans_n_iters=4), x)
+    m = np.asarray(ivf_pq.coarse_margins(idx, easy))
+    assert m.shape == (easy.shape[0],)
+    assert ((0 <= m) & (m <= 1)).all()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: recall band + probed-work reduction + (bucket, k, rung)
+# trace stability
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_recall_band_and_trace_stability(clustered):
+    x, easy, hard, index = clustered
+    k = 4
+    obs.set_mode("on")
+    try:
+        obs.reset()
+        with serve.Server(_params()) as srv:       # warmup on
+            srv.add_index("default", index, algo="ivf_flat", dataset=x)
+            assert srv.stats()["probe_ladder"] == [1, 2, 4, 8]
+            # ---- easy-dominated mix (the ISSUE-14 acceptance mix) ----
+            sp_exh = ivf_flat.SearchParams(
+                n_probes=N_LISTS, compute_dtype="f32",
+                local_recall_target=1.0)
+            mix = [easy[i:i + 1] for i in range(24)] + [hard[:1]]
+            # the exhaustive oracle traces its own (unpadded, unfiltered)
+            # shapes — keep it out of the serve trace-stability window
+            exhaustive = [np.asarray(ivf_flat.search(sp_exh, index,
+                                                     q, k)[1])
+                          for q in mix]
+            before = serve.trace_cache_sizes()
+            served = []
+            for q in mix:
+                _, si = srv.search(q, k)
+                served.append(np.asarray(si))
+            # mutation + prefilter traffic rides the same ladder
+            srv.delete([int(served[0][0, 0])])
+            filt = Bitset.from_dense(np.arange(512) % 2 == 0)
+            srv.search(easy[:3], 3, prefilter=filt)
+            srv.search(hard[:1], 2)
+            after = serve.trace_cache_sizes()
+            assert after == before, (
+                f"adaptive steady state retraced: {before} -> {after}")
+        served = np.concatenate(served)
+        exhaustive = np.concatenate(exhaustive)
+        gt = np.asarray(brute_force.knn(
+            np.concatenate(mix), x, k)[1])
+        recall_served = float(np.mean([
+            len(set(served[j]) & set(gt[j])) / k
+            for j in range(gt.shape[0])]))
+        recall_exh = float(np.mean([
+            len(set(exhaustive[j]) & set(gt[j])) / k
+            for j in range(gt.shape[0])]))
+        assert recall_served >= recall_exh - 0.01, (
+            f"adaptive recall {recall_served:.4f} fell below the "
+            f"exhaustive band ({recall_exh:.4f} - 0.01)")
+        # ---- >= 4x mean probed-list reduction on the easy mix --------
+        snap = obs.snapshot(runtime_gauges=False)["metrics"]
+        pts = snap["serve.probe_rung"]["points"]
+        total = sum(p["value"] for p in pts)
+        probed = sum(p["value"] * int(p["labels"]["rung"]) for p in pts)
+        mean_probed = probed / total
+        assert mean_probed <= N_LISTS / 4, (
+            f"mean probed lists {mean_probed:.2f} not a 4x reduction "
+            f"vs exhaustive {N_LISTS}")
+        assert "serve.difficulty_margin" in snap
+    finally:
+        obs.set_mode(None)
+        obs.reset()
+
+
+def test_escape_hatch_serves_bitwise_vs_nonadaptive(clustered):
+    """Ambiguous margins route to the TOP rung, which must dispatch the
+    exact program the non-adaptive path runs — tombstones and user
+    prefilters composed — so the escape hatch costs zero correctness."""
+    x, easy, hard, index = clustered
+    dead = [3, 17, 99]
+    filt = Bitset.from_dense(np.arange(512) % 3 != 0)
+    q = np.concatenate([easy[:2], hard[:2]])
+    results = {}
+    for adaptive in (True, False):
+        with serve.Server(_params(warmup=False,
+                                  adaptive_probes=adaptive)) as srv:
+            srv.add_index("default", index, algo="ivf_flat", dataset=x)
+            if adaptive:
+                # force EVERY query through the escape hatch
+                h = srv.registry.get("default").handle
+                h.adaptive = dataclasses.replace(
+                    h.adaptive, easy_margin=1.01, floor_margin=1.0)
+            srv.delete(dead)
+            results[adaptive] = (
+                srv.search(q, 4, prefilter=filt))
+    np.testing.assert_array_equal(results[True][0], results[False][0])
+    np.testing.assert_array_equal(results[True][1], results[False][1])
+
+
+def test_forced_rung_matches_explicit_params(clustered, monkeypatch):
+    """tombstone/prefilter x adaptive composition: a mid-ladder rung
+    serves bitwise what a non-adaptive server with the same explicit
+    n_probes serves."""
+    x, easy, hard, index = clustered
+    rung = 4
+    monkeypatch.setattr(AdaptivePolicy, "choose_idx",
+                        lambda self, m, k=1: self.ladder.index(rung))
+    dead = [5, 42]
+    filt = Bitset.from_dense(np.arange(512) % 2 == 0)
+    q = np.concatenate([easy[:2], hard[:2]])
+    with serve.Server(_params(warmup=False)) as srv:
+        srv.add_index("default", index, algo="ivf_flat", dataset=x)
+        srv.delete(dead)
+        ad, ai = srv.search(q, 4, prefilter=filt)
+    with serve.Server(_params(warmup=False,
+                              adaptive_probes=False)) as srv:
+        srv.add_index(
+            "default", index, algo="ivf_flat", dataset=x,
+            search_params=ivf_flat.SearchParams(
+                n_probes=rung, compute_dtype="f32",
+                local_recall_target=1.0))
+        srv.delete(dead)
+        ed, ei = srv.search(q, 4, prefilter=filt)
+    np.testing.assert_array_equal(ai, ei)
+    np.testing.assert_array_equal(ad, ed)
+
+
+def test_rabitq_pipeline_rides_adaptive_rungs(clustered):
+    """The rabitq multi-stage pipeline composes with adaptive rungs:
+    per-rung n_probes + the per-rung refine_ratio rung (easiest rung
+    halves the over-fetch; ROADMAP item 2b)."""
+    x, easy, _, _ = clustered
+    bp = ivf_pq.IndexParams(n_lists=4, pq_dim=DIM, kmeans_n_iters=4,
+                            cache_dtype="rabitq")
+    with serve.Server(_params(warmup=False, max_k=4)) as srv:
+        srv.create_index("default", x, algo="ivf_pq", build_params=bp)
+        h = srv.registry.get("default").handle
+        assert h.adaptive is not None
+        assert h.adaptive.refine_ladder() == (2, 4)
+        d, i = srv.search(easy[:3], 4)
+        assert i.shape == (3, 4) and (np.asarray(i) >= 0).all()
+        # a served id deletes cleanly through whatever rung serves it
+        victim = int(np.asarray(i)[0, 0])
+        srv.delete([victim])
+        _, i2 = srv.search(easy[:3], 4)
+        assert victim not in np.asarray(i2)
+
+
+# ---------------------------------------------------------------------------
+# deadline-driven serving
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_request_skips_linger(clustered):
+    x = clustered[0]
+    with serve.Server(_params(warmup=False, max_wait_ms=400.0,
+                              adaptive_probes=False)) as srv:
+        srv.create_index("default", x, algo="brute_force")
+        srv.search(x[0], 2)                     # compile outside timing
+        t0 = time.monotonic()
+        srv.search(x[1], 2, deadline_ms=150)
+        took_ms = (time.monotonic() - t0) * 1e3
+        # a lingering dispatcher would hold the request ~400 ms; the
+        # priority lane's slack test releases it once the remaining
+        # budget only just covers the service estimate + headroom
+        assert took_ms < 300, f"deadline request lingered {took_ms:.0f}ms"
+
+
+def test_deadline_shed_under_slow_dispatch(clustered, monkeypatch):
+    from raft_tpu import resilience
+
+    x = clustered[0]
+    monkeypatch.setenv("RAFT_TPU_FAULTS_SLOW_MS", "300")
+    obs.set_mode("on")
+    try:
+        obs.reset()
+        with serve.Server(_params(warmup=False, max_wait_ms=1.0,
+                                  adaptive_probes=False)) as srv:
+            srv.create_index("default", x, algo="brute_force")
+            srv.search(x[0], 2)                 # compile before the storm
+            faultinject.install("slow@stage:serve.dispatch*20")
+            futs = [srv.submit(x[j], 2, deadline_ms=50)
+                    for j in range(6)]
+            shed = served = 0
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    served += 1
+                except serve.Overloaded as e:
+                    assert e.reason == "deadline"
+                    assert resilience.classify(e) == resilience.TRANSIENT
+                    shed += 1
+            assert shed >= 1, "no deadline work was shed under the stall"
+            faultinject.clear()
+            snap = obs.snapshot(runtime_gauges=False)["metrics"]
+            pts = snap["serve.deadline_shed_total"]["points"]
+            assert sum(p["value"] for p in pts
+                       if p["labels"]["action"] == "shed") == shed
+            # the server stays healthy once the stall clears
+            _, i = srv.search(x[0], 2)
+            assert int(i[0, 0]) == 0
+    finally:
+        obs.set_mode(None)
+        obs.reset()
+
+
+def test_slow_stage_fault_grammar():
+    specs = faultinject.parse("slow@stage:serve.dispatch*3")
+    assert specs[0].kind == "slow" and specs[0].remaining == 3
+    with pytest.raises(ValueError):
+        faultinject.parse("slow@chunk:1")
+
+
+def test_admission_quotas(clustered):
+    from raft_tpu import resilience
+
+    x = clustered[0]
+    with serve.Server(_params(
+            warmup=False, adaptive_probes=False, max_wait_ms=400.0,
+            admission_quotas={"default": 2},
+            max_total_queue_rows=8)) as srv:
+        srv.create_index("default", x, algo="brute_force")
+        srv.search(x[0], 2)                     # compile outside window
+        futs = [srv.submit(x[0], 2), srv.submit(x[1], 2)]
+        with pytest.raises(serve.Overloaded) as ei:
+            srv.submit(x[2], 2)
+        assert ei.value.reason == "quota"
+        assert resilience.classify(ei.value) == resilience.TRANSIENT
+        for f in futs:
+            f.result(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# swap re-derivation (ISSUE-14 satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_swap_rederives_probe_ladder(clustered):
+    """After a same-algo swap to a bigger index, the TOP rung equals
+    the new n_lists — the ladder re-derives, not just the ceiling."""
+    x = clustered[0]
+    rng = np.random.default_rng(31)
+    big = rng.standard_normal((x.shape[0] * 4, DIM)).astype(np.float32)
+    with serve.Server(_params(warmup=False)) as srv:
+        srv.create_index("default", x, algo="ivf_flat")
+        h0 = srv.registry.get("default").handle
+        assert h0.adaptive.ladder[-1] == h0.index.n_lists
+        srv.swap("default", dataset=big, wait=True)
+        h1 = srv.registry.get("default").handle
+        assert h1.index.n_lists > h0.index.n_lists
+        assert h1.adaptive.ladder[-1] == h1.index.n_lists
+        assert h1.adaptive.ladder == tuple(
+            serve.probe_ladder(h1.index.n_lists))
+        # an explicit user n_probes stays the ceiling across swaps
+        srv.swap("default", dataset=x,
+                 search_params=ivf_flat.SearchParams(n_probes=3),
+                 wait=True)
+        h2 = srv.registry.get("default").handle
+        assert h2.adaptive.ladder == (1, 2, 3)
