@@ -228,6 +228,58 @@ def bench_cagra_sift1m(results):
         seconds=s, rows=visited)
 
 
+def bench_cagra_graph_build(results):
+    """Graph-build roofline (ISSUE 15, ROADMAP item 7): time the
+    rebuilt nn-descent at the 1M scale and score it against the
+    gather byte floor — per iteration every node gathers S+K candidate
+    vectors (+ the sampled two-hop ids), so the ideal traffic is
+    ``iters * n * (S+K) * (d*4 + 4)`` bytes against
+    ``iters * n * (S+K) * pair_flops`` FLOPs. The old formulation
+    added ``n*2K*K*4`` bytes of two-hop tensor per iteration on top —
+    deleted by sample-then-gather, which is why it is not in this
+    model (the cost model is the algorithm as implemented)."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import nn_descent
+    from raft_tpu.distance.types import DistanceType, pair_flops
+
+    n, d, deg, iters = 1_000_000, 128, 32, 14
+    # clustered blobs, generated ON DEVICE (tunnel moves host arrays at
+    # ~20 MB/s): the sampled pull-join localizes blobs in ~10-16 rounds
+    # but flat low-intrinsic-dim manifolds crawl at ~0.04
+    # recall/iteration (GRAPH_r15.json sweep, 2026-08-04) — _sift_like
+    # here would publish an iteration-budget artifact, not a build
+    # property (ROADMAP item 7b tracks the convergence-rate work)
+    kc, ka, kn = jax.random.split(jax.random.PRNGKey(5), 3)
+    centers = jax.random.uniform(kc, (1024, d), jnp.float32, -5.0, 5.0)
+    x = (centers[jax.random.randint(ka, (n,), 0, 1024)]
+         + 0.6 * jax.random.normal(kn, (n, d), jnp.float32))
+    x = jax.block_until_ready(x)
+    params = nn_descent.IndexParams(
+        graph_degree=deg, max_iterations=iters,
+        termination_threshold=0.0)
+    t0 = time.time()
+    index = nn_descent.build(params, x)
+    g = np.asarray(index.graph)                 # sync
+    s = time.time() - t0
+    results["graph_build_s"] = round(s, 1)
+    from raft_tpu.neighbors import brute_force
+
+    sub = 500
+    _, want = brute_force.knn(x[:sub], x, deg + 1)
+    want = np.asarray(want)[:, 1:]
+    results["graph_build_recall"] = round(float(np.mean(
+        [len(set(g[i]) & set(want[i])) / deg for i in range(sub)])), 3)
+    K = deg * 3 // 2
+    S = int(params.n_candidates)
+    C = S + K
+    _emit_roofline(
+        results, "graph_build",
+        bytes_moved=iters * n * (C * (d * 4 + 4) + S * 4),
+        flops=iters * n * C * pair_flops(DistanceType.L2Expanded, d),
+        seconds=s, rows=iters * n * C)
+
+
 def bench_ivfpq_deep10m(results):
     import jax
     from raft_tpu.neighbors import ivf_pq
@@ -464,6 +516,10 @@ def main():
             bench_cagra_sift1m(results)
         except Exception as e:  # keep the headline alive on partial failure
             results["cagra_error"] = repr(e)[:200]
+        try:
+            bench_cagra_graph_build(results)
+        except Exception as e:
+            results["graph_build_error"] = repr(e)[:200]
         # the PQ bench needs ~2400s end to end (BASELINE.md measurement);
         # only start it if that fits in what's left of the budget
         if budget_s - (time.time() - t_start) > 2400:

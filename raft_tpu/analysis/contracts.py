@@ -125,6 +125,7 @@ def load_all() -> Dict[str, KernelContract]:
         "raft_tpu.ops.fused_topk",
         "raft_tpu.ops.ivf_scan",
         "raft_tpu.ops.beam_step",
+        "raft_tpu.ops.graph_join",
         "raft_tpu.matrix.select_k",
     ):
         importlib.import_module(mod)

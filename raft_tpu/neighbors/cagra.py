@@ -11,7 +11,14 @@ Design — idiomatic TPU, not a port:
   dataset, batched self-search for ``intermediate_graph_degree`` raw
   neighbors (cagra_build.cuh:103-155), exact ``refine`` re-rank, then
   ``optimize``. An ``nn_descent`` builder is available as the alternative
-  (build_algo, cagra_types.hpp:47).
+  (build_algo, cagra_types.hpp:47) — rebuilt for the memory hierarchy
+  in r15 (sample-then-gather candidates, node-blocked iteration under
+  the OOM ladder, fused local-join kernel): 3.5x faster per iteration
+  than the r2–r3-era formulation at 1M rows on the CPU host with
+  bitwise-identical graphs, per-iteration transients bounded by the
+  ``graph_join_rows`` block (~3.2 GB) instead of the old ``n*2K*K``
+  two-hop tensor (18.4 GB/iteration at that scale) (2026-08-04,
+  GRAPH_r15.json; TPU re-measure queued behind ROADMAP item 1).
 
 * **optimize** keeps the reference's exact semantics (graph_core.cuh
   comment at :360): the detour count of edge A->B at rank k is the number
@@ -188,8 +195,43 @@ jax.tree_util.register_dataclass(
 # override it ("cagra_inline_bytes", see raft_tpu.tuning)
 _INLINE_BUDGET = 6 << 30
 
-# queries per Pallas beam-step grid tile (the kernel's lane dimension)
+# queries per Pallas beam-step grid tile (the kernel's lane dimension);
+# the analytic default — ``_resolve_beam_tile`` consults the dispatch
+# table (op key ``beam_step_tile``) so a live-chip capture adopts tile
+# geometry with no code change, like ``fused_topk_tile``
 _QUERY_TILE = 128
+
+
+def _resolve_beam_tile(m: int, itopk: int, width: int, deg: int, d: int,
+                       ip: bool) -> int:
+    """Query-tile (lane) geometry for the fused beam kernel, dispatched
+    under the ``beam_step_tile`` op key (docs/dispatch_tuning.md).
+    Candidates are ``tuning.BEAM_STEP_TILES`` values whose VMEM
+    footprint (ops/beam_step.py:beam_step_vmem_bytes) fits ~half of
+    per-core VMEM; winner strings carry the tile (``pallas:<g>``). The
+    analytic fallback keeps the measured r3 default of 128."""
+    from raft_tpu import tuning
+    from raft_tpu.ops.beam_step import beam_step_vmem_bytes
+
+    budget = 8 * 1024 * 1024
+    cands = [
+        f"pallas:{g}" for g in tuning.BEAM_STEP_TILES
+        if beam_step_vmem_bytes(g, itopk, width, deg, d, ip) <= budget
+    ]
+    if not cands:
+        return _QUERY_TILE
+    fallback = f"pallas:{_QUERY_TILE}"
+    if fallback not in cands:
+        fallback = cands[0]
+    w = tuning.choose(
+        "beam_step_tile",
+        {"m": int(m), "itopk": int(itopk), "deg": int(deg), "d": int(d)},
+        cands, fallback,
+    )
+    try:
+        return int(str(w).split(":", 1)[1])
+    except (IndexError, ValueError):
+        return _QUERY_TILE
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -880,7 +922,8 @@ def _beam_search(
     return _finalize(fd, fi, q32, metric)
 
 
-@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13, 15))
+@functools.partial(jax.jit,
+                   static_argnums=(7, 8, 9, 10, 11, 12, 13, 15, 16))
 def _beam_search_pallas(
     queries,       # [m0, d] f32
     dataset,       # [n, d] (exact rescore)
@@ -898,6 +941,7 @@ def _beam_search_pallas(
     interpret: bool = False,
     filter_bits=None,
     filter_nbits: int = 0,
+    g: int = 0,    # query tile; 0 = the analytic _QUERY_TILE default
 ):
     """Fused beam search: XLA gathers the packed int32 neighbor rows
     (row gathers are XLA's strength; the int32 fused row measured ~7x
@@ -930,7 +974,7 @@ def _beam_search_pallas(
         # buffer. Costs one [width*deg, m] penalty gather + merge per
         # iteration — filtered mode only.
         pen = _filter_penalty_vector(filter_bits, filter_nbits, n, jnp.inf)
-    G = _QUERY_TILE
+    G = int(g) or _QUERY_TILE
     m = -(-m0 // G) * G
     q32 = jnp.pad(queries.astype(jnp.float32), ((0, m - m0), (0, 0)))
     two_scale = (1.0 if ip else 2.0) * code_scale
@@ -1120,6 +1164,12 @@ def search(
                     "scan_impl=%r scores int8 traversal distances; "
                     "compute_dtype must stay 'auto' (got %r)" % (impl, dtype)
                 )
+            g = _resolve_beam_tile(
+                int(queries.shape[0]), itopk, width,
+                int(index.graph.shape[1]), int(index.dim),
+                index.metric == DistanceType.InnerProduct,
+            )
+            _sp.set(beam_tile=g)
             return _beam_search_pallas(
                 queries,
                 index.dataset,
@@ -1137,6 +1187,7 @@ def search(
                 impl == "pallas_interpret",
                 fbits,
                 fnbits,
+                g,
             )
         return _beam_search(
             queries,

@@ -8,6 +8,7 @@ round-trip through HBM.
 """
 
 from raft_tpu.ops.fused_topk import fused_topk
+from raft_tpu.ops.graph_join import graph_local_join
 from raft_tpu.ops.ivf_scan import fused_list_scan_topk
 
-__all__ = ["fused_list_scan_topk", "fused_topk"]
+__all__ = ["fused_list_scan_topk", "fused_topk", "graph_local_join"]

@@ -56,6 +56,28 @@ def _a128(v: int) -> int:
     return -(-v // 128) * 128
 
 
+def beam_step_vmem_bytes(g: int, L: int, width: int, deg: int, d: int,
+                         ip: bool = False) -> int:
+    """Per-grid-step VMEM bytes of the packed-scoring beam kernel at
+    query tile ``g``: in/out blocks + the [C, g] decode scratch + the
+    live [LL, g] sort pair. The eligibility rule behind the
+    ``beam_step_tile`` dispatch candidates (cagra._resolve_beam_tile) —
+    a tile only races when this fits ~half of per-core VMEM."""
+    dw = deg * (d // 4)
+    W = packed_row_layout(deg, d, ip)[3]
+    C = width * deg
+    LL = _next_pow2(max(L + C, 2))
+    blocks = (
+        6 * L * g * 4            # buffer state in + out (d, i, e)
+        + g * 4 * dw * 2         # qrep (bf16)
+        + g * width * W * 4      # packed rows (flattened)
+        + 2 * width * g * 4      # parents in + out
+        + 2 * C * g * 4          # cd/ci decode scratch
+    )
+    live = 2 * LL * g * 4        # the sort network's key + payload
+    return blocks + live
+
+
 def packed_row_layout(deg: int, d: int, ip: bool = False):
     """THE single definition of the packed inline row layout, shared by
     the builder (cagra._pack_tables), the HBM-budget check
@@ -323,17 +345,39 @@ def beam_merge_step(
 
     Returns (buf_d, buf_i, buf_e, parents [width, m]); the output
     buffer is distance-sorted, deduplicated, truncated to L slots, with
-    the picked parents marked explored. m must be a multiple of ``g``.
+    the picked parents marked explored. A query count off the ``g``
+    lane tile is padded up with inert columns (empty buffer, invalid
+    candidates/parents) and sliced back off the outputs — callers no
+    longer need to pre-round m.
 
     ``emit_cands`` (packed-scoring mode only) additionally returns the
     iteration's raw scored candidates (cand_d [C, m] f32, cand_i
     [C, m] i32) so filtered search can side-accumulate valid results
     outside the kernel while traversal itself stays unfiltered.
     """
-    L, m = buf_d.shape
+    L, m0 = buf_d.shape
     scored = cand_d is not None
-    if m % g:
-        raise ValueError(f"m={m} must be a multiple of the query tile g={g}")
+    m = -(-m0 // g) * g
+    if m != m0:
+        # tail columns: empty explored buffer + invalid candidates (and
+        # parents -1, which mask their whole candidate block in packed
+        # mode), so pad lanes compute nothing and pick no parents
+        pc = m - m0
+        buf_d = jnp.pad(buf_d, ((0, 0), (0, pc)),
+                        constant_values=jnp.inf)
+        buf_i = jnp.pad(buf_i, ((0, 0), (0, pc)),
+                        constant_values=_INVALID)
+        buf_e = jnp.pad(buf_e, ((0, 0), (0, pc)), constant_values=1)
+        if scored:
+            cand_d = jnp.pad(cand_d, ((0, 0), (0, pc)),
+                             constant_values=jnp.inf)
+            cand_i = jnp.pad(cand_i, ((0, 0), (0, pc)),
+                             constant_values=_INVALID)
+        else:
+            qrep = jnp.pad(qrep, ((0, pc), (0, 0), (0, 0)))
+            pack = jnp.pad(pack, ((0, pc), (0, 0), (0, 0)))
+            parents = jnp.pad(parents, ((0, 0), (0, pc)),
+                              constant_values=_INVALID)
     nsteps = m // g
 
     col = lambda i: (0, i)
@@ -396,7 +440,7 @@ def beam_merge_step(
             jax.ShapeDtypeStruct((C, m), jnp.float32),
             jax.ShapeDtypeStruct((C, m), jnp.int32),
         ]
-    return pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid=(nsteps,),
         in_specs=in_specs,
@@ -405,6 +449,9 @@ def beam_merge_step(
         out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
+    if m != m0:
+        outs = tuple(o[:, :m0] for o in outs)
+    return outs
 
 
 # ---------------------------------------------------------------------------
@@ -441,12 +488,14 @@ def _beam_case_derive(case: dict) -> dict:
     return case
 
 
+from raft_tpu.tuning import BEAM_STEP_TILES  # noqa: E402
+
 kernel_contract(
     "beam_step",
     module=__name__,
     entry="beam_merge_step",
     driver="raft_tpu.analysis.contract_drivers:drive_beam_step",
-    tail_rows="rejected",        # m % g and W % 128 raise at the door
+    tail_rows="padded",          # m % g pads inert lanes, sliced off
     k_range=(1, 1),
     k_key=None,                  # no k: the buffer length L is static
     dtypes=("float32",),
@@ -458,23 +507,60 @@ kernel_contract(
             "qrep": ("m", 4, "dwq"), "pack": ("m", "width", "W"),
             "parents": ("width", "m")},
     derive=_beam_case_derive,
-    extra_cases=(
-        # scored arm: the merge/dedup/pick pipeline vs the numpy oracle
-        {"scored": True, "L": 16, "C": 32, "m": 128, "width": 4},
-        {"scored": True, "L": 8, "C": 8, "m": 128, "width": 2},
-        {"scored": True, "L": 16, "C": 32, "m": 256, "width": 4,
-         "window": 3},
-        # non-pow2 buffer + candidate counts: LL pads internally
-        {"scored": True, "L": 12, "C": 20, "m": 128, "width": 3},
-        # packed-scoring arm: static geometry bindings (scratch, packed
-        # row blocks); dynamics pinned by test_beam_step/test_cagra
-        {"scored": False, "deg": 16, "d": 32, "L": 16, "m": 128,
-         "width": 4, "static_only": True},
-        {"scored": False, "deg": 16, "d": 32, "L": 16, "m": 128,
-         "width": 4, "emit_cands": True, "ip": True,
-         "static_only": True},
+    extra_cases=tuple(
+        [
+            # scored arm: merge/dedup/pick pipeline vs the numpy oracle
+            {"scored": True, "L": 16, "C": 32, "m": 128, "width": 4},
+            {"scored": True, "L": 8, "C": 8, "m": 128, "width": 2},
+            {"scored": True, "L": 16, "C": 32, "m": 256, "width": 4,
+             "window": 3},
+            # non-pow2 buffer + candidate counts: LL pads internally
+            {"scored": True, "L": 12, "C": 20, "m": 128, "width": 3},
+            # tail rows: m off the lane tile pads inert columns
+            {"scored": True, "L": 16, "C": 32, "m": 100, "width": 4},
+            # k/degree boundary cases: one candidate, one parent; a
+            # tiny buffer against a wide candidate block
+            {"scored": True, "L": 16, "C": 1, "m": 128, "width": 1},
+            {"scored": True, "L": 2, "C": 24, "m": 128, "width": 2,
+             "window": 1},
+            # packed-scoring arm, DRIVEN: in-kernel int8 word decode +
+            # scoring vs the same arithmetic through XLA, then the
+            # merge oracle (judged per-id within bf16 rounding)
+            {"scored": False, "deg": 8, "d": 32, "L": 16, "m": 128,
+             "width": 2},
+            {"scored": False, "deg": 8, "d": 32, "L": 8, "m": 128,
+             "width": 3, "ip": True},
+            {"scored": False, "deg": 16, "d": 64, "L": 16, "m": 128,
+             "width": 4, "emit_cands": True},
+            # packed arm, tail rows: padded parents mask their blocks
+            {"scored": False, "deg": 8, "d": 32, "L": 16, "m": 90,
+             "width": 2},
+            # deg/d geometry boundaries (static bindings): minimal
+            # packed row (every region one 128-pad), and a wide row
+            # where the id region crosses its own 128 boundary
+            {"scored": False, "deg": 4, "d": 4, "L": 16, "m": 128,
+             "width": 4, "static_only": True},
+            {"scored": False, "deg": 32, "d": 64, "L": 32, "m": 256,
+             "width": 4, "static_only": True},
+        ]
+        + [
+            # every dispatchable query tile (op key beam_step_tile;
+            # winner strings carry g) gets a geometry case, so the
+            # static audit covers each injectable lane tile
+            {"scored": False, "deg": 16, "d": 32, "L": 64, "m": 2 * g,
+             "g": g, "width": 4, "static_only": True}
+            for g in BEAM_STEP_TILES
+        ]
+        + [
+            {"scored": True, "L": 16, "C": 32, "m": 2 * g, "g": g,
+             "width": 4}
+            for g in BEAM_STEP_TILES
+        ]
     ),
     notes="all per-query state rides TRANSPOSED [slots, m] so the sort "
-          "axis is the sublane axis; m must be a multiple of g (the "
-          "kernel raises otherwise — tail_rows='rejected').",
+          "axis is the sublane axis; m off the g lane tile is padded "
+          "with inert columns and sliced back (tail_rows='padded'); "
+          "the packed arm's int8 word decode is driven against the "
+          "same arithmetic through XLA (bf16-rounded products, f32 "
+          "accumulation).",
 )

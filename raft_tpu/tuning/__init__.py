@@ -54,6 +54,22 @@ FUSED_TOPK_TILES = (512, 1024, 2048)
 # must cover even though it is never raced by name
 FUSED_TOPK_TILE_FLOOR = 256
 
+# Canonical node-tile candidates for the fused nn-descent local-join
+# kernel (ops/graph_join.py, op key ``graph_join``; winner strings
+# ``pallas:<tile_b>``). Same one-home rule as FUSED_TOPK_TILES: the
+# dispatch resolver (neighbors.nn_descent._resolve_join_impl), the
+# microbench race (bench_graph_join) and the graft-kern static audit
+# (kernel_shape_candidates + the contract's per-tile cases) all consume
+# this tuple — a tile added here is raced, dispatched, and audited.
+GRAPH_JOIN_TILES = (8, 16, 32)
+
+# Canonical query-tile (lane) candidates for the fused CAGRA beam-step
+# kernel (ops/beam_step.py, op key ``beam_step_tile``; winner strings
+# ``pallas:<g>``) — cagra._resolve_beam_tile dispatches over them,
+# bench_beam_step races them, and the beam contract carries one static
+# geometry case per value so the audit covers every injectable tile.
+BEAM_STEP_TILES = (128, 256)
+
 # ops cheap enough to measure synchronously at first use in "measure"
 # mode; scan-path ops need an index built around them — capture those
 # with scripts/capture_dispatch_tables.py instead
@@ -235,34 +251,53 @@ def fused_topk_candidate_impls(k: int, approx_ok: bool) -> List[str]:
     return out
 
 
+def _winner_tiles(table, op: str, prefix: str) -> set:
+    """Integer tile suffixes of an op's ``<prefix><tile>`` winner
+    strings in an active table (``fused_exact:1024``, ``pallas:16``)."""
+    tiles: set = set()
+    if table is None:
+        return tiles
+    try:
+        for entry in table.data.get("ops", {}).get(op, {}).get(
+                "entries", []):
+            w = str(entry.get("winner", ""))
+            if w.startswith(prefix):
+                tail = w[len(prefix):].split(":", 1)[0]
+                if tail.isdigit():
+                    tiles.add(int(tail))
+    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow malformed table entries only shrink the audited domain to the canonical set
+        pass
+    return tiles
+
+
 def kernel_shape_candidates() -> Dict[str, tuple]:
     """Shape-parameter domains reachable through ``tuning.choose``
     winners, keyed by kernel parameter NAME — consumed by the
     graft-kern static verifier (docs/static_analysis.md §engine-4) so
     table-dispatched tile geometry is audited at every value it can
     take, not just the analytic default. Includes any extra tiles an
-    active site-captured table carries in its ``fused_topk_tile``
-    winner strings (``fused_<variant>:<tile>``)."""
+    active site-captured table carries in its ``fused_topk_tile`` /
+    ``graph_join`` / ``beam_step_tile`` winner strings."""
+    t = get_table()
     tiles = set(FUSED_TOPK_TILES)
     tiles.add(FUSED_TOPK_TILE_FLOOR)          # analytic halving floor
-    t = get_table()
-    if t is not None:
-        try:
-            for entry in t.data.get("ops", {}).get(
-                    "fused_topk_tile", {}).get("entries", []):
-                w = str(entry.get("winner", ""))
-                if w.startswith("fused_") and ":" in w:
-                    tail = w.split(":", 1)[1].split(":", 1)[0]
-                    if tail.isdigit():
-                        tiles.add(int(tail))
-        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow malformed table entries only shrink the audited domain to the canonical set
-            pass
+    for variant in ("fused_exact:", "fused_fold:"):
+        tiles |= _winner_tiles(t, "fused_topk_tile", variant)
+    join_tiles = set(GRAPH_JOIN_TILES) | _winner_tiles(
+        t, "graph_join", "pallas:")
+    beam_tiles = set(BEAM_STEP_TILES) | _winner_tiles(
+        t, "beam_step_tile", "pallas:")
     return {
         "tile_n": tuple(sorted(tiles)),
         # tile_geometry rounds the query tile to a pow2 in [8, 128];
         # the corners bound both the VMEM max and the alignment screen
         "tile_q": (8, 128),
         "variant": ("exact", "fold"),
+        # graph_join node tiles / beam_step query tiles: the contracts
+        # pin the canonical values in explicit cases; these domains let
+        # a site-captured winner outside them still enter the audit
+        "tile_b": tuple(sorted(join_tiles)),
+        "g": tuple(sorted(beam_tiles)),
     }
 
 
@@ -309,9 +344,10 @@ def budget(name: str, default: int) -> int:
 
 
 __all__ = [
-    "DispatchTable", "FUSED_TOPK_TILES", "FUSED_TOPK_TILE_FLOOR",
-    "MEASURABLE_INLINE", "backend_name", "budget", "choose",
-    "fused_topk_candidate_impls", "get_table", "kernel_shape_candidates",
-    "mode", "record_budget", "reload", "runtime_budget", "set_mode",
-    "set_table_path", "table_path", "tables_dir",
+    "BEAM_STEP_TILES", "DispatchTable", "FUSED_TOPK_TILES",
+    "FUSED_TOPK_TILE_FLOOR", "GRAPH_JOIN_TILES", "MEASURABLE_INLINE",
+    "backend_name", "budget", "choose", "fused_topk_candidate_impls",
+    "get_table", "kernel_shape_candidates", "mode", "record_budget",
+    "reload", "runtime_budget", "set_mode", "set_table_path",
+    "table_path", "tables_dir",
 ]

@@ -39,6 +39,17 @@ cpp/scripts/heuristics/select_k). Ops:
     keeps the finest rung whenever it fits); i8's time is captured for
     the record.
 
+``graph_join``
+    nn-descent local-join backends raced at one join-block shape: the
+    XLA einsum + keep-min merge vs the fused Pallas kernel per node
+    tile (``pallas:8`` … ``pallas:32``, ops/graph_join.py) — the
+    winner string carries the tile, so a live-chip capture adopts
+    node-tile geometry with no code change (ISSUE 15).
+``beam_step_tile``
+    the fused CAGRA beam-step kernel's query-tile (lane) geometry
+    raced over ``tuning.BEAM_STEP_TILES`` on real packed inline rows;
+    TPU-only by default (the kernel's compile target), winner strings
+    ``pallas:<g>`` consumed by ``cagra._resolve_beam_tile``.
 ``serve_service``
     end-to-end ``ivf_flat.search`` medians per (bucket, probe-rung)
     shape — not a dispatch race but a TIMING table: the serve layer's
@@ -310,6 +321,123 @@ def bench_fused_topk(key: Dict, candidates: Optional[List[str]] = None,
     return times
 
 
+def bench_graph_join(key: Dict, candidates: Optional[List[str]] = None,
+                     reps: int = _DEF_REPS,
+                     interpret: bool = False) -> Dict[str, float]:
+    """Race the nn-descent local-join backends at ``key``
+    ({rows, K, S, d}): the XLA einsum + keep-min merge ("xla") vs the
+    fused Pallas kernel per node tile ("pallas:8" ... "pallas:32",
+    ops/graph_join.py) — candidate names are nn_descent's join impl
+    strings, so the captured winner IS the dispatch answer and a
+    live-chip capture adopts node-tile geometry with no code change.
+    The workload is one join block at the real shape (current lists +
+    sampled candidates + the reverse slab), gathers included — both
+    arms pay the candidate-vector gather, so the race isolates the
+    score+merge transients the kernel removes. ``interpret`` runs the
+    kernel in interpret mode (CPU debug-only numbers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.nn_descent import _join_block, _make_rev
+
+    rows = int(key.get("rows", 4096))
+    K = int(key.get("K", 64))
+    S = int(key.get("S", 128))
+    d = int(key.get("d", 64))
+    n = 2 * rows            # join block over half the node range
+    if candidates is None:
+        from raft_tpu.tuning import GRAPH_JOIN_TILES
+
+        candidates = ["xla"] + [f"pallas:{t}" for t in GRAPH_JOIN_TILES]
+    rng = np.random.default_rng(23)
+    data = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    norms = jnp.sum(data * data, axis=1)
+    graph_i = jnp.asarray(
+        rng.integers(0, n, (n, K)).astype(np.int32))
+    graph_d = jnp.asarray(
+        rng.standard_normal((n, K)).astype(np.float32) ** 2)
+    rev_i = jax.block_until_ready(_make_rev(graph_i))
+    pool = jnp.concatenate([graph_i, rev_i], axis=1)
+    cols = jnp.asarray(rng.integers(0, 2 * K * K, S).astype(np.int32))
+    start0 = jnp.int32(0)
+    times: Dict[str, float] = {}
+    for impl in candidates:
+        kind, _, tile = impl.partition(":")
+        if kind.startswith("pallas") and interpret:
+            kind = "pallas_interpret"
+        try:
+            times[impl] = _median_ms(
+                lambda kind=kind, tile=tile: _join_block(
+                    data, norms, graph_d, graph_i, pool, rev_i, cols,
+                    start0, rows=rows, ip=False, impl=kind,
+                    tile_b=int(tile) if tile else 0), reps)
+        except Exception:  # noqa: BLE001 - impl unavailable on backend
+            continue
+    return times
+
+
+def bench_beam_step(key: Dict, candidates: Optional[List[str]] = None,
+                    reps: int = _DEF_REPS,
+                    interpret: bool = False) -> Dict[str, float]:
+    """Race the fused beam-step kernel's query-tile geometry at ``key``
+    ({m, itopk, width, deg, d}) — op key ``beam_step_tile``, candidate
+    names ``pallas:<g>`` over ``tuning.BEAM_STEP_TILES`` (the lane tile
+    cagra._resolve_beam_tile dispatches): one packed-scoring
+    beam_merge_step call per tile on real inline rows, so the captured
+    winner adopts tile geometry with no code change."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops.beam_step import beam_merge_step, beam_step_vmem_bytes
+
+    m = int(key.get("m", 1024))
+    L = int(key.get("itopk", 64))
+    width = int(key.get("width", 4))
+    deg = int(key.get("deg", 32))
+    d = int(key.get("d", 64))
+    n = 20_000
+    if candidates is None:
+        from raft_tpu.tuning import BEAM_STEP_TILES
+
+        candidates = [
+            f"pallas:{g}" for g in BEAM_STEP_TILES
+            if beam_step_vmem_bytes(g, L, width, deg, d) <= 8 << 20
+        ]
+    rng = np.random.default_rng(29)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    graph = rng.integers(0, n, (n, deg)).astype(np.int32)
+    idx = cagra.from_graph(x, graph, "sqeuclidean")
+    if idx.nbr_pack is None:
+        return {}
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    qs = jnp.asarray(q * 2.0 * idx.code_scale, jnp.bfloat16)
+    qperm = jnp.transpose(qs.reshape(m, d // 4, 4), (0, 2, 1))
+    qrep = jnp.tile(qperm, (1, 1, deg))
+    parents = jnp.asarray(rng.integers(0, n, (width, m)).astype(np.int32))
+    pack = idx.nbr_pack[jnp.maximum(parents.T, 0)]
+    bd = jnp.asarray(np.sort(
+        rng.standard_normal((L, m)).astype(np.float32) ** 2, axis=0))
+    bi = jnp.asarray(rng.integers(0, n, (L, m)).astype(np.int32))
+    be = jnp.zeros((L, m), jnp.int32)
+    jax.block_until_ready((qrep, pack, bd))
+    times: Dict[str, float] = {}
+    for impl in candidates:
+        try:
+            g = int(impl.split(":", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        try:
+            times[impl] = _median_ms(
+                lambda g=g: beam_merge_step(
+                    bd, bi, be, qrep=qrep, pack=pack, parents=parents,
+                    deg=deg, d=d, width=width, g=g,
+                    interpret=interpret), reps)
+        except Exception:  # noqa: BLE001 - tile unavailable on backend
+            continue
+    return times
+
+
 def _pq_oracle_ids(data, queries, k: int):
     """Exact L2 top-k ids for the shared pq_scan workload (the recall
     judge for the matched-recall race below)."""
@@ -521,6 +649,31 @@ def extract_grid(quick: bool = True) -> List[Dict]:
              "nb": 16} for k in ks]
 
 
+def graph_join_grid(quick: bool = True) -> List[Dict]:
+    """(rows, K, S, d) grid for the graph_join race — the nn-descent
+    block shapes CAGRA builds dispatch at (K = intermediate degree,
+    S = n_candidates), plus the small-K regime where XLA's batched
+    einsum can win back."""
+    if quick:
+        return [{"rows": 4096, "K": 64, "S": 128, "d": 64},
+                {"rows": 4096, "K": 96, "S": 128, "d": 128}]
+    return [{"rows": r, "K": K, "S": S, "d": d}
+            for r in (4096, 16384)
+            for (K, S) in ((32, 64), (64, 128), (96, 128))
+            for d in (64, 128)]
+
+
+def beam_step_grid(quick: bool = True) -> List[Dict]:
+    """(m, itopk, width, deg, d) grid for the beam_step_tile race —
+    the serve bucket ladder's batch range at the CAGRA search shapes."""
+    if quick:
+        return [{"m": 1024, "itopk": 64, "width": 4, "deg": 32, "d": 64}]
+    return [{"m": m, "itopk": L, "width": 4, "deg": 32, "d": d}
+            for m in (256, 1024, 10240)
+            for L in (64, 128)
+            for d in (64, 128)]
+
+
 def fused_topk_grid(quick: bool = True) -> List[Dict]:
     """(m, n, d, k) grid for the brute-force backend race — the
     north-star bruteforce_sift10k shape's neighborhood plus the large-k
@@ -620,7 +773,8 @@ def capture(backend: Optional[str] = None, quick: bool = True,
 
     want = set(ops) if ops else {"select_k", "merge_topk", "ivf_scan",
                                  "pq_scan", "ivf_scan_extract",
-                                 "fused_topk_tile", "serve_service"}
+                                 "fused_topk_tile", "serve_service",
+                                 "graph_join", "beam_step_tile"}
     if "select_k" in want:
         for key in select_grid(quick):
             times = bench_select(key, reps=reps)
@@ -669,6 +823,25 @@ def capture(backend: Optional[str] = None, quick: bool = True,
             if times:
                 log(f"fused_topk_tile {key} -> "
                     f"{t.record('fused_topk_tile', key, times)} {times}")
+    # nn-descent local-join backends: the xla arm races everywhere; the
+    # fused-kernel tiles need the compile target (or --interpret for
+    # CPU debug numbers) — same rule as the other kernel ops
+    if "graph_join" in want:
+        for key in graph_join_grid(quick):
+            cands = (None if on_tpu or include_interpret
+                     else ["xla"])
+            times = bench_graph_join(key, cands, reps=reps,
+                                     interpret=not on_tpu)
+            if times:
+                log(f"graph_join {key} -> "
+                    f"{t.record('graph_join', key, times)} {times}")
+    # beam query-tile geometry: kernel-only op, TPU (or --interpret)
+    if "beam_step_tile" in want and (on_tpu or include_interpret):
+        for key in beam_step_grid(quick):
+            times = bench_beam_step(key, reps=reps, interpret=not on_tpu)
+            if times:
+                log(f"beam_step_tile {key} -> "
+                    f"{t.record('beam_step_tile', key, times)} {times}")
     if "serve_service" in want:
         # single-candidate op: the entry's TIMES are the product (the
         # serve deadline machinery reads the per-(bucket, rung) median

@@ -10,7 +10,10 @@ ivf_scan_extract (in-kernel extraction arms incl. the unextracted
 fold), fused_topk_tile (brute-force scan vs fused kernel per
 variant/row-tile), pq_scan (i8/i4/pq4/rabitq cache kinds — the rabitq
 arm races its whole rerank pipeline at matched recall, and arms that
-cannot hit the recall band are filtered before timing), and
+cannot hit the recall band are filtered before timing),
+graph_join (nn-descent local join: XLA einsum+merge vs the fused
+kernel per node tile, ISSUE 15), beam_step_tile (the beam kernel's
+query-tile geometry over real packed rows), and
 serve_service (per-(bucket, probe-rung) end-to-end service medians the
 serve deadline machinery reads, ISSUE 14) — over a shape
 grid, plus the environment byte budgets, and writes
@@ -49,9 +52,10 @@ def main(argv=None):
     ap.add_argument("--ops", default=None,
                     help="comma list: select_k,merge_topk,ivf_scan,"
                          "pq_scan,ivf_scan_extract,fused_topk_tile,"
-                         "serve_service (kernel arms need a TPU, or "
-                         "--interpret on CPU). A subset capture MERGES "
-                         "into the existing table at --out instead of "
+                         "graph_join,beam_step_tile,serve_service "
+                         "(kernel arms need a TPU, or --interpret on "
+                         "CPU). A subset capture MERGES into the "
+                         "existing table at --out instead of "
                          "clobbering the other ops' entries")
     ap.add_argument("--interpret", action="store_true",
                     help="on CPU, also time the Pallas kernels in "

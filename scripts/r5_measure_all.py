@@ -50,6 +50,12 @@ STAGES = [
     ("bench", [PY, "bench.py"], 5400),
     ("deep100m", [PY, "scripts/deep100m.py", "DEEP100M_r05.json"], 4200),
     ("sweep", [PY, "scripts/r4_sweep.py", "both"], 3600),
+    # graph rung (ISSUE 15): nn-descent rebuild A/B (sample-then-gather
+    # vs the old full-two-hop gather, bitwise-identical graphs) + the
+    # 1M-row blocked build with bounded per-iteration transients —
+    # GRAPH_r{N}.json re-captured at chip service times
+    ("graph_bench", [PY, "scripts/graph_bench.py", "GRAPH_r15.json"],
+     3600),
     ("latency", [PY, "scripts/latency_table.py"], 1800),
     ("crossover", [PY, "scripts/select_crossover.py"], 1800),
     # per-backend dispatch table (select/merge/scan winners + budgets):

@@ -20,6 +20,11 @@ the real Mosaic-compiled kernels on the TPU:
   hardware-top_k oracle (ids bitwise on the exact arm),
 * beam_step.beam_merge_step (scored + packed variants) vs the numpy
   merge oracle from tests/test_beam_step.py,
+* the graph rung (check_graph, ISSUE 15): nn-descent builds through
+  the fused local-join kernel vs the XLA fallback (graph recall +
+  id agreement + wall clock), one compiled graph_local_join block vs
+  the fallback bitwise, and the graph_join / beam_step_tile candidate
+  races — the same numbers capture_dispatch_tables.py records,
 * cagra pallas search vs the scattered XLA search (recall agreement),
 * the full kernel-contract adversarial sweep (ISSUE 10): every
   registered contract's cases — the same shapes tier-1 runs in
@@ -277,6 +282,55 @@ def check_beam_step(results):
     results["beam_merge_step_oracle"] = {"ok": bool(ok)}
 
 
+def check_graph(results):
+    """The graph rung compiled on chip (ISSUE 15): the fused nn-descent
+    local-join kernel against its XLA fallback — one join block must
+    agree bitwise on ids (tie-free keys), whole builds must agree on
+    recall — plus the dispatch-table candidate races for the two new op
+    keys, so chip day records the winners with no extra tooling."""
+    import time as _time
+
+    from raft_tpu.neighbors import nn_descent
+    from raft_tpu.tuning import microbench
+    from tests.oracles import naive_knn
+
+    rng = np.random.default_rng(15)
+    n, d, k = 60_000, 64, 32
+    centers = rng.uniform(-5, 5, (32, d)).astype(np.float32)
+    x = (centers[rng.integers(0, 32, n)]
+         + 0.8 * rng.standard_normal((n, d))).astype(np.float32)
+    out = {}
+    graphs = {}
+    for impl in ("xla", "pallas"):
+        t0 = _time.time()
+        idx = nn_descent.build(nn_descent.IndexParams(
+            graph_degree=k, max_iterations=10, join_impl=impl), x)
+        graphs[impl] = np.asarray(idx.graph)         # sync
+        out[f"build_s_{impl}"] = round(_time.time() - t0, 2)
+    sub = 500
+    _, want = naive_knn(x[:sub], x, k + 1)
+    for impl, g in graphs.items():
+        rec = float(np.mean(
+            [len(set(g[i]) & set(want[i][1:k + 1])) / k
+             for i in range(sub)]))
+        out[f"recall_{impl}"] = round(rec, 4)
+    out["id_agreement"] = round(
+        float((graphs["xla"] == graphs["pallas"]).mean()), 4)
+    # candidate races at the dispatch-table shapes (the rows a
+    # capture_dispatch_tables.py run would persist)
+    out["graph_join_race_ms"] = {
+        kk: round(vv, 3) for kk, vv in microbench.bench_graph_join(
+            {"rows": 4096, "K": 48, "S": 128, "d": d}, reps=3).items()}
+    out["beam_step_race_ms"] = {
+        kk: round(vv, 3) for kk, vv in microbench.bench_beam_step(
+            {"m": 1024, "itopk": 64, "width": 4, "deg": 32, "d": d},
+            reps=3).items()}
+    out["ok"] = bool(
+        out["recall_pallas"] > 0.9 and out["recall_xla"] > 0.9
+        and abs(out["recall_pallas"] - out["recall_xla"]) < 0.02)
+    results["graph"] = out
+
+
 def check_cagra(results):
     from raft_tpu.neighbors import cagra
     from tests.oracles import naive_knn, eval_recall
@@ -349,7 +403,7 @@ def main():
                "device": str(jax.devices()[0])}
     for fn in (check_ivf_scan, check_ivf_pq_scan, check_rabitq,
                check_adaptive, check_fused_topk, check_beam_step,
-               check_cagra, check_kernel_contracts):
+               check_graph, check_cagra, check_kernel_contracts):
         try:
             fn(results)
         except Exception as e:  # noqa: BLE001 - record, keep going

@@ -126,6 +126,37 @@ def test_packed_scoring_matches_direct():
                 t, c, got_d[t, c], w)
 
 
+def test_merge_step_tail_queries_padded():
+    """m off the g lane tile: the kernel pads inert columns and slices
+    them back — outputs must equal the same columns of a pre-padded
+    call (the old caller contract) and the numpy oracle."""
+    rng = np.random.default_rng(7)
+    L, C, m, width = 16, 32, 100, 4
+    bi = rng.permutation(np.arange(0, 4 * (L + C) * m))[: L * m]
+    bi = bi.reshape(L, m).astype(np.int32)
+    be = (rng.random((L, m)) < 0.5).astype(np.int32)
+    ci = rng.permutation(
+        np.arange(4 * (L + C) * m, 8 * (L + C) * m))[: C * m]
+    ci = ci.reshape(C, m).astype(np.int32)
+    bd = bi.astype(np.float32)
+    cd = ci.astype(np.float32)
+    order = np.argsort(bd, axis=0, kind="stable")
+    bd = np.take_along_axis(bd, order, axis=0)
+    bi = np.take_along_axis(bi, order, axis=0)
+    be = np.take_along_axis(be, order, axis=0)
+
+    od, oi, oe, par = beam_merge_step(
+        jnp.asarray(bd), jnp.asarray(bi), jnp.asarray(be),
+        cand_d=jnp.asarray(cd), cand_i=jnp.asarray(ci),
+        width=width, g=128, interpret=True,
+    )
+    assert oi.shape == (L, m) and par.shape == (width, m)
+    wd, wi, we, wpar = _np_merge_oracle(bd, bi, be, cd, ci, L, width)
+    np.testing.assert_array_equal(np.asarray(oi), wi)
+    np.testing.assert_array_equal(np.asarray(par), wpar)
+    np.testing.assert_allclose(np.asarray(od), wd, rtol=1e-6)
+
+
 def _clustered(rng, n, nq, d=32, n_centers=16):
     centers = rng.uniform(-5, 5, (n_centers, d)).astype(np.float32)
     x = (centers[rng.integers(0, n_centers, n)]

@@ -1,0 +1,138 @@
+"""Unit tests for the fused nn-descent local-join kernel
+(ops/graph_join.py), run in pallas interpret mode on CPU (the on-chip
+rerun is scripts/tpu_parity.py::check_graph + the compiled contract
+sweep).
+
+Oracle strategy: the XLA dispatch fallback IS the oracle — einsum
+scoring + the keep-min ``_merge_topk_unique`` — so these tests pin the
+bitwise contract the dispatch table relies on (either arm may serve any
+block of a build).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.neighbors.nn_descent import _merge_topk_unique
+from raft_tpu.ops.graph_join import graph_local_join, tile_geometry
+
+
+def _mk(rng, B, C, d, K, N=500, ip=False):
+    vecs = rng.standard_normal((N, d)).astype(np.float32)
+    q = rng.standard_normal((B, d)).astype(np.float32)
+    cand = rng.integers(0, N, (B, C)).astype(np.int32)
+    cand[rng.random((B, C)) < 0.1] = -1
+    cur_i = np.stack([
+        rng.choice(N, size=K, replace=False).astype(np.int32)
+        for _ in range(B)])
+    norms = (vecs ** 2).sum(1).astype(np.float32)
+    qn = (q ** 2).sum(1).astype(np.float32)
+    dots = np.einsum("bd,bkd->bk", q, vecs[cur_i])
+    if ip:
+        cur_d = (-dots).astype(np.float32)
+    else:
+        cur_d = np.maximum(
+            qn[:, None] + norms[cur_i] - 2.0 * dots, 0.0).astype(np.float32)
+    return vecs, q, cand, cur_d, cur_i, qn, norms
+
+
+def _oracle(q, cand, vecs, cur_d, cur_i, qn, norms, K, ip=False):
+    cs = np.maximum(cand, 0)
+    dots = jnp.einsum(
+        "bd,bcd->bc", jnp.asarray(q), jnp.asarray(vecs[cs]),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGH)
+    if ip:
+        cd = -dots
+    else:
+        cd = jnp.maximum(jnp.asarray(qn)[:, None]
+                         + jnp.asarray(norms[cs]) - 2.0 * dots, 0.0)
+    cd = jnp.where(jnp.asarray(cand) < 0, jnp.inf, cd)
+    return _merge_topk_unique(
+        jnp.asarray(cur_d), jnp.asarray(cur_i), cd, jnp.asarray(cand), K)
+
+
+def _run_kernel(q, cand, vecs, cur_d, cur_i, qn, norms, ip=False,
+                tile_b=None):
+    cs = np.maximum(cand, 0)
+    return graph_local_join(
+        jnp.asarray(q), jnp.asarray(cand), jnp.asarray(vecs[cs]),
+        jnp.asarray(cur_d), jnp.asarray(cur_i),
+        None if ip else jnp.asarray(qn),
+        None if ip else jnp.asarray(norms[cs]),
+        ip=ip, tile_b=tile_b, interpret=True)
+
+
+@pytest.mark.parametrize("ip", [False, True])
+def test_kernel_matches_xla_fallback_bitwise(ip):
+    rng = np.random.default_rng(7)
+    B, C, d, K = 50, 70, 32, 16
+    vecs, q, cand, cur_d, cur_i, qn, norms = _mk(rng, B, C, d, K, ip=ip)
+    # plant every duplicate class: in-row dups + already-listed ids
+    cand[:, 1] = cand[:, 0]
+    cand[:, 2] = cur_i[:, 0]
+    kd, ki = _run_kernel(q, cand, vecs, cur_d, cur_i, qn, norms, ip=ip)
+    wd, wi = _oracle(q, cand, vecs, cur_d, cur_i, qn, norms, K, ip=ip)
+    np.testing.assert_array_equal(np.asarray(ki), np.asarray(wi))
+    fin = np.isfinite(np.asarray(wd))
+    np.testing.assert_allclose(np.asarray(kd)[fin], np.asarray(wd)[fin],
+                               rtol=1e-5, atol=1e-5)
+    # uniqueness invariant per row
+    for b in range(B):
+        live = np.asarray(ki)[b][np.asarray(ki)[b] >= 0]
+        assert len(set(live.tolist())) == len(live)
+
+
+def test_fewer_candidates_than_k_tails_invalid():
+    rng = np.random.default_rng(8)
+    B, C, d, K = 9, 3, 16, 32
+    vecs, q, cand, _, _, qn, norms = _mk(rng, B, C, d, 4)
+    cur_d = np.full((B, K), np.inf, np.float32)
+    cur_i = np.full((B, K), -1, np.int32)
+    kd, ki = _run_kernel(q, cand, vecs, cur_d, cur_i, qn, norms)
+    kd, ki = np.asarray(kd), np.asarray(ki)
+    assert ((ki == -1) == np.isinf(kd)).all()
+    # at most C unique finite entries per row
+    assert (np.isfinite(kd).sum(1) <= C).all()
+
+
+def test_all_invalid_row_is_empty():
+    rng = np.random.default_rng(9)
+    B, C, d, K = 8, 12, 16, 8
+    vecs, q, cand, cur_d, cur_i, qn, norms = _mk(rng, B, C, d, K)
+    cand[3, :] = -1
+    cur_d[3, :] = np.inf
+    cur_i[3, :] = -1
+    kd, ki = _run_kernel(q, cand, vecs, cur_d, cur_i, qn, norms)
+    assert (np.asarray(ki)[3] == -1).all()
+    assert np.isinf(np.asarray(kd)[3]).all()
+
+
+def test_every_dispatchable_tile_agrees():
+    """The graph_join winner strings carry tile_b — every dispatchable
+    tile must produce the same answer (geometry is a speed knob, never
+    a semantics knob)."""
+    from raft_tpu.tuning import GRAPH_JOIN_TILES
+
+    rng = np.random.default_rng(10)
+    B, C, d, K = 37, 40, 24, 12
+    vecs, q, cand, cur_d, cur_i, qn, norms = _mk(rng, B, C, d, K)
+    outs = [
+        _run_kernel(q, cand, vecs, cur_d, cur_i, qn, norms, tile_b=t)
+        for t in GRAPH_JOIN_TILES
+    ]
+    for kd, ki in outs[1:]:
+        np.testing.assert_array_equal(np.asarray(ki),
+                                      np.asarray(outs[0][1]))
+        np.testing.assert_array_equal(np.asarray(kd),
+                                      np.asarray(outs[0][0]))
+
+
+def test_tile_geometry_fits_budget():
+    from raft_tpu.ops.graph_join import join_vmem_bytes
+
+    for C, K, d in ((128, 64, 64), (256, 96, 128), (512, 128, 256)):
+        tb = tile_geometry(C, K, d)["tile_b"]
+        assert tb in (8, 16, 32)
+        assert join_vmem_bytes(tb, C, K, d) <= 8 * 1024 * 1024

@@ -53,7 +53,8 @@ def test_every_ops_kernel_has_a_contract():
     contract (a new kernel without one fails here, not in review)."""
     modules = {c.module for c in CONTRACTS.values()}
     for mod in ("raft_tpu.ops.fused_topk", "raft_tpu.ops.ivf_scan",
-                "raft_tpu.ops.beam_step", "raft_tpu.matrix.select_k"):
+                "raft_tpu.ops.beam_step", "raft_tpu.ops.graph_join",
+                "raft_tpu.matrix.select_k"):
         assert mod in modules, f"{mod} has no kernel contract"
 
 
@@ -97,7 +98,8 @@ def test_static_engine_resolves_contracted_sites():
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for rel in ("raft_tpu/ops/fused_topk.py", "raft_tpu/ops/ivf_scan.py",
-                "raft_tpu/ops/beam_step.py"):
+                "raft_tpu/ops/beam_step.py",
+                "raft_tpu/ops/graph_join.py"):
         path = os.path.join(repo, rel)
         with open(path) as f:
             v = FileKernelVerifier(path, f.read())

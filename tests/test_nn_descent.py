@@ -49,6 +49,145 @@ def test_no_self_edges_no_dups(dataset):
         assert len(set(row)) == len(row)
 
 
+def test_recall_non_pow2_n():
+    """Non-pow2 row counts: the blocked join's tail block and the
+    reverse-graph pack must cover every row (recall-vs-brute oracle)."""
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((777, 16)).astype(np.float32)
+    k = 16
+    index = nn_descent.build(
+        nn_descent.IndexParams(graph_degree=k, max_iterations=12), x)
+    g = np.asarray(index.graph)
+    assert g.shape == (777, k)
+    assert g.max() < 777 and not (
+        g == np.arange(777)[:, None]).any()
+    _, want = naive_knn(x, x, k + 1)
+    rec = np.mean(
+        [len(set(g[i]) & set(want[i][1:k + 1])) / k for i in range(777)])
+    assert rec > 0.9, rec
+
+
+def test_tiny_n_below_intermediate_degree():
+    """n < intermediate_graph_degree: K clamps to n-1 and the build
+    still returns a full, valid, near-exact graph."""
+    rng = np.random.default_rng(6)
+    n, k = 40, 16
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    index = nn_descent.build(
+        nn_descent.IndexParams(graph_degree=k,
+                               intermediate_graph_degree=64,
+                               max_iterations=10), x)
+    g = np.asarray(index.graph)
+    assert g.shape == (n, k)
+    assert g.max() < n and g.min() >= 0
+    assert not (g == np.arange(n)[:, None]).any()
+    _, want = naive_knn(x, x, k + 1)
+    rec = np.mean(
+        [len(set(g[i]) & set(want[i][1:k + 1])) / k for i in range(n)])
+    assert rec > 0.95, rec
+
+
+def test_inner_product_metric():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((2000, 24)).astype(np.float32)
+    k = 16
+    index = nn_descent.build(
+        nn_descent.IndexParams(graph_degree=k, max_iterations=12,
+                               metric="inner_product"), x)
+    g = np.asarray(index.graph)
+    _, want = naive_knn(x[:200], x, k + 1, metric="inner_product")
+    rec = np.mean(
+        [len(set(g[i]) & set(want[i][1:k + 1])) / k for i in range(200)])
+    assert rec > 0.85, rec
+    # distances are true (sign-restored) inner products of returned ids
+    d = np.asarray(index.distances)
+    for i in range(5):
+        np.testing.assert_allclose(
+            d[i, 0], float(x[i] @ x[g[i, 0]]), rtol=1e-3, atol=1e-3)
+
+
+def test_blocked_matches_unblocked_bitwise():
+    """The blocked iteration is a pure memory-shape choice: covering
+    the rows in tiles (OOM-ladder path) must reproduce the single-block
+    dispatch bitwise — ids AND distances."""
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((1500, 16)).astype(np.float32)
+    mk = lambda rows: nn_descent.IndexParams(
+        graph_degree=16, max_iterations=5, block_rows=rows)
+    blocked = nn_descent.build(mk(256), x)        # 6 tiles, ragged tail
+    whole = nn_descent.build(mk(1 << 20), x)      # one dispatch
+    np.testing.assert_array_equal(np.asarray(blocked.graph),
+                                  np.asarray(whole.graph))
+    np.testing.assert_array_equal(np.asarray(blocked.distances),
+                                  np.asarray(whole.distances))
+
+
+def test_oom_ladder_covers_the_join_bitwise():
+    """A RESOURCE_EXHAUSTED mid-join halves the block (OOM ladder,
+    stage nn_descent.join) instead of killing the build, and the
+    shrunken cover reproduces the unfaulted graph bitwise (the join is
+    row-independent). The survivor size lands in the graph_join_rows
+    runtime budget."""
+    from raft_tpu import tuning
+    from raft_tpu.resilience import faultinject
+
+    rng = np.random.default_rng(15)
+    x = rng.standard_normal((1000, 16)).astype(np.float32)
+    params = nn_descent.IndexParams(graph_degree=16, max_iterations=3,
+                                    block_rows=400)
+    clean = nn_descent.build(params, x)
+    try:
+        with faultinject.inject("oom@stage:nn_descent.join"):
+            faulted = nn_descent.build(params, x)
+        assert tuning.runtime_budget("graph_join_rows") == 200
+    finally:
+        tuning.reload()
+    np.testing.assert_array_equal(np.asarray(faulted.graph),
+                                  np.asarray(clean.graph))
+
+
+def test_convergence_window_matches_truncated_build():
+    """The device-side convergence window syncs once per check_every
+    iterations: with a threshold every iteration clears, the build must
+    stop at the first window — bitwise the same graph as a build capped
+    at check_every iterations (same key schedule)."""
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((1200, 16)).astype(np.float32)
+    early = nn_descent.build(
+        nn_descent.IndexParams(graph_degree=16, max_iterations=20,
+                               termination_threshold=10.0,
+                               check_every=3), x)
+    capped = nn_descent.build(
+        nn_descent.IndexParams(graph_degree=16, max_iterations=3,
+                               termination_threshold=0.0), x)
+    np.testing.assert_array_equal(np.asarray(early.graph),
+                                  np.asarray(capped.graph))
+
+
+def test_join_impl_pallas_interpret_agrees():
+    """The fused local-join kernel serving a whole build (interpret
+    mode) stays in lockstep with the XLA fallback — per-step the two
+    are bitwise (tests/test_graph_join.py); across iterations ulp-scale
+    scoring ties may diverge a handful of picks, so judge agreement and
+    recall, not equality."""
+    rng = np.random.default_rng(14)
+    centers = rng.uniform(-5, 5, (8, 16)).astype(np.float32)
+    x = (centers[rng.integers(0, 8, 900)]
+         + 0.6 * rng.standard_normal((900, 16))).astype(np.float32)
+    mk = lambda impl: nn_descent.IndexParams(
+        graph_degree=16, max_iterations=8, join_impl=impl)
+    gp = nn_descent.build(mk("pallas_interpret"), x)
+    gx = nn_descent.build(mk("xla"), x)
+    agree = (np.asarray(gp.graph) == np.asarray(gx.graph)).mean()
+    assert agree > 0.98, agree
+    _, want = naive_knn(x[:150], x, 17)
+    for idx in (gp, gx):
+        g = np.asarray(idx.graph)
+        rec = np.mean([
+            len(set(g[i]) & set(want[i][1:17])) / 16 for i in range(150)])
+        assert rec > 0.9, rec
+
+
 def test_cagra_with_nn_descent_builder(dataset):
     x = dataset
     params = cagra.IndexParams(
