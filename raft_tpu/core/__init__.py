@@ -13,6 +13,7 @@ from raft_tpu.core.logger import logger, set_level
 from raft_tpu.core.trace import annotate, push_range, pop_range
 from raft_tpu.core.interruptible import Interruptible, synchronize
 from raft_tpu.core.device_ndarray import auto_convert_output, cai_wrapper, device_ndarray
+from raft_tpu.core.pipeline import Prefetcher, overlap, resolve_depth
 
 __all__ = [
     "Resources",
@@ -32,4 +33,7 @@ __all__ = [
     "pop_range",
     "Interruptible",
     "synchronize",
+    "Prefetcher",
+    "overlap",
+    "resolve_depth",
 ]

@@ -1,0 +1,368 @@
+"""graft-flow: bounded-depth staged prefetch for streaming data paths.
+
+Every out-of-core tier here has the same serial shape — read a chunk
+from the host tier (memmap slice, ``.bin`` file block, shortlist
+gather), upload it, score it, repeat — so the device idles during the
+read and the host idles during the score. FusionANNS (arXiv:2409.16576)
+earns its billion-scale numbers precisely by hiding storage fetch
+behind GPU compute; with XLA's async dispatch the device side of that
+overlap is already free, and the missing piece is a *background
+producer* that keeps the next chunk's host work off the consumer's
+critical path. That producer is this module.
+
+:class:`Prefetcher` wraps any chunk iterator in a bounded buffer
+(``depth`` slots, default 2 = classic double buffering) filled by one
+background thread:
+
+* **bitwise-off switch** — ``depth<=0`` runs the source inline on the
+  consumer thread: no thread, no buffer, byte-identical scheduling to
+  the pre-pipeline code. Depth only moves *when* work happens, never
+  what is computed, so pipeline on vs off is bitwise-identical by
+  construction on every wired path.
+* **error attribution** — a producer exception is caught, carried
+  through the buffer in order, and re-raised (the original object, so
+  :func:`raft_tpu.resilience.errors.classify` and the faultinject
+  classes survive) at the consuming ``next()`` — faults injected in a
+  read stage attribute to the chunk's consuming iteration, not to a
+  background stack.
+* **cancellation** — ``close()`` (and the consumer's
+  :class:`~raft_tpu.core.interruptible.Interruptible` token) stops the
+  producer at its next buffer interaction and joins it; the thread is
+  daemonized so even a producer wedged inside a slow read can never pin
+  interpreter exit (GL014).
+* **resize/flush** — :meth:`flush` discards buffered-but-unconsumed
+  chunks and restarts the producer from a fresh iterator, the hook the
+  OOM degradation ladder needs: after a downshift the already-prefetched
+  chunks carry the old batch geometry, so the ladder rewinds the source
+  (``start_row``), shrinks it (``set_batch_rows``), and flushes.
+* **accounting** — ``pipeline.stall_ms{path}`` (consumer waited on the
+  producer), ``pipeline.occupancy`` / ``pipeline.prefetch_depth``
+  gauges, and :meth:`stats` totals for the bench scripts'
+  overlap-fraction columns (docs/observability.md).
+
+Checkpoint composition (docs/resilience.md): prefetch hands the
+consumer chunks *earlier*, never marks them done — StreamCheckpoint
+writes remain strictly consumption-ordered, so kill+resume stays
+bitwise with any number of chunks in flight.
+
+``pipeline_depth`` rides the tuning-budget plumbing
+(:func:`resolve_depth`): ``RAFT_TPU_TUNING`` modes read a measured
+depth from the active dispatch table, and a runtime
+:func:`raft_tpu.tuning.record_budget` ceiling (recorded when a
+downshift proves memory pressure) clamps it process-wide.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, Optional, Union
+
+from raft_tpu import obs, tuning
+from raft_tpu.analysis import lockwatch
+from raft_tpu.core.interruptible import Interruptible
+
+# tuning-budget key for the prefetch buffer depth (docs/dispatch_tuning.md)
+PIPELINE_DEPTH_BUDGET = "pipeline_depth"
+# double-buffered: one chunk in flight while one is consumed — the knee
+# of the occupancy curve on every measured leg (PIPE_r16.json)
+DEFAULT_DEPTH = 2
+
+# depth candidates the capture harness races (scripts/r5_measure_all.py
+# --stage pipeline): 0 = off, 1 = single-slot handoff, 2 = double
+# buffer, 4 = deep (only wins when read latency is bursty)
+PIPELINE_DEPTH_CANDIDATES = (0, 1, 2, 4)
+
+
+def resolve_depth(depth: Optional[int] = None) -> int:
+    """The effective prefetch depth: an explicit ``depth`` wins, else the
+    ``pipeline_depth`` tuning budget (table value in non-off modes, the
+    double-buffered default otherwise, always clamped by a recorded
+    runtime ceiling). Never negative; 0 = pipeline off."""
+    if depth is not None:
+        return max(int(depth), 0)
+    return max(int(tuning.budget(PIPELINE_DEPTH_BUDGET, DEFAULT_DEPTH)), 0)
+
+
+Source = Union[Iterable, Callable[[], Iterator]]
+
+
+def _make_iter(source: Source) -> Iterator:
+    return iter(source() if callable(source) else source)
+
+
+class Prefetcher:
+    """Iterate ``source`` with up to ``depth`` items produced ahead.
+
+    ``source`` is an iterable or a zero-arg callable returning an
+    iterator; a callable (or a re-iterable like ``BatchLoadIterator``)
+    is required for :meth:`flush` to restart after a resize. Yields the
+    source's items unchanged and in order.
+
+    ``depth<=0`` is the off mode: items are pulled inline on the
+    consumer thread with zero added machinery. ``token`` (default: the
+    constructing thread's token) wakes a parked consumer promptly on
+    cross-thread ``cancel()`` and stops the producer at its next
+    buffer interaction.
+
+    Use as a context manager (or call :meth:`close`) so the producer is
+    joined on every exit path, including consumer-side exceptions.
+    """
+
+    def __init__(
+        self,
+        source: Source,
+        depth: Optional[int] = None,
+        *,
+        path: str = "pipeline",
+        token: Optional[Interruptible] = None,
+    ):
+        self._source = source
+        self._depth = resolve_depth(depth)
+        self._path = path
+        self._token = token if token is not None \
+            else Interruptible.get_token()
+        # one condition guards buffer+epoch+stop; "core.pipeline" is its
+        # node in the lock hierarchy (docs/serving.md §11) — leaf-level,
+        # never held across a callback into user code
+        self._cv = lockwatch.make_condition(
+            lockwatch.make_lock("core.pipeline"))
+        self._buf: deque = deque()
+        self._epoch = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._inline_it: Optional[Iterator] = None
+        # accounting (consumer-thread writes; reads via stats())
+        self._stall_ms = 0.0
+        self._wait_ms = 0.0
+        self._items = 0
+        self._stalls = 0
+        self._occ_sum = 0
+        if self._depth > 0:
+            obs.gauge("pipeline.prefetch_depth", self._depth,
+                      path=self._path)
+
+    @property
+    def depth(self) -> int:
+        """The effective (resolved) prefetch depth; 0 = off/inline."""
+        return self._depth
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _start_locked(self) -> None:
+        epoch = self._epoch
+        it = _make_iter(self._source)
+        t = threading.Thread(
+            target=self._produce, args=(it, epoch),
+            name=f"raft-tpu-prefetch-{self._path}", daemon=True,
+        )
+        self._thread = t
+        t.start()
+
+    def _produce(self, it: Iterator, epoch: int) -> None:
+        try:
+            for item in it:
+                with self._cv:
+                    while (len(self._buf) >= self._depth
+                           and not self._stop and self._epoch == epoch):
+                        self._cv.wait()
+                    if self._stop or self._epoch != epoch:
+                        return
+                    self._buf.append(("item", item))
+                    self._cv.notify_all()
+                if self._token.cancelled():
+                    # drain, don't raise: the consumer's own token.check()
+                    # raises InterruptedException at its chunk boundary;
+                    # the producer just stops feeding and exits
+                    return
+        except BaseException as e:  # noqa: BLE001 — carried to the consumer and re-raised at the consuming next(); classification happens there
+            with self._cv:
+                if self._epoch == epoch and not self._stop:
+                    self._buf.append(("err", e))
+                    self._cv.notify_all()
+            return
+        with self._cv:
+            if self._epoch == epoch and not self._stop:
+                self._buf.append(("end", None))
+                self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Discard produced-but-unconsumed items and restart the
+        producer from a fresh ``iter(source)`` at the next pull — the
+        OOM-downshift hook: rewind/shrink the source first, then flush.
+        No-op in off mode (nothing is ever buffered ahead)."""
+        if self._depth <= 0:
+            self._inline_it = None
+            return
+        with self._cv:
+            self._epoch += 1
+            self._buf.clear()
+            self._cv.notify_all()
+            t, self._thread = self._thread, None
+        if t is not None:
+            # the producer exits at its next buffer interaction; a read
+            # wedged in slow IO keeps the (daemon) thread alive past the
+            # timeout, and its stale item is dropped by the epoch check
+            t.join(timeout=30.0)
+        obs.counter("pipeline.flushes", path=self._path)
+
+    def close(self) -> None:
+        """Stop and join the producer, dropping buffered items. Safe to
+        call twice; called by ``__exit__`` and by the wired paths'
+        ``finally`` blocks so no exit path leaks the thread."""
+        if self._depth <= 0:
+            self._inline_it = None
+            return
+        with self._cv:
+            self._stop = True
+            self._epoch += 1
+            self._buf.clear()
+            self._cv.notify_all()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30.0)
+        if self._items:
+            obs.gauge("pipeline.occupancy",
+                      self._occ_sum / max(self._items, 1), path=self._path)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- consumption -------------------------------------------------------
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self):
+        if self._depth <= 0:
+            if self._inline_it is None:
+                self._inline_it = _make_iter(self._source)
+            t0 = time.perf_counter()
+            item = next(self._inline_it)
+            # in off mode the whole read IS a stall: the consumer waits
+            # for it inline. Recording it makes the depth=0 vs depth=2
+            # stall comparison a single metric query.
+            ms = (time.perf_counter() - t0) * 1e3
+            self._stall_ms += ms
+            self._wait_ms += ms
+            self._items += 1
+            self._stalls += 1
+            if obs.enabled():
+                obs.observe("pipeline.stall_ms", ms, path=self._path)
+            return item
+        t0 = time.perf_counter()
+        with self._cv:
+            if self._thread is None and not self._buf and not self._stop:
+                self._start_locked()
+            stalled = not self._buf
+            while not self._buf:
+                if self._token.cancelled():
+                    self._token.check()     # raises InterruptedException
+                if self._thread is None or not self._thread.is_alive():
+                    raise RuntimeError(
+                        f"pipeline[{self._path}]: producer thread died "
+                        "without delivering an end/err envelope")
+                self._cv.wait(0.05)
+            kind, val = self._buf.popleft()
+            if kind == "item":
+                # mean-occupancy sample: this item plus what is still
+                # buffered; end/err envelopes are not occupancy
+                self._occ_sum += len(self._buf) + 1
+            self._cv.notify_all()
+        wait = (time.perf_counter() - t0) * 1e3
+        self._wait_ms += wait
+        if stalled:
+            self._stall_ms += wait
+            self._stalls += 1
+            if obs.enabled():
+                obs.observe("pipeline.stall_ms", wait, path=self._path)
+        if kind == "err":
+            self.close()
+            raise val
+        if kind == "end":
+            self.close()
+            raise StopIteration
+        self._items += 1
+        return val
+
+    # -- accounting --------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Consumer-side totals: ``stall_ms`` (time the consumer spent
+        blocked on the producer — in off mode, the full inline read
+        time), ``items``, ``stalls``, ``occupancy`` (mean buffered
+        items at pop, in [0, depth]), and the effective ``depth``. The
+        bench scripts derive their overlap-fraction column as
+        ``1 - stall_ms(depth=N) / stall_ms(depth=0)``."""
+        return {
+            "depth": self._depth,
+            "path": self._path,
+            "items": self._items,
+            "stalls": self._stalls,
+            "stall_ms": self._stall_ms,
+            "wait_ms": self._wait_ms,
+            "occupancy": self._occ_sum / max(self._items, 1),
+        }
+
+
+class _Staged:
+    """Iterator applying ``fn`` to an upstream iterator's items — the
+    restartable unit :func:`overlap` chains Prefetchers over."""
+
+    def __init__(self, upstream: Source, fn: Callable):
+        self._upstream = upstream
+        self._fn = fn
+
+    def __call__(self) -> Iterator:
+        fn = self._fn
+        return (fn(x) for x in _make_iter(self._upstream))
+
+
+def overlap(
+    source: Source,
+    *stages: Callable,
+    depth: Optional[int] = None,
+    path: str = "pipeline",
+    token: Optional[Interruptible] = None,
+) -> Prefetcher:
+    """Compose a staged pipeline over ``source``: each stage is a unary
+    function applied to the previous stage's items, every stage boundary
+    gets its own bounded :class:`Prefetcher`, and the caller consumes
+    the final stage's output. ``overlap(read_chunks, upload, ...)``
+    therefore runs chunk N+1's read concurrently with chunk N's upload
+    while the caller computes on chunk N-1 — the classic
+    read/upload/compute overlap with the compute stage being the
+    consuming loop itself.
+
+    Returns the outermost :class:`Prefetcher` (iterate it, ``close()``
+    it or use it as a context manager — closing it closes the whole
+    chain). ``depth<=0`` composes inline on the consumer thread and is
+    bitwise-equivalent scheduling to the unpipelined loop.
+    """
+    d = resolve_depth(depth)
+    if not stages:
+        return Prefetcher(source, depth=d, path=path, token=token)
+    up: Source = source
+    chain: list = []                      # upstream-first
+    names = [getattr(s, "__name__", f"s{i}") for i, s in enumerate(stages)]
+    for i, stage in enumerate(stages):
+        pf = Prefetcher(_Staged(up, stage), depth=d,
+                        path=f"{path}.{names[i]}", token=token)
+        chain.append(pf)
+        up = pf
+    outer = chain[-1]
+
+    # closing the outermost prefetcher must join EVERY producer in the
+    # chain, upstream-first: stopping an upstream unblocks the stage
+    # thread pulling from it, so each join returns promptly instead of
+    # waiting out a producer parked on a live upstream
+    def close_chain(_chain=tuple(chain)):
+        for p in _chain:
+            Prefetcher.close(p)
+
+    outer.close = close_chain  # type: ignore[method-assign]
+    return outer

@@ -605,6 +605,7 @@ def build_streamed(
     checkpoint_every: int = 8,
     resume: bool = False,
     token=None,
+    pipeline_depth: Optional[int] = None,
 ) -> Index:
     """Build from a re-iterable stream of fixed-shape device batches —
     the out-of-core path for datasets too large for HBM or host RAM.
@@ -619,7 +620,7 @@ def build_streamed(
             params, make_batches, n, dim, trainset, keep_codes=keep_codes,
             cap_rows=cap_rows, verbose=verbose,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            resume=resume, token=token,
+            resume=resume, token=token, pipeline_depth=pipeline_depth,
         )
 
 
@@ -636,6 +637,7 @@ def _build_streamed_impl(
     checkpoint_every: int = 8,
     resume: bool = False,
     token=None,
+    pipeline_depth: Optional[int] = None,
 ) -> Index:
     """Build from a RE-ITERABLE stream of fixed-shape device batches —
     the path for datasets too large for HBM *or host RAM* (DEEP-100M at
@@ -673,7 +675,17 @@ def _build_streamed_impl(
     thread's :class:`~raft_tpu.core.interruptible.Interruptible`) is
     checked at every batch so ``cancel()`` from another thread stops the
     hours-long job at the next chunk boundary.
+
+    ``pipeline_depth`` (default: the ``pipeline_depth`` tuning budget)
+    runs ``make_batches()`` on a graft-flow producer for each pass, so
+    the caller's host read + device upload for batch N+1 overlaps batch
+    N's label/scatter compute. Bitwise-invariant at any depth (the
+    stream's items and order are unchanged); checkpoints still save
+    only after a batch's scatter dispatched (a prefetched batch is
+    never marked done), and a caller-side read error surfaces at the
+    consuming batch, classified as usual.
     """
+    from raft_tpu.core import pipeline as _pipeline
     from raft_tpu.neighbors.ivf_flat import _aligned_cap
     from raft_tpu import resilience
     from raft_tpu.core.interruptible import Interruptible
@@ -865,36 +877,42 @@ def _build_streamed_impl(
             parts = [jnp.asarray(_state[3]["labels_parts"])]
             _p1_done = int(_state[2]["batches_done"])
             _p1_restored_rows = int(parts[0].shape[0])
-        for bi, batch in enumerate(make_batches()):
-            if bi < _p1_done:
-                _p1_skipped += int(batch.shape[0])
-                continue                 # resumed past this chunk
-            if _p1_done and _p1_skipped != _p1_restored_rows:
-                # the new make_batches yields different shapes than the
-                # killed run's — skipping by batch INDEX would silently
-                # drop or duplicate rows
-                raise ValueError(
-                    f"build_streamed resume misalignment: checkpoint "
-                    f"covers {_p1_restored_rows} pass-1 rows in "
-                    f"{_p1_done} batches but the first {_p1_done} "
-                    f"batches of this run hold {_p1_skipped} rows; "
-                    "resume with the make_batches shape the checkpoint "
-                    "was written at"
-                )
-            token.check()
-            faultinject.check(stage="build.pass1", chunk=bi)
-            obs.counter("stream_chunks_total", stage="build.pass1")
-            parts.append(kmeans_balanced.predict(kb, index.centers, batch))
-            if bi % 8 == 7:
-                np.asarray(parts[-1][0])
-            if ck is not None and (bi + 1) % _every == 0 \
-                    and bi + 1 > _p1_done:
-                ck.save(
-                    "pass1", bi, {"batches_done": bi + 1},
-                    dict(_quant_arrays(index, ts_scales),
-                         labels_parts=jnp.concatenate(parts)),
-                    fingerprint=_fp,
-                )
+        # graft-flow: the caller's host read + upload for batch N+1
+        # runs on a producer while batch N labels (depth 0 = the old
+        # inline loop); closed on every exit path via the context
+        with _pipeline.Prefetcher(make_batches, depth=pipeline_depth,
+                                  path="build.pass1", token=token) as _pf1:
+            for bi, batch in enumerate(_pf1):
+                if bi < _p1_done:
+                    _p1_skipped += int(batch.shape[0])
+                    continue             # resumed past this chunk
+                if _p1_done and _p1_skipped != _p1_restored_rows:
+                    # the new make_batches yields different shapes than
+                    # the killed run's — skipping by batch INDEX would
+                    # silently drop or duplicate rows
+                    raise ValueError(
+                        f"build_streamed resume misalignment: checkpoint "
+                        f"covers {_p1_restored_rows} pass-1 rows in "
+                        f"{_p1_done} batches but the first {_p1_done} "
+                        f"batches of this run hold {_p1_skipped} rows; "
+                        "resume with the make_batches shape the "
+                        "checkpoint was written at"
+                    )
+                token.check()
+                faultinject.check(stage="build.pass1", chunk=bi)
+                obs.counter("stream_chunks_total", stage="build.pass1")
+                parts.append(
+                    kmeans_balanced.predict(kb, index.centers, batch))
+                if bi % 8 == 7:
+                    np.asarray(parts[-1][0])
+                if ck is not None and (bi + 1) % _every == 0 \
+                        and bi + 1 > _p1_done:
+                    ck.save(
+                        "pass1", bi, {"batches_done": bi + 1},
+                        dict(_quant_arrays(index, ts_scales),
+                             labels_parts=jnp.concatenate(parts)),
+                        fingerprint=_fp,
+                    )
         if _p1_done and _p1_skipped != _p1_restored_rows:
             raise ValueError(
                 "build_streamed resume misalignment: the stream ended "
@@ -1007,54 +1025,57 @@ def _build_streamed_impl(
         nbatch = 0
     _p2_done = nbatch
     _p2_skipped = 0
-    for bi, batch in enumerate(make_batches()):
-        if bi < _p2_done:
-            _p2_skipped += int(batch.shape[0])
-            continue                     # resumed past this chunk
-        if bi == _p2_done and _p2_done and _p2_skipped != off:
-            # index-based skipping only works when the new stream's
-            # batch shapes match the killed run's (off is the row-exact
-            # encode position the checkpoint restored)
-            raise ValueError(
-                f"build_streamed resume misalignment: checkpoint encoded "
-                f"{off} rows in {_p2_done} batches but the first "
-                f"{_p2_done} batches of this run hold {_p2_skipped} "
-                "rows; resume with the make_batches shape the "
-                "checkpoint was written at"
+    with _pipeline.Prefetcher(make_batches, depth=pipeline_depth,
+                              path="build.pass2", token=token) as _pf2:
+        for bi, batch in enumerate(_pf2):
+            if bi < _p2_done:
+                _p2_skipped += int(batch.shape[0])
+                continue                 # resumed past this chunk
+            if bi == _p2_done and _p2_done and _p2_skipped != off:
+                # index-based skipping only works when the new stream's
+                # batch shapes match the killed run's (off is the
+                # row-exact encode position the checkpoint restored)
+                raise ValueError(
+                    f"build_streamed resume misalignment: checkpoint "
+                    f"encoded {off} rows in {_p2_done} batches but the "
+                    f"first {_p2_done} batches of this run hold "
+                    f"{_p2_skipped} rows; resume with the make_batches "
+                    "shape the checkpoint was written at"
+                )
+            token.check()
+            faultinject.check(stage="build.pass2", chunk=bi)
+            obs.counter("stream_chunks_total", stage="build.pass2")
+            bs = batch.shape[0]
+            lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
+            (acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac,
+             acc_ids, fill) = (
+                _scatter_encode_batch(
+                    acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac,
+                    acc_ids, fill,
+                    batch, lab, jnp.int32(off), scale,
+                    index.centers_rot, index.rotation, index.pq_centers,
+                    C, cap, int(index.codebook_kind), pq_dim, pq_bits,
+                    keep_codes, cache_kind,
+                )
             )
-        token.check()
-        faultinject.check(stage="build.pass2", chunk=bi)
-        obs.counter("stream_chunks_total", stage="build.pass2")
-        bs = batch.shape[0]
-        lab = jax.lax.dynamic_slice_in_dim(labels_all, off, bs)
-        (acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac, acc_ids,
-         fill) = (
-            _scatter_encode_batch(
-                acc_codes, acc_cache, acc_norms, acc_qnorms, acc_fac,
-                acc_ids, fill,
-                batch, lab, jnp.int32(off), scale,
-                index.centers_rot, index.rotation, index.pq_centers,
-                C, cap, int(index.codebook_kind), pq_dim, pq_bits,
-                keep_codes, cache_kind,
-            )
-        )
-        nbatch += 1
-        if nbatch % 4 == 0:
-            np.asarray(fill[0])        # throttle the async queue (above)
-        if verbose and nbatch == 1:
-            np.asarray(fill[0])
-            print("[build_streamed] first scatter ok", flush=True)
-        off += bs
-        if ck is not None and nbatch % _every == 0 and nbatch > _p2_done:
-            ck.save(
-                "pass2", nbatch, {"off": off, "nbatch": nbatch},
-                dict(_quant_arrays(index, ts_scales),
-                     labels_all=labels_all, acc_codes=acc_codes,
-                     acc_cache=acc_cache, acc_norms=acc_norms,
-                     acc_qnorms=acc_qnorms, acc_fac=acc_fac,
-                     acc_ids=acc_ids, fill=fill),
-                fingerprint=_fp,
-            )
+            nbatch += 1
+            if nbatch % 4 == 0:
+                np.asarray(fill[0])    # throttle the async queue (above)
+            if verbose and nbatch == 1:
+                np.asarray(fill[0])
+                print("[build_streamed] first scatter ok", flush=True)
+            off += bs
+            if ck is not None and nbatch % _every == 0 \
+                    and nbatch > _p2_done:
+                ck.save(
+                    "pass2", nbatch, {"off": off, "nbatch": nbatch},
+                    dict(_quant_arrays(index, ts_scales),
+                         labels_all=labels_all, acc_codes=acc_codes,
+                         acc_cache=acc_cache, acc_norms=acc_norms,
+                         acc_qnorms=acc_qnorms, acc_fac=acc_fac,
+                         acc_ids=acc_ids, fill=fill),
+                    fingerprint=_fp,
+                )
 
     if _p2_done and nbatch == _p2_done and _p2_skipped != off:
         raise ValueError(
@@ -2585,9 +2606,16 @@ def search_refined(
                 if obs.enabled():
                     s1.sync(ids1)
             row_bytes = int(src_obj.row_bytes)
+            # stage-split rerank: the host gather (shortlist sync +
+            # dedup + mmap read + upload) times under its own `fetch`
+            # span — before graft-flow this was invisibly folded into
+            # rerank time, hiding exactly the latency the prefetch
+            # pipeline overlaps
+            with obs.span("ivf_pq.fetch", source=source) as sf:
+                prepared = src_obj.prepare(queries, ids1)
             with obs.span("ivf_pq.rerank", source=source) as s2:
-                d, ids, fetch = src_obj.rerank_info(queries, ids1,
-                                                    int(k), index.metric)
+                d, ids, fetch = src_obj.score(prepared, int(k),
+                                              index.metric)
                 if obs.enabled():
                     s2.sync(ids)
             shortlist = ids1
@@ -2654,11 +2682,97 @@ def search_refined(
             if getattr(s1, "device_ms", None) is not None:
                 obs.observe("rerank.stage_ms", s1.device_ms,
                             stage="first_stage")
+            if src_obj is not None and sf.ms is not None:
+                # the fetch stage is HOST work (sync+gather+upload
+                # dispatch): wall-clock is the honest number — there is
+                # no device compute to sync on
+                obs.observe("rerank.stage_ms", sf.ms, stage="fetch")
             if getattr(s2, "device_ms", None) is not None:
                 obs.observe("rerank.stage_ms", s2.device_ms,
                             stage="rerank")
             _sp.set(source=source, shortlist=kc)
         return d, ids
+
+
+def search_refined_stream(
+    search_params: SearchParams,
+    index: Index,
+    queries,
+    k: int,
+    refine_ratio: int = 2,
+    prefilter=None,
+    dataset=None,
+    batch_rows: int = 1024,
+    pipeline_depth: Optional[int] = None,
+    token=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched :func:`search_refined` with graft-flow overlap: batch
+    N+1's first-stage scan + shortlist fetch (host gather + H2D upload
+    — :meth:`~raft_tpu.neighbors.tiered.RerankSource.prepare`) runs on
+    a bounded background producer while batch N's exact rerank scores
+    and lands in the host result arrays. This is the batched tiered
+    path the serial per-batch loop becomes once the fetch dominates:
+    the memmap gather disappears behind device compute
+    (``pipeline.stall_ms{path=tiered.rerank}`` shows what is left).
+
+    Requires ``dataset`` (host array / memmap / ``RerankSource`` — the
+    overlap hides *its* fetch; the cache/codes reranks never fetch).
+    Results are bitwise :func:`search_refined` over the same batches at
+    any ``pipeline_depth`` including 0 (off): an overlapped
+    ``prepare(N+1)`` can at most classify a row as a host miss that a
+    serialized run would have served from the hot cache — the gathered
+    values are identical either way (tiered module docstring), only
+    ``FetchInfo`` traffic accounting shifts between tiers. ``token``
+    cancellation drains the producer at the next batch boundary.
+    """
+    from raft_tpu.core import pipeline as _pipeline
+    from raft_tpu.core.interruptible import Interruptible
+    from raft_tpu.neighbors import tiered as _tiered
+    from raft_tpu.resilience import faultinject
+
+    if refine_ratio < 1:
+        raise ValueError(f"refine_ratio must be >= 1, got {refine_ratio}")
+    if dataset is None:
+        raise ValueError(
+            "search_refined_stream needs dataset= (a host array, memmap "
+            "or tiered.RerankSource): the pipeline overlaps the rerank "
+            "FETCH, and the cache/codes rerank paths never fetch — use "
+            "search_refined for those")
+    src_obj = _tiered.as_source(dataset)
+    m = int(queries.shape[0])
+    kc = refined_shortlist_width(search_params, index, k, refine_ratio)
+    bs = max(int(batch_rows), 1)
+    out_d = np.empty((m, k), np.float32)
+    out_i = np.empty((m, k), np.int32)
+    if token is None:
+        token = Interruptible.get_token()
+
+    def produce():
+        for off in range(0, m, bs):
+            qb = jnp.asarray(queries[off:off + bs])
+            _, ids1 = search(search_params, index, qb, kc,
+                             prefilter=prefilter)
+            # the producer's host sync + gather + upload; score() stays
+            # with the consumer so device results complete in order
+            yield off, src_obj.prepare(qb, ids1)
+
+    pf = _pipeline.Prefetcher(produce, depth=pipeline_depth,
+                              path="tiered.rerank", token=token)
+    with obs.span("ivf_pq.search_refined_stream", k=int(k),
+                  refine_ratio=int(refine_ratio), n_queries=m,
+                  batch_rows=bs, pipeline_depth=pf.depth), pf:
+        for ci, (off, prepared) in enumerate(pf):
+            token.check()
+            # the CONSUMING dispatch's fault point: chunk-scoped specs
+            # (oom@chunk:N) attribute here — never to the producer's
+            # prefetch — and slow@stage:tiered.score lets the CPU-smoke
+            # bench model the device scan time the overlap hides behind
+            faultinject.check(stage="tiered.score", chunk=ci)
+            d, i, _ = src_obj.score(prepared, int(k), index.metric)
+            rows = min(bs, m - off)
+            out_d[off:off + rows] = np.asarray(d, np.float32)[:rows]
+            out_i[off:off + rows] = np.asarray(i)[:rows]
+    return out_d, out_i
 
 
 def _norm_dtype_knob(v) -> str:

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from raft_tpu import obs, resilience, tuning
+from raft_tpu.core import pipeline
 from raft_tpu.core.interruptible import Interruptible
 from raft_tpu.resilience import degrade, faultinject
 from raft_tpu.utils.batch import BatchLoadIterator, FileBatchLoadIterator
@@ -54,6 +55,7 @@ def search_stream(
     checkpoint_every: int = 8,
     resume: bool = False,
     token: Optional[Interruptible] = None,
+    pipeline_depth: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run ``search_fn(query_batch) -> (dists, ids)`` over an iterator of
     ``(offset, device_batch)`` pairs (``BatchLoadIterator`` /
@@ -75,6 +77,16 @@ def search_stream(
     ``token`` (default: the calling thread's token) is checked between
     batches — ``cancel()`` from another thread raises
     ``InterruptedException`` at the next boundary.
+
+    ``pipeline_depth`` sets the graft-flow prefetch depth (default: the
+    ``pipeline_depth`` tuning budget, 2 = double-buffered): chunk N+1's
+    host read + device upload run on a background producer while chunk
+    N scans. Depth only moves when the read happens, never what is
+    computed, so any depth (including 0 = off) yields bitwise-identical
+    results; checkpoints stay consumption-ordered (a prefetched chunk
+    is never marked done), and an OOM downshift rewinds + flushes the
+    prefetcher so in-flight chunks re-read at the surviving size
+    (docs/resilience.md).
     """
     out_d = np.empty((n_queries, k), np.float32)
     out_i = np.empty((n_queries, k), np.int32)
@@ -92,9 +104,15 @@ def search_stream(
     if token is None:
         token = Interruptible.get_token()
 
+    # graft-flow: a bounded producer keeps the next chunk's host read +
+    # H2D upload ahead of the scan; depth 0 degenerates to the original
+    # inline loop (bitwise-identical scheduling)
+    pf = pipeline.Prefetcher(batches, depth=pipeline_depth,
+                             path=f"stream.{stage}", token=token)
     with obs.span("stream.search_stream", stage=stage,
-                  n_queries=int(n_queries), k=int(k), resumed=rows_done):
-        for ci, (offset, batch) in enumerate(batches):
+                  n_queries=int(n_queries), k=int(k), resumed=rows_done,
+                  pipeline_depth=pf.depth), pf:
+        for ci, (offset, batch) in enumerate(pf):
             rows = min(batch.shape[0], n_queries - offset)
             if offset + rows <= rows_done:
                 continue                  # resumed past this chunk
@@ -134,11 +152,20 @@ def search_stream(
                         algo="stream", stage=stage)
             obs.counter("stream_rows_total", rows, stage=stage)
             obs.counter("stream_chunks_total", stage=stage)
-            if survived < batch.shape[0] and hasattr(batches, "set_batch_rows"):
-                batches.set_batch_rows(survived)
             out_d[offset:offset + rows] = np.asarray(d[:rows], np.float32)
             out_i[offset:offset + rows] = np.asarray(i[:rows])
             rows_done = offset + rows
+            if survived < batch.shape[0] and hasattr(batches, "set_batch_rows"):
+                batches.set_batch_rows(survived)
+                if pf.depth > 0 and hasattr(batches, "start_row"):
+                    # chunks already prefetched carry the pre-downshift
+                    # geometry and would re-OOM under real memory
+                    # pressure: rewind the source to the consumed row
+                    # mark and flush so in-flight work re-reads at the
+                    # surviving size (row-exact restart == resume, so
+                    # outputs stay bitwise)
+                    batches.start_row = rows_done
+                    pf.flush()
             if ck is not None and (ci + 1) % max(int(checkpoint_every), 1) == 0:
                 ck.save(
                     "search", ci, {"rows_done": rows_done},
@@ -168,6 +195,7 @@ def search_file(
     retries: int = 2,
     backoff_s: float = 0.5,
     deadline_s: Optional[float] = None,
+    pipeline_depth: Optional[int] = None,
     **search_kwargs,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Stream a ``.fbin``-family query file through ``module.search``
@@ -191,7 +219,7 @@ def search_file(
             fn, it, it.shape[0], k,
             retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            resume=resume, token=token,
+            resume=resume, token=token, pipeline_depth=pipeline_depth,
         )
 
 
@@ -209,6 +237,7 @@ def search_host_array(
     retries: int = 2,
     backoff_s: float = 0.5,
     deadline_s: Optional[float] = None,
+    pipeline_depth: Optional[int] = None,
     **search_kwargs,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Same streaming pattern over a host-resident array (numpy or
@@ -247,5 +276,5 @@ def search_host_array(
             fn, it, queries.shape[0], k,
             retries=retries, backoff_s=backoff_s, deadline_s=deadline_s,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            resume=resume, token=token,
+            resume=resume, token=token, pipeline_depth=pipeline_depth,
         )

@@ -116,6 +116,24 @@ class RerankSource:
                     ) -> Tuple[jax.Array, jax.Array, FetchInfo]:
         raise NotImplementedError
 
+    def prepare(self, queries, candidates):
+        """The fetch half of a stage-split rerank: everything host-side
+        — shortlist sync, dedup/classify, gather, device upload —
+        packaged as an opaque handle for :meth:`score`. graft-flow's
+        producers run this for batch N+1 while batch N scores; the
+        default defers everything to ``score`` (device-resident sources
+        have no host fetch to overlap)."""
+        return (queries, candidates)
+
+    def score(self, prepared, k: int, metric
+              ) -> Tuple[jax.Array, jax.Array, FetchInfo]:
+        """The device half: exact-score a :meth:`prepare` handle.
+        ``score(prepare(q, c), k, metric)`` is always bitwise
+        ``rerank_info(q, c, k, metric)`` — the split moves *when* the
+        fetch happens, never what is computed."""
+        queries, candidates = prepared
+        return self.rerank_info(queries, candidates, k, metric)
+
     def warm(self, m: int, c: int, k: int, metric,
              query_dtype=jnp.float32) -> int:
         """Trace every device shape an [m, c] shortlist rerank at
@@ -408,8 +426,20 @@ class HostArraySource(RerankSource):
 
     # -- the rerank --------------------------------------------------------
 
-    def rerank_info(self, queries, candidates, k, metric):
-        metric = resolve_metric(metric)
+    def prepare(self, queries, candidates):
+        """The host fetch for one shortlist batch: sync the ids, dedupe
+        + hot/miss classify, gather misses, upload. Runs on graft-flow
+        producer threads: the lock discipline in :meth:`_gather` and
+        the CAS promotion commit in :meth:`score` make an overlapped
+        ``prepare(N+1)`` vs ``score(N)`` race-free — at worst a
+        concurrent classify misses a just-promoted row and re-fetches
+        it (module docstring), never a wrong result."""
+        from raft_tpu.resilience import faultinject
+
+        # the fetch-stage fault point: slow@stage:tiered.fetch models
+        # host-tier gather latency (stage-scoped only — chunk faults
+        # stay with the consuming dispatch)
+        faultinject.check(stage="tiered.fetch", stage_only=True)
         # the structural host sync of the tiered pipeline: the
         # shortlist ids must reach the host to drive the gather — this
         # is the ONE device->host hop the architecture is built around
@@ -419,8 +449,7 @@ class HostArraySource(RerankSource):
                              f"{ids_host.shape}")
         if self.hot_capacity:
             self._ensure_hot_block()       # device alloc OUTSIDE _lock
-        (miss_dev, pos_dev, hot_mask, blk, promote,
-         info) = self._gather(ids_host)
+        gathered = self._gather(ids_host)
         q = queries if isinstance(queries, jax.Array) \
             else jnp.asarray(queries)
         # stage 1 hands us a device int32 array: reuse it rather than
@@ -430,6 +459,12 @@ class HostArraySource(RerankSource):
             cand = candidates
         else:
             cand = jnp.asarray(ids_host.astype(np.int32, copy=False))
+        return (q, cand, gathered)
+
+    def score(self, prepared, k, metric):
+        q, cand, (miss_dev, pos_dev, hot_mask, blk, promote,
+                  info) = prepared
+        metric = resolve_metric(metric)
         if self.hot_capacity:
             d, i = _score_fetched_hot(q, miss_dev, blk, pos_dev,
                                       hot_mask, cand, int(k),
@@ -452,6 +487,9 @@ class HostArraySource(RerankSource):
             d, i = _score_fetched(q, miss_dev, pos_dev, cand, int(k),
                                   int(metric))
         return d, i, info
+
+    def rerank_info(self, queries, candidates, k, metric):
+        return self.score(self.prepare(queries, candidates), k, metric)
 
     # -- warmup / stats ----------------------------------------------------
 

@@ -235,13 +235,21 @@ def active() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def check(stage: str, chunk: Optional[int] = None) -> None:
+def check(stage: str, chunk: Optional[int] = None,
+          stage_only: bool = False) -> None:
     """A fault point: raise the first matching live spec's synthetic
     error. Call this where a real device failure would surface (chunk
     boundaries of the streaming/build loops, stage entries of the
     measurement battery). Spec matching + one-shot consumption happen
     in ONE critical section (plan resolution included); the obs
-    bookkeeping and the raise run outside it."""
+    bookkeeping and the raise run outside it.
+
+    ``stage_only=True`` marks a fetch-stage fault point (graft-flow's
+    ``stream.read`` / ``tiered.fetch`` producers): only specs that name
+    the stage explicitly (``slow@stage:stream.read``, ordinals
+    included) match there — ``oom@chunk:N`` specs stay reserved for the
+    consuming dispatch, so chunk faults keep attributing to the
+    iteration that scores the chunk, never to a background read."""
     fired: Optional[FaultSpec] = None
     with _lock:
         for s in _plan_locked():
@@ -251,7 +259,8 @@ def check(stage: str, chunk: Optional[int] = None) -> None:
                 # proc_action, rpc_dropped), never raised here
                 continue
             if s.scope == "chunk":
-                hit = chunk is not None and int(s.arg) == chunk
+                hit = (not stage_only) and chunk is not None \
+                    and int(s.arg) == chunk
             elif "#" in s.arg:           # stage-scoped ordinal
                 name, _, idx = s.arg.rpartition("#")
                 hit = stage == name and chunk is not None \

@@ -35,6 +35,7 @@ serving instance of the resilience OOM ladder.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import threading
@@ -49,6 +50,7 @@ import numpy as np
 from raft_tpu import obs, tuning
 from raft_tpu.analysis import lockwatch
 from raft_tpu.obs import trace as obs_trace
+from raft_tpu.core import pipeline as _pipeline
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.distance.types import is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -137,6 +139,16 @@ class ServeParams:
     # reject with Overloaded(reason="quota") (transient).
     admission_quotas: Optional[Dict[str, int]] = None
     max_total_queue_rows: Optional[int] = None
+    # graft-flow dispatch pipelining (docs/serving.md §12): the batcher
+    # thread stops at ASYNC dispatch and hands the in-flight batch (a
+    # ticket holding its pinned generation) to a per-index completion
+    # thread that syncs + delivers, so batch N+1's host work — padding,
+    # H2D upload, and the tiered rerank gather — overlaps batch N's
+    # device time. The value bounds tickets in flight (backpressure
+    # blocks the batcher past it); 0 forces the classic synchronous
+    # dispatch, bitwise-identical results either way. None draws from
+    # tuning.budget("pipeline_depth") (default 2).
+    pipeline_depth: Optional[int] = None
 
 
 class _Handle:
@@ -458,6 +470,20 @@ class _IndexServing:
                                 self.batcher.max_batch_rows)
         if ceiling < self.batcher.max_batch_rows:
             self.batcher.set_ceiling(ceiling)
+        # graft-flow dispatch pipeline (docs/serving.md §12): bounded
+        # ticket queue between the batcher thread (async dispatch) and
+        # a completion thread (sync + deliver). Each ticket carries its
+        # OWN pinned generation — a hot swap or compaction can publish
+        # a new generation while the ticket is in flight and the old
+        # one stays alive until the ticket's release, exactly the
+        # invalidation contract the synchronous path had.
+        self.pipeline_depth = _pipeline.resolve_depth(
+            self.params.pipeline_depth)
+        self._pipe_cv = lockwatch.make_condition(
+            lockwatch.make_lock("serve.pipeline"))
+        self._pipe_q: collections.deque = collections.deque()
+        self._pipe_thread: Optional[threading.Thread] = None
+        self._pipe_stop = False
 
     # -- dispatch ----------------------------------------------------------
 
@@ -658,17 +684,19 @@ class _IndexServing:
                 part = regated[0]
             self._dispatch_part(part)
 
-    def _dispatch_part(self, batch: Batch) -> None:
+    def _dispatch_part(self, batch: Batch, force_sync: bool = False) -> None:
         try:
             _rerrors.run(
-                self._dispatch_once, batch,
+                functools.partial(self._dispatch_once,
+                                  force_sync=force_sync),
+                batch,
                 retries=self.params.dispatch_retries,
                 backoff_s=self.params.retry_backoff_s,
             )
         except BaseException as e:  # noqa: BLE001 — classified right below
             kind = _rerrors.classify(e)
             if kind == _rerrors.OOM and len(batch.requests) > 1:
-                self._downshift_and_split(batch)
+                self._downshift_and_split(batch, force_sync=force_sync)
                 return
             if kind == _rerrors.OOM:
                 # single request: record the learned ceiling anyway
@@ -688,7 +716,8 @@ class _IndexServing:
         obs.counter("oom_ladder_downshifts", path="serve")
         obs.event("serve_downshift", index=self.name, ceiling=new_ceiling)
 
-    def _downshift_and_split(self, batch: Batch) -> None:
+    def _downshift_and_split(self, batch: Batch,
+                             force_sync: bool = False) -> None:
         """The serving OOM ladder: halve the bucket ceiling and re-dispatch
         the batch as two ladder-shaped halves (requests are the atomic
         unit — row-independent searches make the split result-identical)."""
@@ -714,10 +743,14 @@ class _IndexServing:
             # the policy already chose, not re-partition (the member
             # futures' policy decisions are final)
             self._dispatch_part(
-                self._sub_batch(batch, part, rung=batch.rung))
+                self._sub_batch(batch, part, rung=batch.rung),
+                force_sync=force_sync)
 
-    def _dispatch_once(self, batch: Batch) -> None:
+    def _dispatch_once(self, batch: Batch,
+                       force_sync: bool = False) -> None:
+        pipelined = self.pipeline_depth > 0 and not force_sync
         gen, st = self._pin_consistent()
+        handed_off = False
         try:
             h: _Handle = gen.handle
             try:
@@ -739,13 +772,29 @@ class _IndexServing:
             t0 = time.perf_counter()
             with obs.span("serve.batch", index=self.name,
                           bucket=batch.bucket, rows=batch.rows,
-                          rung=batch.rung, generation=gen.version) as sp:
-                # fault point: where a real device failure would surface
+                          rung=batch.rung, generation=gen.version,
+                          pipelined=pipelined) as sp:
+                # fault point: where a real device failure would surface.
+                # Deliberately BEFORE the async handoff — injected faults
+                # strike here on the batcher thread, inside
+                # resilience.run, so retry and OOM-ladder semantics are
+                # byte-for-byte those of the synchronous path at any
+                # pipeline depth.
                 faultinject.check(stage="serve.dispatch", chunk=batch.seq)
                 d, i = self._run_search(
                     h, batch, main_bits, side_bits, side_idx, side_ids)
-                jax.block_until_ready((d, i))
+                if not pipelined:
+                    jax.block_until_ready((d, i))
                 sp.set(k_pad=int(d.shape[1]))
+            if pipelined:
+                # graft-flow handoff: the ticket owns the pin from here;
+                # the completion thread syncs, records service time, and
+                # delivers while this (batcher) thread pads + uploads +
+                # gathers for the NEXT batch. XLA's async dispatch means
+                # the device is already running this batch.
+                self._pipe_put((batch, gen, h, d, i, t0))
+                handed_off = True
+                return
             latency_ms = (time.perf_counter() - t0) * 1e3
             # feed the deadline machinery's service estimate (the
             # batcher's linger slack test and _shed_missed read the
@@ -755,7 +804,114 @@ class _IndexServing:
             self._deliver(batch, gen, h, np.asarray(d), np.asarray(i),
                           latency_ms)
         finally:
+            if not handed_off:
+                gen.release()
+
+    # -- graft-flow completion pipeline (docs/serving.md §12) --------------
+
+    def _pipe_put(self, ticket) -> None:
+        """Enqueue an in-flight batch for the completion thread, blocking
+        while ``pipeline_depth`` tickets are already outstanding — the
+        backpressure that bounds device-queue depth (and pinned
+        generations) exactly as the synchronous path did with one."""
+        t0 = time.perf_counter()
+        with self._pipe_cv:
+            while (len(self._pipe_q) >= self.pipeline_depth
+                   and not self._pipe_stop):
+                self._pipe_cv.wait(0.05)
+            waited_ms = (time.perf_counter() - t0) * 1e3
+            if waited_ms >= 0.05:
+                obs.observe("pipeline.stall_ms", waited_ms,
+                            path="serve.dispatch")
+            if self._pipe_stop:
+                # close raced the dispatch: complete inline — the ticket
+                # must never be dropped (its futures and pin would leak)
+                pass
+            else:
+                self._pipe_q.append(ticket)
+                obs.gauge("pipeline.occupancy", float(len(self._pipe_q)),
+                          path="serve.dispatch")
+                if self._pipe_thread is None or not self._pipe_thread.is_alive():
+                    self._pipe_thread = threading.Thread(
+                        target=self._complete_loop, daemon=True,
+                        name=f"serve-pipe-{self.name}")
+                    self._pipe_thread.start()
+                self._pipe_cv.notify_all()
+                return
+        self._complete_ticket(ticket)
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._pipe_cv:
+                while not self._pipe_q and not self._pipe_stop:
+                    self._pipe_cv.wait(0.05)
+                if not self._pipe_q:
+                    return                # stop + drained
+                ticket = self._pipe_q.popleft()
+                self._pipe_cv.notify_all()
+            self._complete_ticket(ticket)
+
+    def _complete_ticket(self, ticket) -> None:
+        """Sync one in-flight batch and deliver it, releasing the
+        ticket's generation pin. Error recovery mirrors
+        ``_dispatch_part``'s classification: a REAL device failure that
+        surfaces at the wait (injected faults never reach here — they
+        strike pre-dispatch) re-dispatches the batch in FORCED-SYNC
+        mode, so resilience.run's retry budget and the OOM
+        split-ladder apply without this thread ever re-entering its own
+        queue (the self-deadlock a recursive enqueue would be)."""
+        batch, gen, h, d, i, t0 = ticket
+        try:
+            try:
+                jax.block_until_ready((d, i))
+            except BaseException as e:  # noqa: BLE001 — classified below
+                kind = _rerrors.classify(e)
+                if kind in (_rerrors.TRANSIENT, _rerrors.DEAD,
+                            _rerrors.OOM):
+                    for r in batch.requests:
+                        obs_trace.stage(r.trace, "retry", status="retry",
+                                        reason="pipeline_sync", kind=kind)
+                    self._dispatch_part(batch, force_sync=True)
+                    return
+                for r in batch.requests:
+                    obs_trace.finish(r.trace, status="error", kind=kind,
+                                     error=type(e).__name__)
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                return
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            self.batcher.note_service_ms(batch.bucket, latency_ms,
+                                         rung=batch.rung)
+            self._deliver(batch, gen, h, np.asarray(d), np.asarray(i),
+                          latency_ms)
+        except BaseException as e:  # noqa: BLE001 — must not kill the loop
+            kind = _rerrors.classify(e)
+            for r in batch.requests:
+                if not r.future.done():
+                    obs_trace.finish(r.trace, status="error", kind=kind,
+                                     error=type(e).__name__)
+                    r.future.set_exception(e)
+        finally:
             gen.release()
+
+    def close_pipeline(self, timeout_s: float = 30.0) -> None:
+        """Drain outstanding tickets and join the completion thread.
+        Called after the batcher closes (no new tickets can arrive);
+        every queued ticket is still completed — futures resolve, pins
+        release — before the thread exits."""
+        with self._pipe_cv:
+            self._pipe_stop = True
+            self._pipe_cv.notify_all()
+            t = self._pipe_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout_s)
+        # a thread that never started (or died): drain inline
+        while True:
+            with self._pipe_cv:
+                if not self._pipe_q:
+                    break
+                ticket = self._pipe_q.popleft()
+            self._complete_ticket(ticket)
 
     def _run_search(self, h: _Handle, batch: Batch, main_bits: Bitset,
                     side_bits: Optional[Bitset], side_idx, side_ids):
@@ -1522,6 +1678,11 @@ class Server:
             servings = list(self._servings.values())
         for s in servings:
             s.batcher.close(timeout_s=timeout_s)
+        for s in servings:
+            # after the batcher drains no new tickets can arrive; now
+            # drain the graft-flow completion queue so every in-flight
+            # batch resolves its futures and releases its pin
+            s.close_pipeline(timeout_s=timeout_s)
         for name in self.registry.names():
             self.registry.drop(name)
 

@@ -727,6 +727,35 @@ def bench_serve_service(keys: List[Dict], reps: int = _DEF_REPS,
         yield dict(key, rung=rung), {"search": _median_ms(run, reps)}
 
 
+def bench_pipeline_depth(reps: int = 3, n_items: int = 24,
+                         work_ms: float = 2.0) -> Dict[str, float]:
+    """Race the graft-flow prefetch depths
+    (:data:`raft_tpu.core.pipeline.PIPELINE_DEPTH_CANDIDATES`) on a
+    balanced synthetic read/compute stream — equal sleep on the
+    producer (the host-tier read) and the consumer (the scoring loop),
+    the regime where overlap pays the most. The winner lands in the
+    table's ``pipeline_depth`` budget, which every streaming path reads
+    through :func:`raft_tpu.core.pipeline.resolve_depth` when the
+    caller leaves the depth defaulted."""
+    from raft_tpu.core import pipeline as gf
+
+    def run(depth: int) -> float:
+        def source():
+            for i in range(n_items):
+                time.sleep(work_ms / 1e3)
+                yield i
+
+        t0 = time.perf_counter()
+        with gf.Prefetcher(source, depth=depth,
+                           path="capture.pipeline") as pf:
+            for _ in pf:
+                time.sleep(work_ms / 1e3)
+        return (time.perf_counter() - t0) * 1e3
+
+    return {str(depth): min(run(depth) for _ in range(max(reps, 1)))
+            for depth in gf.PIPELINE_DEPTH_CANDIDATES}
+
+
 def default_budgets() -> Dict[str, int]:
     """Measured-environment byte budgets. The CAGRA inline budget tracks
     the device HBM actually present (packed table + dataset + transients
@@ -774,7 +803,8 @@ def capture(backend: Optional[str] = None, quick: bool = True,
     want = set(ops) if ops else {"select_k", "merge_topk", "ivf_scan",
                                  "pq_scan", "ivf_scan_extract",
                                  "fused_topk_tile", "serve_service",
-                                 "graph_join", "beam_step_tile"}
+                                 "graph_join", "beam_step_tile",
+                                 "pipeline_depth"}
     if "select_k" in want:
         for key in select_grid(quick):
             times = bench_select(key, reps=reps)
@@ -858,6 +888,14 @@ def capture(backend: Optional[str] = None, quick: bool = True,
         # robust proxy that shrinks to ~nothing on a real chip)
         t.set_budget("serve_deadline_headroom_ms",
                      max(5, int(round(float(np.median(medians))))))
+    if "pipeline_depth" in want:
+        # graft-flow depth race (host-side timing, backend-independent):
+        # the measured winner becomes the default prefetch depth for
+        # every streaming path on this backend
+        times = bench_pipeline_depth(reps=min(reps, 3))
+        winner = t.record("pipeline_depth", {"shape": "balanced"}, times)
+        log(f"pipeline_depth balanced -> {winner} {times}")
+        t.set_budget("pipeline_depth", int(winner))
     for name, val in default_budgets().items():
         t.set_budget(name, val)
     return t

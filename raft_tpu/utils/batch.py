@@ -57,11 +57,20 @@ class BatchLoadIterator:
         self.batch_size = max(int(rows), 1)
 
     def __iter__(self) -> Iterator[Tuple[int, jax.Array]]:
+        from raft_tpu.resilience import faultinject
+
         n = self.host.shape[0]
         pending: Optional[Tuple[int, jax.Array]] = None
         start = self.start_row
+        bi = 0
         while start < n:
             bs = self.batch_size          # re-read: see set_batch_rows
+            # the read-side fault point (``stream.read``): a slow@stage
+            # spec here models host-tier fetch latency, an error spec
+            # strikes on whichever thread runs the read — inline, or a
+            # graft-flow producer that carries it to the consuming next()
+            faultinject.check(stage="stream.read", chunk=bi,
+                              stage_only=True)
             stop = min(start + bs, n)
             chunk = self.host[start:stop]
             if self.pad_to_full and chunk.shape[0] < bs:
@@ -72,6 +81,7 @@ class BatchLoadIterator:
                 yield pending
             pending = (start, dev)
             start = stop
+            bi += 1
         if pending is not None:
             yield pending
 
@@ -89,7 +99,8 @@ class FileBatchLoadIterator:
     """
 
     def __init__(self, path: str, batch_rows: int, dtype=None,
-                 device=None, pad_to_full: bool = False, depth: int = 2):
+                 device=None, pad_to_full: bool = False, depth: int = 2,
+                 start_row: int = 0):
         from raft_tpu.bench.datasets import _dtype_for
 
         self.path = path
@@ -100,25 +111,39 @@ class FileBatchLoadIterator:
         self.device = device
         self.pad_to_full = pad_to_full
         self.depth = depth
+        self.start_row = int(start_row)
 
     @property
     def shape(self):
         return (self.n, self.d)
 
     def __len__(self) -> int:
-        return -(-self.n // self.batch_rows)
+        return -(-max(self.n - self.start_row, 0) // self.batch_rows)
+
+    def set_batch_rows(self, rows: int) -> None:
+        """Shrink (or grow) the batch size — the OOM ladder's iterator
+        hook (see :meth:`BatchLoadIterator.set_batch_rows`). The native
+        prefetcher's block size is fixed per ``__iter__``, so this takes
+        effect at the next (re)start, which is exactly when graft-flow's
+        downshift flush re-iterates."""
+        self.batch_rows = max(int(rows), 1)
 
     def __iter__(self):
         from raft_tpu.native import FilePrefetcher
+        from raft_tpu.resilience import faultinject
 
         row_bytes = self.d * self.dtype.itemsize
+        start = self.start_row                # row-exact restart point
         pf = FilePrefetcher(
-            self.path, offset=8, block_bytes=self.batch_rows * row_bytes,
-            total_bytes=self.n * row_bytes, depth=self.depth,
+            self.path, offset=8 + start * row_bytes,
+            block_bytes=self.batch_rows * row_bytes,
+            total_bytes=(self.n - start) * row_bytes, depth=self.depth,
         )
-        offset = 0
+        offset = start
         pending = None
-        for raw in pf:
+        for bi, raw in enumerate(pf):
+            faultinject.check(stage="stream.read", chunk=bi,
+                              stage_only=True)
             rows = raw.size // row_bytes
             chunk = raw[: rows * row_bytes].view(self.dtype).reshape(
                 rows, self.d

@@ -22,6 +22,14 @@ and records recall / QPS / bytes-moved (vs the full-upload baseline)
 to the device full-upload rerank on the same shortlists.
 ``--tiered-only`` skips the main battery (the CPU-smoke acceptance
 shape; pair with --n 200000 and DEEP100M_FORCE_CPU=1).
+
+graft-flow acceptance (ISSUE 16): ``--pipeline-out PIPE_r16.json``
+(with ``--pipeline-only`` to skip the main battery) measures the
+prefetch pipeline on the memmap tiered rerank leg — depth 0 (serial)
+vs ``--pipeline-depth`` (default 2) wall-clock under an injected slow
+fetch, with the stall/occupancy columns and the overlap fraction
+``1 - stall(depth)/stall(0)`` — asserting bitwise-identical results
+between the legs.
 """
 
 import json
@@ -233,6 +241,152 @@ def tiered_stage(out_path: str, n: int, cpu_smoke: bool) -> dict:
     return res
 
 
+def pipeline_stage(out_path: str, n: int, cpu_smoke: bool,
+                   depth: int = 2) -> dict:
+    """ISSUE 16 acceptance: graft-flow prefetch on the memmap tiered
+    rerank leg. Runs the SAME Zipf-free query battery through
+    ``ivf_pq.search_refined_stream`` serially (depth 0) and pipelined
+    (``depth``) under an injected slow fetch, and records wall-clock
+    speedup, stall totals, and the overlap fraction
+    ``1 - stall(depth)/stall(0)`` in a dated ``PIPE_r16.json``.
+
+    The injection models both sides of the overlap on the CPU smoke:
+    ``slow@stage:tiered.fetch`` is the host/SSD tier's fetch latency
+    (producer side), ``slow@stage:tiered.score`` stands in for the
+    device scan time the CPU host-loop lacks (consumer side) — on TPU
+    the score side is real device time and needs no injection. The
+    sleep length is calibrated to 2x the measured uninjected per-batch
+    time, so the serial leg pays fetch+score stacked while the
+    pipelined leg pays only the longer of the two. Results must be
+    bitwise identical between the legs (GL005: every number dated and
+    platform-labeled)."""
+    import tempfile
+
+    from raft_tpu import obs
+    from raft_tpu.bench.run import _gen_device_block
+    from raft_tpu.neighbors import ivf_pq, tiered
+    from raft_tpu.resilience import faultinject
+
+    d, k, rr = 96, 10, 3
+    bs = 50_000
+    n_lists = max(32, min(512, n // 512))
+    # lighter probe work than tiered_stage: the overlap ratio is
+    # shape-independent and the CPU-smoke xla scan is the bottleneck
+    n_probes = max(8, n_lists // 32)
+    batch_q, n_batches = 256, 8
+    m = batch_q * n_batches
+    hot_rows = 4096          # small on purpose: misses keep the gather real
+    gen = _gen_device_block(bs, d, 16)
+    key0 = jax.random.PRNGKey(71)
+    nb = -(-n // bs)
+
+    tmp = tempfile.NamedTemporaryFile(suffix=".f32", delete=False)
+    mm = np.memmap(tmp.name, dtype=np.float32, mode="w+", shape=(n, d))
+    for b in range(nb):
+        blk = np.asarray(gen(jax.random.fold_in(key0, b)))
+        rows = min(bs, n - b * bs)
+        mm[b * bs:b * bs + rows] = blk[:rows]
+    mm.flush()
+    mm = np.memmap(tmp.name, dtype=np.float32, mode="r", shape=(n, d))
+    print(f"pipeline: host tier materialized ({n}x{d} f32, "
+          f"{mm.nbytes / 1e6:.0f} MB memmap)", flush=True)
+
+    params = ivf_pq.IndexParams(
+        n_lists=n_lists, pq_dim=64, pq_bits=8, kmeans_n_iters=4,
+        cache_dtype="i4",
+    )
+
+    def make_batches():
+        for b in range(nb):
+            yield jnp.asarray(np.asarray(mm[b * bs:(b + 1) * bs]))
+
+    trainset = jnp.asarray(np.asarray(mm[:min(n, 4 * bs)]))
+    index = ivf_pq.build_streamed(
+        params, make_batches, n, d, trainset, keep_codes=False,
+        cap_rows=int(1.4 * n / n_lists), verbose=False,
+        pipeline_depth=depth,
+    )
+    jax.block_until_ready(index.list_sizes)
+
+    qgen = _gen_device_block(m, d, 16)
+    queries = np.asarray(qgen(jax.random.fold_in(key0, 10_000)))
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_impl="xla")
+    kc = ivf_pq.refined_shortlist_width(sp, index, k, rr)
+    obs.set_mode("on")
+
+    def leg(depth_leg):
+        src = tiered.HostArraySource(mm, hot_rows=hot_rows,
+                                     promote_after=1, promote_batch=1024)
+        src.warm(batch_q, kc, k, index.metric)
+        obs.reset()
+        t0 = time.perf_counter()
+        d_, i_ = ivf_pq.search_refined_stream(
+            sp, index, queries, k, refine_ratio=rr, dataset=src,
+            batch_rows=batch_q, pipeline_depth=depth_leg)
+        wall = time.perf_counter() - t0
+        snap = obs.snapshot()
+        stall = 0.0
+        occ = None
+        for p in snap["metrics"].get("pipeline.stall_ms",
+                                     {}).get("points", []):
+            if p["labels"].get("path") == "tiered.rerank":
+                stall += p.get("sum", 0.0)
+        for p in snap["metrics"].get("pipeline.occupancy",
+                                     {}).get("points", []):
+            if p["labels"].get("path") == "tiered.rerank":
+                occ = p.get("value")
+        return d_, i_, wall, stall, occ
+
+    # warmup pass (compiles every rung), THEN an uninjected serial pass
+    # whose per-batch time sizes the injected sleep at 2x the real work
+    # — calibrating on the warmup pass would fold the XLA compile into
+    # the sleep and balloon the injected legs
+    leg(0)
+    _, _, wall_cal, _, _ = leg(0)
+    slow_ms = max(25.0, round(2e3 * wall_cal / n_batches, 1))
+    if "RAFT_TPU_FAULTS_SLOW_MS" not in os.environ:
+        os.environ["RAFT_TPU_FAULTS_SLOW_MS"] = str(slow_ms)
+    strikes = 1000 * n_batches
+    spec = (f"slow@stage:tiered.fetch*{strikes},"
+            f"slow@stage:tiered.score*{strikes}")
+    with faultinject.inject(spec):
+        d0, i0, wall0, stall0, _ = leg(0)
+    with faultinject.inject(spec):
+        dN, iN, wallN, stallN, occN = leg(depth)
+    bitwise = bool(np.array_equal(d0, dN) and np.array_equal(i0, iN))
+    res = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.devices()[0].platform,
+        "config": {"n": n, "dim": d, "n_lists": n_lists,
+                   "n_probes": n_probes, "k": k, "refine_ratio": rr,
+                   "batch_rows": batch_q, "n_batches": n_batches,
+                   "hot_rows": hot_rows, "pipeline_depth": depth,
+                   "slow_ms": float(os.environ["RAFT_TPU_FAULTS_SLOW_MS"]),
+                   "fault_spec": spec},
+        "bitwise_identical_serial_vs_pipelined": bitwise,
+        "wall_serial_s": round(wall0, 3),
+        "wall_pipelined_s": round(wallN, 3),
+        "speedup": round(wall0 / max(wallN, 1e-9), 2),
+        "stall_serial_ms": round(stall0, 1),
+        "stall_pipelined_ms": round(stallN, 1),
+        "overlap_fraction": round(1.0 - stallN / max(stall0, 1e-9), 3),
+        "occupancy_pipelined": (round(occN, 2)
+                                if occN is not None else None),
+        "timing": "wall-clock over %d x %d query batches, injected "
+                  "slow fetch+score" % (n_batches, batch_q),
+    }
+    if cpu_smoke:
+        res["note"] = ("CPU smoke: tiered.score slow-injection models "
+                       "the device scan the host loop lacks; on TPU the "
+                       "score side is real device time")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+        f.write("\n")
+    os.unlink(tmp.name)
+    print(json.dumps(res))
+    return res
+
+
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     out_path = args[0] if args else "DEEP100M.json"
@@ -242,9 +396,20 @@ def main():
     tiered_out = None
     if "--tiered-out" in sys.argv:
         tiered_out = sys.argv[sys.argv.index("--tiered-out") + 1]
+    pipe_out = None
+    if "--pipeline-out" in sys.argv:
+        pipe_out = sys.argv[sys.argv.index("--pipeline-out") + 1]
+    pipe_depth = 2
+    if "--pipeline-depth" in sys.argv:
+        pipe_depth = int(sys.argv[sys.argv.index("--pipeline-depth") + 1])
     if "--tiered-only" in sys.argv:
         tiered_stage(tiered_out or "TIERED_r12.json", n,
                      bool(os.environ.get("DEEP100M_FORCE_CPU")))
+        return
+    if "--pipeline-only" in sys.argv:
+        pipeline_stage(pipe_out or "PIPE_r16.json", n,
+                       bool(os.environ.get("DEEP100M_FORCE_CPU")),
+                       depth=pipe_depth)
         return
     scan_impl = "pallas"
     if "--scan-impl" in sys.argv:   # CPU smoke: pass pallas_interpret
@@ -401,6 +566,8 @@ def main():
     print(json.dumps(res))
     if tiered_out:
         tiered_stage(tiered_out, n, cpu_smoke)
+    if pipe_out:
+        pipeline_stage(pipe_out, n, cpu_smoke, depth=pipe_depth)
 
 
 if __name__ == "__main__":

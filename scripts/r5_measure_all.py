@@ -129,6 +129,14 @@ OPTIONAL_STAGES = [
       "--concurrency", "8", "--duration-s", "30", "--k", "1,10",
       "--out", "SERVE_TIERED_r12.json",
       "--merge-into", "TIERED_r12.json"], 1200),
+    # graft-flow acceptance (ISSUE 16): serial vs pipelined memmap
+    # tiered rerank under injected slow fetch — wall-clock speedup,
+    # stall totals, overlap fraction, bitwise verdict (PIPE_r16.json;
+    # on chip day the score-side injection is dropped and the overlap
+    # hides real device scan time)
+    ("pipeline",
+     [PY, "scripts/deep100m.py", "--pipeline-only", "--n", "1000000",
+      "--pipeline-out", "PIPE_r16.json"], 2700),
 ]
 
 

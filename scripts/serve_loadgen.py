@@ -120,6 +120,13 @@ def main() -> int:
                     help="also merge the tiered/zipf summary into this "
                          "existing JSON artifact under 'serve_zipf' "
                          "(the TIERED_r12.json acceptance wiring)")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="graft-flow dispatch pipeline depth (tickets "
+                         "in flight past async dispatch; 0 = classic "
+                         "synchronous dispatch, default: the "
+                         "pipeline_depth tuning budget). The report's "
+                         "'pipeline' section carries the stall/occupancy "
+                         "columns for the depth-0-vs-N overlap A/B")
     ap.add_argument("--max-batch-rows", type=int, default=128)
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue-rows", type=int, default=2048)
@@ -229,6 +236,7 @@ def main() -> int:
         result_cache_entries=args.result_cache,
         adaptive_probes=args.adaptive,
         deadline_ms=args.deadline_ms,
+        pipeline_depth=args.pipeline_depth,
     )
     srv = serve.Server(params)
     t_build = time.perf_counter()
@@ -358,6 +366,26 @@ def main() -> int:
                                        index="default") or 0,
         "steady_state_retraces": int(traces_after - traces_before),
     }
+
+    def _hist(name, **labels):
+        want = {str(k): str(v) for k, v in labels.items()}
+        for p in snap["metrics"].get(name, {}).get("points", []):
+            if all(p["labels"].get(k) == v for k, v in want.items()):
+                return p
+        return None
+
+    from raft_tpu.core import pipeline as _gf
+
+    stall = _hist("pipeline.stall_ms", path="serve.dispatch")
+    pipe_cols = {
+        # backpressure stalls = the batcher blocked on a full ticket
+        # queue; run the depth-0 vs depth-N A/B to derive the overlap
+        # fraction 1 - stall(N)/stall(0) (docs/observability.md)
+        "depth": _gf.resolve_depth(args.pipeline_depth),
+        "stall_ms_total": (round(stall["sum"], 1) if stall else 0.0),
+        "stalls": (int(stall["count"]) if stall else 0),
+        "occupancy": _metric("pipeline.occupancy", path="serve.dispatch"),
+    }
     report = {
         "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "config": {
@@ -368,9 +396,11 @@ def main() -> int:
             "max_queue_rows": args.max_queue_rows,
             "tiered": args.tiered, "refine_ratio": args.refine_ratio,
             "hot_rows": args.hot_rows, "result_cache": args.result_cache,
+            "pipeline_depth": pipe_cols["depth"],
             "duration_s": round(wall_s, 2), "build_s": round(build_s, 2),
         },
         "tiered": tiered_cols,
+        "pipeline": pipe_cols,
         "throughput_qps": round(counts["completed"] / max(wall_s, 1e-9), 1),
         **counts,
         "swap_generation": swap_version,

@@ -1,0 +1,464 @@
+"""graft-flow prefetch pipeline (ISSUE 16): unit semantics of
+raft_tpu.core.pipeline plus on-vs-off bitwise acceptance on every wired
+streaming path — host-array search, tiered refined search, streamed
+build, and the serving dispatcher — with the fault-injection legs
+(OOM ladder, kill+resume, slow fetch) run at depth > 1 so prefetched
+chunks are actually in flight when the fault strikes."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve, tuning
+from raft_tpu.analysis import lockwatch
+from raft_tpu.core import pipeline
+from raft_tpu.core.interruptible import Interruptible, InterruptedException
+from raft_tpu.neighbors import brute_force, ivf_pq, tiered
+from raft_tpu.neighbors.stream import search_host_array
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.resilience import faultinject
+
+pytestmark = [pytest.mark.threadsan]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    # sanitized locks: every pipeline/serve lock in this suite goes
+    # through lockwatch, so the whole file doubles as the THREADSAN leg
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    faultinject.clear()
+    yield
+    faultinject.clear()
+    tuning.reload()
+
+
+def _no_prefetch_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("raft-tpu-prefetch")]
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher units
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [0, 1, 2, 4])
+def test_ordering_every_depth(depth):
+    with pipeline.Prefetcher(lambda: iter(range(20)), depth=depth) as pf:
+        assert list(pf) == list(range(20))
+    assert _no_prefetch_threads() == []
+
+
+def test_off_mode_spawns_no_thread():
+    before = set(threading.enumerate())
+    with pipeline.Prefetcher(lambda: iter(range(5)), depth=0) as pf:
+        assert list(pf) == [0, 1, 2, 3, 4]
+        assert set(threading.enumerate()) == before
+
+
+def test_resolve_depth():
+    assert pipeline.resolve_depth(3) == 3
+    assert pipeline.resolve_depth(-1) == 0          # clamped, never negative
+    assert pipeline.resolve_depth(None) == pipeline.DEFAULT_DEPTH
+
+
+def test_producer_error_surfaces_at_consuming_next():
+    """The ORIGINAL exception object crosses the thread boundary and
+    raises at the iteration that would have consumed the bad item —
+    classification (resilience.errors / faultinject types) survives."""
+    boom = faultinject.InjectedOOM("RESOURCE_EXHAUSTED: injected")
+
+    def source():
+        yield 0
+        yield 1
+        raise boom
+
+    with pipeline.Prefetcher(source, depth=2) as pf:
+        it = iter(pf)
+        assert next(it) == 0
+        assert next(it) == 1
+        with pytest.raises(faultinject.InjectedOOM) as ei:
+            next(it)
+        assert ei.value is boom
+    assert _no_prefetch_threads() == []
+
+
+def test_cross_thread_cancel_joins_producer_promptly():
+    """cancel() from another thread unparks a stalled consumer and the
+    producer thread is gone shortly after — GL014's no-leak contract."""
+    tok = Interruptible()
+
+    def source():
+        yield 0
+        while True:                     # producer that would run forever
+            time.sleep(0.01)
+            yield 1
+
+    pf = pipeline.Prefetcher(source, depth=1, token=tok)
+    it = iter(pf)
+    assert next(it) == 0
+    threading.Timer(0.05, tok.cancel).start()
+    t0 = time.perf_counter()
+    with pytest.raises(InterruptedException):
+        while True:
+            next(it)
+    assert time.perf_counter() - t0 < 5.0
+    pf.close()
+    deadline = time.time() + 5.0
+    while _no_prefetch_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert _no_prefetch_threads() == []
+
+
+def test_flush_restarts_from_mutated_source():
+    """flush() drops buffered items and re-iterates the source — the OOM
+    downshift hook: rewind/shrink, then flush, and in-flight chunks are
+    re-read under the new geometry."""
+
+    class Src:
+        start = 0
+
+        def __iter__(self):
+            return iter(range(self.start, 10))
+
+    src = Src()
+    pf = pipeline.Prefetcher(src, depth=4)
+    it = iter(pf)
+    assert [next(it), next(it)] == [0, 1]
+    src.start = 7
+    pf.flush()
+    assert list(pf) == [7, 8, 9]
+    assert _no_prefetch_threads() == []
+
+
+def test_stall_metric_and_stats():
+    obs.set_mode("on")
+    obs_metrics.reset()
+    try:
+        def slow_source():
+            for i in range(4):
+                time.sleep(0.01)
+                yield i
+
+        with pipeline.Prefetcher(slow_source, depth=0, path="t.off") as pf:
+            list(pf)
+            off = pf.stats()
+        assert off["depth"] == 0
+        # off mode books the full inline read time as stall
+        assert off["stall_ms"] >= 4 * 10 * 0.5
+        assert off["items"] == 4
+        snap = obs_metrics.snapshot(runtime_gauges=False)
+        paths = {p["labels"].get("path")
+                 for p in snap["metrics"]["pipeline.stall_ms"]["points"]}
+        assert "t.off" in paths
+
+        with pipeline.Prefetcher(slow_source, depth=2, path="t.on") as pf:
+            for _ in pf:
+                time.sleep(0.02)        # consumer slower than producer
+            on = pf.stats()
+        assert on["items"] == 4
+        assert 0.0 <= on["occupancy"] <= 2.0
+        # producer stays ahead: stall well under the serial read time
+        assert on["stall_ms"] < off["stall_ms"]
+    finally:
+        obs.set_mode(None)
+        obs_metrics.reset()
+
+
+def test_overlap_chains_stages_in_order():
+    calls = []
+
+    def upload(x):
+        calls.append(("u", x))
+        return x * 10
+
+    def compute(x):
+        calls.append(("c", x))
+        return x + 1
+
+    out = pipeline.overlap(lambda: iter(range(6)), upload, compute, depth=2)
+    with out:
+        assert list(out) == [i * 10 + 1 for i in range(6)]
+    assert [c for k, c in calls if k == "u"] == list(range(6))
+    assert _no_prefetch_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# wired path 1: host-array streaming search
+# ---------------------------------------------------------------------------
+
+_N, _D, _M, _K, _BATCH = 600, 24, 300, 10, 64
+
+
+class _BF:
+    @staticmethod
+    def search(sp, index, batch, k):
+        return brute_force.search(index, batch, k)
+
+
+@pytest.fixture(scope="module")
+def stream_data():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((_N, _D)).astype(np.float32)
+    q = rng.standard_normal((_M, _D)).astype(np.float32)
+    return x, q, brute_force.build(x)
+
+
+def test_stream_on_vs_off_bitwise(stream_data):
+    x, q, index = stream_data
+    base = search_host_array(_BF, None, index, q, _K, batch_rows=_BATCH,
+                             pipeline_depth=0)
+    for depth in (1, 2, 4):
+        d, i = search_host_array(_BF, None, index, q, _K,
+                                 batch_rows=_BATCH, pipeline_depth=depth)
+        assert np.array_equal(d, base[0]) and np.array_equal(i, base[1])
+
+
+def test_stream_oom_ladder_bitwise_with_prefetch_in_flight(stream_data):
+    """oom@chunk strikes the CONSUMING dispatch while later chunks are
+    already prefetched; the downshift rewinds + flushes and the result
+    stays bitwise with the uninjected run."""
+    x, q, index = stream_data
+    base_d, base_i = search_host_array(_BF, None, index, q, _K,
+                                       batch_rows=_BATCH, pipeline_depth=0)
+    with faultinject.inject("oom@chunk:2"):
+        d, i = search_host_array(_BF, None, index, q, _K, batch_rows=_BATCH,
+                                 backoff_s=0.001, pipeline_depth=2)
+    assert np.array_equal(d, base_d)
+    assert np.array_equal(i, base_i)
+    assert tuning.runtime_budget("stream_batch_rows") == _BATCH // 2
+
+
+def test_stream_kill_resume_bitwise_with_prefetch_in_flight(
+        stream_data, tmp_path):
+    """A kill at chunk 3 with depth=2 (chunks 4/5 prefetched but
+    unscored) checkpoints only CONSUMED rows; resume is bitwise."""
+    import json
+    import os
+
+    x, q, index = stream_data
+    base_d, base_i = search_host_array(_BF, None, index, q, _K,
+                                       batch_rows=_BATCH, pipeline_depth=0)
+    ckdir = str(tmp_path / "ck")
+    with faultinject.inject("dead@chunk:3"):
+        with pytest.raises(faultinject.InjectedDeadBackend):
+            search_host_array(_BF, None, index, q, _K, batch_rows=_BATCH,
+                              checkpoint_dir=ckdir, checkpoint_every=1,
+                              retries=0, pipeline_depth=2)
+    manifest = json.load(open(os.path.join(ckdir, "manifest.json")))
+    # prefetched-but-unscored chunks are NOT in the checkpoint
+    assert manifest["meta"]["rows_done"] == 3 * _BATCH
+    d, i = search_host_array(_BF, None, index, q, _K, batch_rows=_BATCH,
+                             checkpoint_dir=ckdir, resume=True,
+                             pipeline_depth=2)
+    assert np.array_equal(d, base_d)
+    assert np.array_equal(i, base_i)
+
+
+def test_stream_slow_fetch_overlap_speedup(stream_data, monkeypatch):
+    """With an injected slow read AND slow dispatch (40 ms each), the
+    serial run pays both per chunk while depth=2 overlaps them — the
+    in-suite version of the PIPE_r16.json acceptance measurement."""
+    x, q, index = stream_data
+    monkeypatch.setenv("RAFT_TPU_FAULTS_SLOW_MS", "40")
+    spec = "slow@stage:stream.read*100,slow@stage:search*100"
+    search_host_array(_BF, None, index, q, _K, batch_rows=_BATCH,
+                      pipeline_depth=0)       # warm compile out of the timing
+    with faultinject.inject(spec):
+        t0 = time.perf_counter()
+        base = search_host_array(_BF, None, index, q, _K,
+                                 batch_rows=_BATCH, pipeline_depth=0)
+        serial_s = time.perf_counter() - t0
+    with faultinject.inject(spec):
+        t0 = time.perf_counter()
+        over = search_host_array(_BF, None, index, q, _K,
+                                 batch_rows=_BATCH, pipeline_depth=2)
+        overlap_s = time.perf_counter() - t0
+    assert np.array_equal(base[0], over[0])
+    assert np.array_equal(base[1], over[1])
+    # ~5 chunks x 80ms serial vs ~5 x 40ms overlapped; generous margin
+    # for CI noise
+    assert serial_s > 1.25 * overlap_s, (serial_s, overlap_s)
+
+
+# ---------------------------------------------------------------------------
+# wired path 2: tiered refined search (fetch/score overlap)
+# ---------------------------------------------------------------------------
+
+def test_refined_stream_on_vs_off_bitwise():
+    rng = np.random.default_rng(11)
+    n, d, m = 3000, 32, 500
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((m, d)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=4,
+                           kmeans_trainset_fraction=1.0), x)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    outs = {}
+    for depth in (0, 2, 4):
+        # fresh source per depth: promotion state is traffic-dependent
+        # accounting, but scored VALUES must not depend on it
+        src = tiered.HostArraySource(x, hot_rows=512, promote_after=1,
+                                     promote_batch=128)
+        outs[depth] = ivf_pq.search_refined_stream(
+            sp, idx, q, 10, refine_ratio=2, dataset=src,
+            batch_rows=128, pipeline_depth=depth)
+    for depth in (2, 4):
+        assert np.array_equal(outs[depth][0], outs[0][0]), depth
+        assert np.array_equal(outs[depth][1], outs[0][1]), depth
+
+
+def test_refined_stream_slow_fetch_injection_attributes_to_consumer():
+    """A fault spec scoped to the fetch stage strikes (on the producer
+    thread at depth>0) and still surfaces at the consuming iteration."""
+    rng = np.random.default_rng(12)
+    n, d = 1500, 32
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((100, d)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=16, kmeans_n_iters=4,
+                           kmeans_trainset_fraction=1.0), x)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    src = tiered.HostArraySource(x, hot_rows=256)
+    with faultinject.inject("dead@stage:tiered.fetch"):
+        with pytest.raises(faultinject.InjectedDeadBackend):
+            ivf_pq.search_refined_stream(sp, idx, q, 10, dataset=src,
+                                         batch_rows=32, pipeline_depth=2)
+    assert _no_prefetch_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# wired path 3: streamed build
+# ---------------------------------------------------------------------------
+
+def test_build_streamed_on_vs_off_bitwise():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    n, d, bs = 4000, 32, 1024
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=4,
+                                kmeans_trainset_fraction=1.0)
+
+    def make_batches():
+        xd = jnp.asarray(x)
+        npad = -(-n // bs) * bs
+        xp = jnp.pad(xd, ((0, npad - n), (0, 0)))
+        for off in range(0, npad, bs):
+            yield xp[off:off + bs]
+
+    off = ivf_pq.build_streamed(params, make_batches, n, d, trainset=x,
+                                pipeline_depth=0)
+    on = ivf_pq.build_streamed(params, make_batches, n, d, trainset=x,
+                               pipeline_depth=2)
+    np.testing.assert_array_equal(np.asarray(on.list_sizes),
+                                  np.asarray(off.list_sizes))
+    np.testing.assert_array_equal(np.asarray(on.indices),
+                                  np.asarray(off.indices))
+    np.testing.assert_array_equal(np.asarray(on.codes),
+                                  np.asarray(off.codes))
+    assert _no_prefetch_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# wired path 4: serving dispatcher
+# ---------------------------------------------------------------------------
+
+_SN, _SD = 320, 16
+
+
+def _serve_params(depth, **kw):
+    kw.setdefault("max_batch_rows", 16)
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("max_k", 8)
+    return serve.ServeParams(pipeline_depth=depth, **kw)
+
+
+def test_serve_pipeline_on_vs_off_matches_under_mutation():
+    """Same mutation + query traffic against a pipelined and a
+    synchronous server yields identical results — delete/upsert/swap
+    invalidation holds with tickets in flight (each ticket pins its
+    generation)."""
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((_SN, _SD)).astype(np.float32)
+    x2 = rng.standard_normal((_SN, _SD)).astype(np.float32)
+    q = rng.standard_normal((40, _SD)).astype(np.float32)
+    up = rng.standard_normal((3, _SD)).astype(np.float32)
+    outs = {}
+    for depth in (0, 2):
+        with serve.Server(_serve_params(depth)) as srv:
+            srv.create_index("default", x)
+            got = [srv.search(q[:7], 5)]
+            srv.delete([1, 2, 3])
+            got.append(srv.search(q[7:20], 5))
+            srv.upsert(up, [_SN + 1, _SN + 2, _SN + 3])
+            got.append(srv.search(q[20:31], 5))
+            srv.swap("default", dataset=x2, wait=True)
+            got.append(srv.search(q[31:], 5))
+            outs[depth] = got
+    for (d0, i0), (d2, i2) in zip(outs[0], outs[2]):
+        assert np.array_equal(d0, d2)
+        assert np.array_equal(i0, i2)
+
+
+def test_serve_pipeline_trace_stable_under_mutation_traffic():
+    """Steady-state serving with the dispatch pipeline on adds ZERO
+    traces across delete/upsert traffic (the GL007 hook, pipelined)."""
+    rng = np.random.default_rng(22)
+    x = rng.standard_normal((_SN, _SD)).astype(np.float32)
+    q = rng.standard_normal((24, _SD)).astype(np.float32)
+    with serve.Server(_serve_params(2, max_wait_ms=0.5)) as srv:
+        srv.create_index("default", x)
+        srv.delete([1, 2])
+        srv.search(q[:3], 4)
+        before = serve.trace_cache_sizes()
+        for rows in (1, 3, 7, 2, 11, 16, 5):
+            block = rng.standard_normal((rows, _SD)).astype(np.float32)
+            srv.search(block, 4)
+        srv.delete([9])
+        srv.search(q[:2], 3)
+        srv.upsert(rng.standard_normal(_SD).astype(np.float32), [_SN + 9])
+        srv.search(q[:5], 4)
+        after = serve.trace_cache_sizes()
+        assert after == before, (
+            f"pipelined steady-state serving retraced: {before} -> {after}")
+
+
+def test_serve_pipeline_concurrent_load_all_futures_resolve():
+    """Concurrent submitters + a hot swap mid-flight: every future
+    resolves (no ticket dropped, no pin leaked) and close() drains."""
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((_SN, _SD)).astype(np.float32)
+    x2 = rng.standard_normal((_SN, _SD)).astype(np.float32)
+    with serve.Server(_serve_params(2)) as srv:
+        srv.create_index("default", x)
+        futs = []
+        errs = []
+
+        def worker(wid):
+            r = np.random.default_rng(wid)
+            for _ in range(12):
+                qb = r.standard_normal((3, _SD)).astype(np.float32)
+                try:
+                    futs.append(srv.submit(qb, 4))
+                except Exception as e:     # noqa: BLE001
+                    errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+        for t in ts:
+            t.start()
+        srv.swap("default", dataset=x2, wait=True)
+        for t in ts:
+            t.join()
+        assert not errs
+        for f in futs:
+            d, i = f.result(timeout=30)
+            assert d.shape == (3, 4)
+    # server closed: completion thread drained and gone
+    deadline = time.time() + 5.0
+    while any(t.name.startswith("serve-pipe")
+              for t in threading.enumerate()) and time.time() < deadline:
+        time.sleep(0.01)
+    assert not any(t.name.startswith("serve-pipe")
+                   for t in threading.enumerate())
