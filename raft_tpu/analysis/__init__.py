@@ -9,12 +9,17 @@ Four engines, one rule set (see ``docs/static_analysis.md``):
 * :mod:`raft_tpu.analysis.jaxpr_audit` — traces the registered public
   entry points on CPU and walks the jaxprs (GL001/GL003/GL004 with
   real dataflow, plus the GL007 recompile audit).
-* :mod:`raft_tpu.analysis.races` — graft-race: lock-discipline lint
-  over the threaded serving tier (GL010-GL014: unguarded shared state,
-  check-then-act, device work under lock, lock-order cycles, unjoined
-  threads); its dynamic complement is the ``RAFT_TPU_THREADSAN=1``
-  lock-order sanitizer (:mod:`raft_tpu.analysis.lockwatch`) the
-  serve/fabric/comms/core tiers construct their locks through.
+* :mod:`raft_tpu.analysis.races` — graft-race: WHOLE-PROGRAM
+  lock-discipline lint over the threaded serving tier (GL010-GL014,
+  GL020: unguarded shared state, check-then-act, device work under
+  lock, interprocedural lock-order cycles, unjoined threads,
+  unbalanced manual acquires), built on a project call graph + type
+  model (:mod:`raft_tpu.analysis.callgraph`) and per-function lock
+  summaries (:mod:`raft_tpu.analysis.summaries`); its dynamic
+  complement is the ``RAFT_TPU_THREADSAN=1`` lock-order sanitizer
+  (:mod:`raft_tpu.analysis.lockwatch`) the serve/fabric/comms/core
+  tiers construct their locks through, and ``--reconcile`` diffs the
+  two graphs (GL022 soundness gaps / GL021 coverage debt).
 * :mod:`raft_tpu.analysis.kernels` — graft-kern: the Pallas kernel
   verifier (GL006, GL015-GL018: computed VMEM accounting, index-map
   bounds/tail masks, tile alignment, grid-revisit hazards, MXU dtype
@@ -52,4 +57,9 @@ from raft_tpu.analysis.races import (  # noqa: F401
     lint_file as race_lint_file,
     lint_paths as race_lint_paths,
     lint_source as race_lint_source,
+)
+from raft_tpu.analysis.callgraph import CallGraph, build_project  # noqa: F401
+from raft_tpu.analysis.summaries import (  # noqa: F401
+    LockSummaries,
+    build_summaries,
 )

@@ -1,0 +1,888 @@
+"""Project-wide call graph + type model for whole-program race analysis.
+
+The per-file engine (:mod:`raft_tpu.analysis.races`) resolves
+``self.X`` receivers and one same-class call hop; everything across a
+module boundary was explicitly the dynamic sanitizer's job. This module
+is the static half of closing that gap (ISSUE 17): it parses every
+``*.py`` under the linted roots ONCE and builds
+
+* a **module index** — imports (``from m import X`` aliasing), classes,
+  module-level functions, module-level locks;
+* a **lock model** — every ``threading``/``lockwatch`` lock, rlock,
+  condition, and flag constructed anywhere, keyed by the same *name*
+  the runtime sanitizer uses (``lockwatch.make_lock("serve.engine")``
+  parses its literal, so every ``Server`` instance is one
+  ``serve.engine`` node, exactly as in :func:`lockwatch.order_graph`);
+  conditions alias to the lock they wrap, flags are excluded from the
+  order graph (they are try-acquire handoffs, never blockable — see
+  ``lockwatch.make_flag_lock``);
+* a **type model** — a deliberately small annotation-driven inference:
+  parameter/return annotations (string forms included), ``self.attr =
+  ClassName(...)`` constructor assignments, ``Dict[K, V]`` /
+  ``List[X]`` container value extraction (``.get()``/subscript), and
+  attribute chains through typed receivers, iterated to fixpoint so
+  ``serving.registry = server.registry`` with ``server: "Server"``
+  resolves two hops deep;
+* **call resolution** — same-module, imported-module, and typed-method
+  calls resolve to :class:`FuncDecl` nodes; ``ClassName(...)`` resolves
+  to ``__init__``; a module-level ``{"key": ClassA, ...}[k](...)``
+  dispatch dict resolves to the union of its classes;
+* **thread roots** — functions handed to ``Thread(target=...)``,
+  executor ``.submit``/``call_soon``/``run_in_executor``, or escaping
+  as callback values, closed to a project-wide reachable set.
+
+Everything stays a heuristic over syntax (the honest caveat every
+engine here carries): unannotated generics (``Generation.handle``) do
+not resolve, dynamic dispatch is invisible, and the model trusts
+annotations. The reconciliation pass (``graft-lint --reconcile``) is
+the audit: a runtime-observed lock edge the model missed is reported
+as a soundness gap, not silently absorbed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# factory classification by dotted-name LAST segment, so
+# ``lockwatch.make_lock``, ``make_lock`` (from-import), and any future
+# re-export all classify identically (the PR-17 alias fix: the old
+# exact-match tables missed from-imported factories entirely)
+_LOCK_LAST = {"Lock": "lock", "RLock": "rlock",
+              "make_lock": "lock", "make_rlock": "rlock"}
+_COND_LAST = {"Condition", "make_condition"}
+_FLAG_LAST = {"make_flag_lock"}
+_EVENT_LAST = {"Event", "Semaphore", "BoundedSemaphore"}
+
+_LOCKISH_ATTR_RE = re.compile(r"(^|_)(r?lock|mutex|cond(ition)?)$")
+
+_SELF_NAMES = {"self", "cls"}
+
+_CONTAINER_DICT = {"Dict", "dict", "Mapping", "MutableMapping",
+                   "DefaultDict", "OrderedDict"}
+_CONTAINER_LIST = {"List", "list", "Sequence", "MutableSequence",
+                   "Tuple", "tuple", "Set", "set", "FrozenSet",
+                   "frozenset", "Deque", "deque", "Iterable",
+                   "Iterator"}
+_UNION_HEADS = {"Optional", "Union"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass(eq=False)
+class LockDecl:
+    """One lock/condition/flag construction site."""
+
+    attr: str                 # attribute or variable name at the site
+    name: str                 # graph node (lockwatch name or fallback)
+    kind: str                 # "lock" | "rlock" | "condition" | "flag"
+    path: str
+    line: int
+
+
+@dataclasses.dataclass(eq=False)
+class ClassDecl:
+    module: "ModuleDecl"
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, "FuncDecl"] = dataclasses.field(default_factory=dict)
+    lock_attrs: Dict[str, LockDecl] = dataclasses.field(default_factory=dict)
+    event_attrs: Set[str] = dataclasses.field(default_factory=set)
+    # inferred `self.<attr>` types — grown to fixpoint by CallGraph
+    attr_types: Dict[str, Set["TypeRef"]] = dataclasses.field(
+        default_factory=dict)
+    bases: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module.name}.{self.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeRef:
+    """An inferred type: an instance of ``cls``, optionally wrapped in
+    a container whose element/value type it is."""
+
+    cls: ClassDecl
+    container: Optional[str] = None        # None | "list" | "dict"
+
+
+@dataclasses.dataclass(eq=False)
+class FuncDecl:
+    module: "ModuleDecl"
+    cls: Optional[ClassDecl]
+    name: str
+    node: ast.AST                          # FunctionDef | AsyncFunctionDef
+
+    @property
+    def qualname(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls.qualname}.{self.name}"
+        return f"{self.module.name}.{self.name}"
+
+
+@dataclasses.dataclass(eq=False)
+class ModuleDecl:
+    name: str
+    path: str
+    tree: ast.Module
+    source: str
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassDecl] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FuncDecl] = dataclasses.field(default_factory=dict)
+    module_locks: Dict[str, LockDecl] = dataclasses.field(
+        default_factory=dict)
+    # module-level `{"k": ClassA, ...}` dispatch dicts: var -> class names
+    class_dicts: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# lock-construction classification
+# ---------------------------------------------------------------------------
+
+
+def _literal_name(call: ast.Call) -> Optional[str]:
+    """The lock's declared sanitizer name: first positional string, or
+    ``name=`` keyword."""
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def classify_lock_call(call: ast.AST) -> Optional[Tuple[str, Optional[str],
+                                                        Optional[ast.AST]]]:
+    """Classify a constructor call as ``(kind, declared_name,
+    alias_arg)``; ``None`` when it is not a lock-family factory.
+
+    ``alias_arg`` is the lock expression a Condition wraps (so the
+    caller can alias the condition to its lock's node), including the
+    nested ``make_condition(make_lock("x"))`` form, whose inner literal
+    is returned directly as ``declared_name``."""
+    if not isinstance(call, ast.Call):
+        return None
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    last = dotted.rsplit(".", 1)[-1]
+    if last in _LOCK_LAST:
+        return _LOCK_LAST[last], _literal_name(call), None
+    if last in _FLAG_LAST:
+        return "flag", _literal_name(call), None
+    if last in _COND_LAST:
+        args = list(call.args) + [kw.value for kw in call.keywords
+                                  if kw.arg in (None, "lock")]
+        for a in args:
+            inner = classify_lock_call(a)
+            if inner is not None and inner[0] in ("lock", "rlock"):
+                return "condition", inner[1], None
+            if isinstance(a, (ast.Attribute, ast.Name)):
+                return "condition", _literal_name(call), a
+        return "condition", _literal_name(call), None
+    if last in _EVENT_LAST:
+        return "event", None, None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the project model
+# ---------------------------------------------------------------------------
+
+
+class CallGraph:
+    """The whole-program model: modules, classes, types, calls, locks."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleDecl] = {}
+        # dotted-suffix index for import resolution across root spellings
+        # ("raft_tpu.serve.registry" vs a scan rooted at raft_tpu/)
+        self._suffixes: Dict[str, List[ModuleDecl]] = {}
+        self.thread_roots: Set[FuncDecl] = set()
+        self.reachable: Set[FuncDecl] = set()
+        self._fn_of_node: Dict[ast.AST, FuncDecl] = {}
+        self._param_types: Dict[FuncDecl, Dict[str, Set[TypeRef]]] = {}
+        self._local_types: Dict[FuncDecl, Dict[str, Set[TypeRef]]] = {}
+        # call-site argument types flowed onto UNannotated params
+        self._param_extra: Dict[FuncDecl, Dict[str, Set[TypeRef]]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, paths: Sequence) -> "CallGraph":
+        g = cls()
+        for mod_name, path in _iter_py_files(paths):
+            g._add_module(mod_name, path)
+        g._index_suffixes()
+        g._collect_decls()
+        g._infer_types()
+        g._collect_thread_roots()
+        return g
+
+    def _add_module(self, name: str, path: Path) -> None:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, UnicodeDecodeError, SyntaxError):
+            return
+        self.modules[name] = ModuleDecl(name, str(path), tree, source)
+
+    def _index_suffixes(self) -> None:
+        for mod in self.modules.values():
+            parts = mod.name.split(".")
+            for i in range(len(parts)):
+                self._suffixes.setdefault(
+                    ".".join(parts[i:]), []).append(mod)
+
+    def module_for(self, dotted: str) -> Optional[ModuleDecl]:
+        """Resolve a dotted import target to a scanned module — exact
+        name first, then the longest unique suffix match (a scan rooted
+        inside the package sees shorter names than the import spells)."""
+        mod = self.modules.get(dotted)
+        if mod is not None:
+            return mod
+        parts = dotted.split(".")
+        for i in range(len(parts)):
+            cands = self._suffixes.get(".".join(parts[i:]), [])
+            if len(cands) == 1:
+                return cands[0]
+            if cands:
+                return None            # ambiguous suffix: stay honest
+        return None
+
+    # -- declaration pass --------------------------------------------------
+
+    def _collect_decls(self) -> None:
+        for mod in self.modules.values():
+            self._collect_imports(mod)
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._collect_class(mod, node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    fd = FuncDecl(mod, None, node.name, node)
+                    mod.functions[node.name] = fd
+                    self._fn_of_node[node] = fd
+                elif isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    self._collect_module_assign(
+                        mod, node.targets[0].id, node.value, node.lineno)
+
+    @staticmethod
+    def _collect_imports(mod: ModuleDecl) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports[alias.asname or
+                                alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    mod.imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def _collect_module_assign(self, mod: ModuleDecl, name: str,
+                               value: ast.AST, line: int) -> None:
+        lk = classify_lock_call(value)
+        if lk is not None and lk[0] in ("lock", "rlock", "condition"):
+            kind, declared, _alias = lk
+            mod.module_locks[name] = LockDecl(
+                name, declared or f"{mod.name}.{name}", kind,
+                mod.path, line)
+        elif isinstance(value, ast.Dict) and value.values and all(
+                isinstance(v, ast.Name) for v in value.values):
+            mod.class_dicts[name] = [v.id for v in value.values
+                                     if isinstance(v, ast.Name)]
+
+    def _collect_class(self, mod: ModuleDecl, node: ast.ClassDef) -> None:
+        cd = ClassDecl(mod, node.name, node)
+        cd.bases = [d for d in (_dotted(b) for b in node.bases) if d]
+        mod.classes[node.name] = cd
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fd = FuncDecl(mod, cd, sub.name, sub)
+                cd.methods[sub.name] = fd
+                self._fn_of_node[sub] = fd
+            elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                self._classify_attr_lock(cd, sub.targets[0].id, sub.value,
+                                         sub.lineno)
+        for m in cd.methods.values():
+            for sub in ast.walk(m.node):
+                tgt = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt = sub.targets[0]
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    tgt = sub.target
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in _SELF_NAMES:
+                    self._classify_attr_lock(cd, tgt.attr, sub.value,
+                                             sub.lineno)
+
+    def _classify_attr_lock(self, cd: ClassDecl, attr: str,
+                            value: ast.AST, line: int) -> None:
+        lk = classify_lock_call(value)
+        if lk is None:
+            return
+        kind, declared, alias_arg = lk
+        if kind == "event":
+            cd.event_attrs.add(attr)
+            return
+        name = declared
+        if name is None and alias_arg is not None:
+            # Condition(self.L): alias to the wrapped lock's node
+            if isinstance(alias_arg, ast.Attribute) and \
+                    isinstance(alias_arg.value, ast.Name) and \
+                    alias_arg.value.id in _SELF_NAMES:
+                wrapped = cd.lock_attrs.get(alias_arg.attr)
+                name = wrapped.name if wrapped else \
+                    f"{cd.name}.{alias_arg.attr}"
+        if name is None:
+            name = f"{cd.name}.{attr}"
+        cd.lock_attrs.setdefault(
+            attr, LockDecl(attr, name, kind, cd.module.path, line))
+
+    # -- type inference ----------------------------------------------------
+
+    def resolve_class(self, mod: ModuleDecl,
+                      dotted: str) -> Optional[ClassDecl]:
+        """Resolve a (possibly dotted) class reference as seen from
+        ``mod``: own classes, then imports, then a module-suffix walk."""
+        if dotted in mod.classes:
+            return mod.classes[dotted]
+        target = mod.imports.get(dotted, dotted)
+        # target like "pkg.module.Class" or "pkg.module"
+        head, _, last = target.rpartition(".")
+        if head:
+            m = self.module_for(head)
+            if m is not None and last in m.classes:
+                return m.classes[last]
+        if "." in dotted:
+            # "module.Class" spelled through an imported module alias
+            mhead, _, mlast = dotted.rpartition(".")
+            mtarget = mod.imports.get(mhead.split(".")[0])
+            if mtarget:
+                tail = mhead.split(".", 1)[1] if "." in mhead else ""
+                full = mtarget + ("." + tail if tail else "")
+                m = self.module_for(full)
+                if m is not None and mlast in m.classes:
+                    return m.classes[mlast]
+        m = self.module_for(target)
+        return None if m is None else m.classes.get(dotted.rsplit(
+            ".", 1)[-1])
+
+    def parse_annotation(self, node: Optional[ast.AST],
+                         mod: ModuleDecl) -> Set[TypeRef]:
+        if node is None:
+            return set()
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return set()
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            dotted = _dotted(node)
+            if not dotted:
+                return set()
+            cls = self.resolve_class(mod, dotted)
+            return {TypeRef(cls)} if cls else set()
+        if isinstance(node, ast.Subscript):
+            head = _dotted(node.value) or ""
+            last = head.rsplit(".", 1)[-1]
+            sl = node.slice
+            elts = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+            if last in _UNION_HEADS:
+                out: Set[TypeRef] = set()
+                for e in elts:
+                    out |= self.parse_annotation(e, mod)
+                return out
+            if last in _CONTAINER_DICT and len(elts) == 2:
+                return {TypeRef(t.cls, "dict")
+                        for t in self.parse_annotation(elts[1], mod)}
+            if last in _CONTAINER_LIST and elts:
+                return {TypeRef(t.cls, "list")
+                        for t in self.parse_annotation(elts[0], mod)}
+        return set()
+
+    def param_types(self, fn: FuncDecl) -> Dict[str, Set[TypeRef]]:
+        ann = self._param_types.get(fn)
+        if ann is None:
+            ann = {}
+            node = fn.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = list(node.args.posonlyargs) + list(node.args.args) + \
+                    list(node.args.kwonlyargs)
+                for a in args:
+                    if a.arg in _SELF_NAMES:
+                        continue
+                    t = self.parse_annotation(a.annotation, fn.module)
+                    if t:
+                        ann[a.arg] = t
+            self._param_types[fn] = ann
+        extra = self._param_extra.get(fn)
+        if not extra:
+            return ann
+        out = {k: set(v) for k, v in ann.items()}
+        for k, v in extra.items():
+            if k not in ann:        # annotations stay authoritative
+                out.setdefault(k, set()).update(v)
+        return out
+
+    def return_types(self, fn: FuncDecl) -> Set[TypeRef]:
+        node = fn.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self.parse_annotation(node.returns, fn.module)
+        return set()
+
+    def local_types(self, fn: FuncDecl) -> Dict[str, Set[TypeRef]]:
+        """Per-function local variable types: annotations win, then
+        single-target assignment inference, iterated twice so chains
+        (``w = self._workers[r]`` feeding ``w.lock``) resolve."""
+        cached = self._local_types.get(fn)
+        if cached is not None:
+            return cached
+        env: Dict[str, Set[TypeRef]] = dict(self.param_types(fn))
+        for _ in range(2):
+            changed = False
+            for sub in ast.walk(fn.node):
+                name = None
+                types: Set[TypeRef] = set()
+                if isinstance(sub, ast.AnnAssign) and \
+                        isinstance(sub.target, ast.Name):
+                    name = sub.target.id
+                    types = self.parse_annotation(sub.annotation,
+                                                  fn.module)
+                elif isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1 and \
+                        isinstance(sub.targets[0], ast.Name):
+                    name = sub.targets[0].id
+                    types = self.infer_expr(sub.value, fn, env)
+                elif isinstance(sub, ast.For) and \
+                        isinstance(sub.target, ast.Name):
+                    name = sub.target.id
+                    types = {TypeRef(t.cls)
+                             for t in self.infer_expr(sub.iter, fn, env)
+                             if t.container is not None}
+                if name and types and env.get(name) != types:
+                    env[name] = types
+                    changed = True
+            if not changed:
+                break
+        self._local_types[fn] = env
+        return env
+
+    def infer_expr(self, expr: ast.AST, fn: FuncDecl,
+                   env: Optional[Dict[str, Set[TypeRef]]] = None
+                   ) -> Set[TypeRef]:
+        if env is None:
+            env = self.local_types(fn)
+        if isinstance(expr, ast.Name):
+            if expr.id in _SELF_NAMES and fn.cls is not None:
+                return {TypeRef(fn.cls)}
+            return set(env.get(expr.id, ()))
+        if isinstance(expr, ast.Attribute):
+            out: Set[TypeRef] = set()
+            for t in self.infer_expr(expr.value, fn, env):
+                if t.container is None:
+                    out |= t.cls.attr_types.get(expr.attr, set())
+            return out
+        if isinstance(expr, ast.Subscript):
+            return {TypeRef(t.cls)
+                    for t in self.infer_expr(expr.value, fn, env)
+                    if t.container is not None}
+        if isinstance(expr, ast.Call):
+            return self._infer_call(expr, fn, env)
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+            return {TypeRef(t.cls, "list")
+                    for t in self.infer_expr(expr.elt, fn, env)
+                    if t.container is None}
+        if isinstance(expr, ast.List) and expr.elts:
+            return {TypeRef(t.cls, "list")
+                    for t in self.infer_expr(expr.elts[0], fn, env)
+                    if t.container is None}
+        if isinstance(expr, ast.IfExp):
+            return self.infer_expr(expr.body, fn, env) | \
+                self.infer_expr(expr.orelse, fn, env)
+        if isinstance(expr, ast.Await):
+            return self.infer_expr(expr.value, fn, env)
+        return set()
+
+    def _infer_call(self, call: ast.Call, fn: FuncDecl,
+                    env: Dict[str, Set[TypeRef]]) -> Set[TypeRef]:
+        func = call.func
+        # `.get(k)` on a dict-typed receiver -> the value type
+        if isinstance(func, ast.Attribute) and func.attr == "get":
+            vals = {TypeRef(t.cls)
+                    for t in self.infer_expr(func.value, fn, env)
+                    if t.container == "dict"}
+            if vals:
+                return vals
+        # `DISPATCH[k](...)` over a module-level class dict -> union
+        if isinstance(func, ast.Subscript) and \
+                isinstance(func.value, ast.Name):
+            names = fn.module.class_dicts.get(func.value.id)
+            if names:
+                out: Set[TypeRef] = set()
+                for n in names:
+                    cls = self.resolve_class(fn.module, n)
+                    if cls:
+                        out.add(TypeRef(cls))
+                return out
+        dotted = _dotted(func)
+        if dotted:
+            cls = self.resolve_class(fn.module, dotted)
+            if cls is not None:
+                return {TypeRef(cls)}
+        out = set()
+        for callee in self.resolve_call(call, fn, env):
+            out |= self.return_types(callee)
+        return out
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, call: ast.Call, fn: FuncDecl,
+                     env: Optional[Dict[str, Set[TypeRef]]] = None
+                     ) -> List[FuncDecl]:
+        """Callee candidates of one call site (constructor calls
+        resolve to ``__init__``)."""
+        env = self.local_types(fn) if env is None else env
+        func = call.func
+        out: List[FuncDecl] = []
+        if isinstance(func, ast.Name):
+            cls = self.resolve_class(fn.module, func.id)
+            if cls is not None:
+                init = cls.methods.get("__init__")
+                return [init] if init else []
+            fd = fn.module.functions.get(func.id)
+            if fd is not None:
+                return [fd]
+            target = fn.module.imports.get(func.id)
+            if target:
+                head, _, last = target.rpartition(".")
+                m = self.module_for(head) if head else None
+                if m is not None and last in m.functions:
+                    return [m.functions[last]]
+            return []
+        if isinstance(func, ast.Subscript) and \
+                isinstance(func.value, ast.Name):
+            for n in fn.module.class_dicts.get(func.value.id, ()):
+                cls = self.resolve_class(fn.module, n)
+                init = cls.methods.get("__init__") if cls else None
+                if init:
+                    out.append(init)
+            return out
+        if not isinstance(func, ast.Attribute):
+            return []
+        attr = func.attr
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id in _SELF_NAMES and fn.cls is not None:
+                m = self._method_on(fn.cls, attr)
+                if m is not None:
+                    return [m]
+            # imported module function: `lockwatch.make_lock(...)`
+            target = fn.module.imports.get(base.id)
+            if target:
+                m = self.module_for(target)
+                if m is not None:
+                    if attr in m.functions:
+                        return [m.functions[attr]]
+                    if attr in m.classes:
+                        init = m.classes[attr].methods.get("__init__")
+                        return [init] if init else []
+        for t in self.infer_expr(base, fn, env):
+            if t.container is not None:
+                continue
+            m = self._method_on(t.cls, attr)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def _method_on(self, cls: ClassDecl, name: str,
+                   _depth: int = 0) -> Optional[FuncDecl]:
+        if name in cls.methods:
+            return cls.methods[name]
+        if _depth >= 3:
+            return None
+        for b in cls.bases:
+            base = self.resolve_class(cls.module, b)
+            if base is not None:
+                m = self._method_on(base, name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def _infer_types(self) -> None:
+        """Grow ``ClassDecl.attr_types`` from ``self.attr = <expr>``
+        sites AND flow call-site argument types onto unannotated
+        parameters, to fixpoint (attr chains across classes need 2-3
+        rounds; bounded to keep pathological graphs cheap).
+
+        The argument flow is what types ``Generation.handle``: no
+        annotation anywhere, but every ``publish(name, handle)`` caller
+        passes a ``_Handle``, so the param — and through ``self.handle
+        = handle``, the attribute — gets the callers' union."""
+        for _ in range(4):
+            changed = False
+            for mod in self.modules.values():
+                for cd in mod.classes.values():
+                    for meth in cd.methods.values():
+                        changed |= self._infer_attr_assigns(cd, meth)
+                for fn in self._module_fns(mod):
+                    changed |= self._propagate_call_args(fn)
+            if not changed:
+                break
+            self._local_types.clear()
+
+    def _propagate_call_args(self, fn: FuncDecl) -> bool:
+        changed = False
+        env = self.local_types(fn)
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            for callee in self.resolve_call(sub, fn, env):
+                changed |= self._bind_args(sub, fn, env, callee)
+        return changed
+
+    def _bind_args(self, call: ast.Call, fn: FuncDecl,
+                   env: Dict[str, Set[TypeRef]],
+                   callee: FuncDecl) -> bool:
+        node = callee.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        self.param_types(callee)               # prime annotation cache
+        ann = self._param_types[callee]
+        params = [a.arg for a in (list(node.args.posonlyargs) +
+                                  list(node.args.args))]
+        offset = 1 if params and params[0] in _SELF_NAMES else 0
+        changed = False
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            j = i + offset
+            if j >= len(params):
+                break
+            changed |= self._add_param_extra(
+                callee, params[j], ann, self.infer_expr(a, fn, env))
+        names = set(params) | {a.arg for a in node.args.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg and kw.arg in names:
+                changed |= self._add_param_extra(
+                    callee, kw.arg, ann,
+                    self.infer_expr(kw.value, fn, env))
+        return changed
+
+    def _add_param_extra(self, callee: FuncDecl, pname: str,
+                         ann: Dict[str, Set[TypeRef]],
+                         types: Set[TypeRef]) -> bool:
+        if not types or pname in ann or pname in _SELF_NAMES:
+            return False
+        have = self._param_extra.setdefault(
+            callee, {}).setdefault(pname, set())
+        if types <= have:
+            return False
+        have |= types
+        return True
+
+    def _infer_attr_assigns(self, cd: ClassDecl, fn: FuncDecl) -> bool:
+        changed = False
+        env = self.local_types(fn)
+        for sub in ast.walk(fn.node):
+            tgt, value, ann = None, None, None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt, value = sub.targets[0], sub.value
+            elif isinstance(sub, ast.AnnAssign):
+                tgt, value, ann = sub.target, sub.value, sub.annotation
+            if not (isinstance(tgt, ast.Attribute) and
+                    isinstance(tgt.value, ast.Name) and
+                    tgt.value.id in _SELF_NAMES):
+                continue
+            types = self.parse_annotation(ann, cd.module) if ann is not None \
+                else set()
+            if not types and value is not None:
+                types = self.infer_expr(value, fn, env)
+            if types:
+                have = cd.attr_types.setdefault(tgt.attr, set())
+                if not types <= have:
+                    have |= types
+                    changed = True
+        return changed
+
+    # -- thread roots ------------------------------------------------------
+
+    def _collect_thread_roots(self) -> None:
+        for mod in self.modules.values():
+            for fn in self._module_fns(mod):
+                for sub in ast.walk(fn.node):
+                    if isinstance(sub, ast.Call):
+                        self._root_scan_call(sub, fn)
+        # close reachability over resolvable calls
+        frontier = list(self.thread_roots)
+        self.reachable = set(frontier)
+        while frontier:
+            fn = frontier.pop()
+            for sub in ast.walk(fn.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                for callee in self.resolve_call(sub, fn):
+                    if callee not in self.reachable:
+                        self.reachable.add(callee)
+                        frontier.append(callee)
+
+    def _module_fns(self, mod: ModuleDecl) -> Iterable[FuncDecl]:
+        for fd in mod.functions.values():
+            yield fd
+        for cd in mod.classes.values():
+            for fd in cd.methods.values():
+                yield fd
+
+    def _mark_root(self, expr: ast.AST, fn: FuncDecl) -> None:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id in _SELF_NAMES and fn.cls is not None:
+                m = self._method_on(fn.cls, expr.attr)
+                if m is not None:
+                    self.thread_roots.add(m)
+                return
+            for t in self.infer_expr(expr.value, fn):
+                if t.container is None:
+                    m = self._method_on(t.cls, expr.attr)
+                    if m is not None:
+                        self.thread_roots.add(m)
+        elif isinstance(expr, ast.Name):
+            fd = fn.module.functions.get(expr.id)
+            if fd is not None:
+                self.thread_roots.add(fd)
+
+    def _root_scan_call(self, call: ast.Call, fn: FuncDecl) -> None:
+        dotted = _dotted(call.func) or ""
+        last = dotted.rsplit(".", 1)[-1]
+        if dotted.endswith("Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._mark_root(kw.value, fn)
+        elif last in ("submit", "call_soon", "run_in_executor") and \
+                call.args:
+            self._mark_root(call.args[0], fn)
+        else:
+            # escaping callback: `self.m` (or a typed `obj.m`) passed as
+            # a VALUE — it may run on any thread later
+            for arg in list(call.args) + [kw.value
+                                          for kw in call.keywords]:
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id in _SELF_NAMES and \
+                        fn.cls is not None and \
+                        arg.attr in fn.cls.methods:
+                    self.thread_roots.add(fn.cls.methods[arg.attr])
+
+    # -- lock-expression resolution ----------------------------------------
+
+    def lock_node(self, expr: ast.AST,
+                  fn: FuncDecl) -> Optional[LockDecl]:
+        """Resolve a with-item / acquire receiver expression to its
+        lock declaration. Returns ``None`` for non-lock expressions;
+        flag locks resolve (kind ``"flag"``) so callers can exempt
+        them."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in _SELF_NAMES and fn.cls is not None:
+            decl = fn.cls.lock_attrs.get(expr.attr)
+            if decl is not None:
+                return decl
+            if _LOCKISH_ATTR_RE.search(expr.attr):
+                return LockDecl(expr.attr,
+                                f"{fn.cls.name}.{expr.attr}", "lock",
+                                fn.module.path, expr.lineno)
+            return None
+        if isinstance(expr, ast.Name):
+            decl = fn.module.module_locks.get(expr.id)
+            if decl is not None:
+                return decl
+            target = fn.module.imports.get(expr.id)
+            if target:
+                head, _, last = target.rpartition(".")
+                m = self.module_for(head) if head else None
+                if m is not None and last in m.module_locks:
+                    return m.module_locks[last]
+            if _LOCKISH_ATTR_RE.search(expr.id):
+                return LockDecl(expr.id, expr.id, "lock",
+                                fn.module.path, expr.lineno)
+            return None
+        if isinstance(expr, ast.Attribute):
+            base, attr = expr.value, expr.attr
+            for t in self.infer_expr(base, fn):
+                if t.container is not None:
+                    continue
+                decl = t.cls.lock_attrs.get(attr)
+                if decl is not None:
+                    return decl
+            if _LOCKISH_ATTR_RE.search(attr):
+                dotted = _dotted(expr) or attr
+                # typed receiver without a known lock attr: name by
+                # class so instances merge; untyped: name by the path
+                for t in self.infer_expr(base, fn):
+                    if t.container is None:
+                        return LockDecl(attr, f"{t.cls.name}.{attr}",
+                                        "lock", fn.module.path,
+                                        expr.lineno)
+                return LockDecl(attr, dotted, "lock", fn.module.path,
+                                expr.lineno)
+        return None
+
+    def fn_for_node(self, node: ast.AST) -> Optional[FuncDecl]:
+        return self._fn_of_node.get(node)
+
+
+# ---------------------------------------------------------------------------
+# file discovery
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence) -> List[Tuple[str, Path]]:
+    """``(module_name, path)`` pairs for every ``*.py`` under the given
+    roots. A directory that is itself a package (has ``__init__.py``)
+    contributes its own name as the leading module component, so a scan
+    of ``raft_tpu/`` yields ``raft_tpu.serve.engine`` — the exact names
+    the package's imports spell."""
+    out: List[Tuple[str, Path]] = []
+    seen: Set[Path] = set()
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            prefix = p.name if (p / "__init__.py").exists() else ""
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts or f in seen:
+                    continue
+                seen.add(f)
+                rel = f.relative_to(p).with_suffix("")
+                parts = [x for x in rel.parts if x != "__init__"]
+                name = ".".join(([prefix] if prefix else []) + list(parts)) \
+                    or prefix or f.stem
+                out.append((name, f))
+        elif p.suffix == ".py" and p not in seen:
+            seen.add(p)
+            out.append((p.stem, p))
+    return out
+
+
+def build_project(paths: Sequence) -> CallGraph:
+    """Build the whole-program model over the given roots."""
+    return CallGraph.build(paths)

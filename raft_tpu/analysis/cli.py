@@ -7,11 +7,14 @@
     graft-lint --engine=races raft_tpu/    # lock-discipline lint only
     graft-lint --engine=both,races raft_tpu/   # the full tier-1 gate
     graft-lint --format=json raft_tpu/    # machine-readable
+    graft-lint --engine=races --reconcile LOCKGRAPH.json raft_tpu/
+    graft-lint --strict-suppressions raft_tpu/   # stale allow- markers
+    graft-lint --emit-lock-hierarchy raft_tpu/   # markdown lock graph
     graft-lint --list-rules
 
 ``--engine`` takes a comma list of ``ast`` / ``jaxpr`` / ``races`` /
 ``kern``; ``both`` keeps meaning ``ast,jaxpr`` (its pre-races spelling)
-and ``all`` is every engine.
+and ``all`` is every engine. ``--reconcile`` implies ``races``.
 
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
 findings, 2 internal/usage error.
@@ -50,6 +53,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the select_k shape-sweep recompile audit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings (text format)")
+    ap.add_argument("--reconcile", metavar="ARTIFACT", default=None,
+                    help="diff the static lock graph against a runtime "
+                         "lockwatch graph JSON (lockwatch.export_graph "
+                         "/ RAFT_TPU_THREADSAN_EXPORT): runtime edges "
+                         "the model misses are GL022 (hard), static "
+                         "edges never exercised are GL021 (advisory); "
+                         "implies the races engine")
+    ap.add_argument("--strict-suppressions", action="store_true",
+                    help="report suppressions that no longer suppress "
+                         "anything as GL000 (judged only for rules "
+                         "whose engine ran)")
+    ap.add_argument("--emit-lock-hierarchy", action="store_true",
+                    help="print the whole-program lock hierarchy "
+                         "(markdown; the generated source of "
+                         "docs/serving.md's hierarchy section) and "
+                         "exit")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -75,6 +94,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
     if not engines:
         engines = {"ast"}
+    if args.reconcile is not None:
+        engines.add("races")     # reconciliation IS a races-engine pass
 
     if args.paths:
         paths = args.paths
@@ -96,6 +117,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
             return 2
 
+    if args.emit_lock_hierarchy:
+        try:
+            from raft_tpu.analysis.summaries import build_summaries
+
+            print(build_summaries(paths).render_hierarchy())
+            return 0
+        except Exception as e:  # noqa: BLE001 — same contract as engines
+            print(f"graft-lint internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+
     findings = []
     report: dict = {}
     try:
@@ -106,7 +138,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if "races" in engines:
             from raft_tpu.analysis.races import lint_paths as race_paths
 
-            findings.extend(race_paths(paths, rules))
+            findings.extend(race_paths(paths, rules,
+                                       reconcile=args.reconcile))
         if "kern" in engines:
             from raft_tpu.analysis.kernels import lint_paths as kern_paths
 
@@ -123,29 +156,66 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    open_findings = [f for f in findings if not f.suppressed]
+    if args.strict_suppressions:
+        findings.extend(_stale_suppression_pass(paths, findings, engines))
+
+    open_findings = [f for f in findings
+                     if not f.suppressed and not f.advisory]
+    advisory = [f for f in findings if not f.suppressed and f.advisory]
     suppressed = [f for f in findings if f.suppressed]
 
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in open_findings],
+            "advisory": [f.to_dict() for f in advisory],
             "suppressed": [f.to_dict() for f in suppressed],
             "counts": {"open": len(open_findings),
+                       "advisory": len(advisory),
                        "suppressed": len(suppressed)},
             "report": report,
         }, indent=1))
     else:
         for f in open_findings:
             print(f.render())
+        for f in advisory:
+            print(f.render())
         if args.show_suppressed:
             for f in suppressed:
                 print(f.render())
         rec = report.get("recompile")
         tail = f"; recompile audit: {rec['status']}" if rec else ""
-        print(f"graft-lint: {len(open_findings)} finding(s), "
+        adv = f", {len(advisory)} advisory" if advisory else ""
+        print(f"graft-lint: {len(open_findings)} finding(s){adv}, "
               f"{len(suppressed)} suppressed{tail}")
 
     return 1 if open_findings else 0
+
+
+def _stale_suppression_pass(paths, findings, engines):
+    """--strict-suppressions: GL000 per suppression that suppressed
+    nothing this run (only for rules whose engine ran)."""
+    from pathlib import Path
+
+    from raft_tpu.analysis.rules import stale_suppressions
+
+    out = []
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        files = sorted(f for f in p.rglob("*.py")
+                       if "__pycache__" not in f.parts) \
+            if p.is_dir() else [p]
+        for f in files:
+            if f in seen or f.suffix != ".py":
+                continue
+            seen.add(f)
+            try:
+                source = f.read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            out.extend(stale_suppressions(str(f), source, findings,
+                                          engines))
+    return out
 
 
 if __name__ == "__main__":

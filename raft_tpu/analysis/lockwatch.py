@@ -132,6 +132,61 @@ def reset() -> None:
             _counts[k] = 0
 
 
+EXPORT_ENV_VAR = "RAFT_TPU_THREADSAN_EXPORT"
+
+
+def export_graph(path: Optional[str] = None, merge: bool = True) -> str:
+    """Write the observed acquisition graph as the JSON artifact
+    ``graft-lint --engine=races --reconcile <path>`` consumes.
+
+    ``path`` defaults to :data:`EXPORT_ENV_VAR`. With ``merge`` (the
+    default) an existing artifact's edges are unioned in first, so a
+    sharded test run — or several suites exporting at exit — ACCUMULATES
+    coverage instead of each process clobbering the last; first-seen
+    sites are kept for edges both halves observed. Returns the path."""
+    import json
+
+    target = path or os.environ.get(EXPORT_ENV_VAR, "")
+    if not target:
+        raise ValueError(
+            f"export_graph needs a path (argument or {EXPORT_ENV_VAR})")
+    graph = order_graph()
+    if merge and os.path.exists(target):
+        try:
+            with open(target) as fh:
+                prior = json.load(fh)
+            prior_graph = prior.get("graph", prior) \
+                if isinstance(prior, dict) else {}
+            for a, succs in prior_graph.items():
+                items = succs.items() if isinstance(succs, dict) \
+                    else [(b, "") for b in succs]
+                mine = graph.setdefault(a, {})
+                for b, site in items:
+                    mine.setdefault(b, site if isinstance(site, str)
+                                    else "")
+        except (OSError, ValueError):
+            pass                 # unreadable prior artifact: overwrite
+    with open(target, "w") as fh:
+        json.dump({"graph": {a: dict(sorted(bs.items()))
+                             for a, bs in sorted(graph.items())},
+                   "stats": stats()}, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return target
+
+
+def _export_at_exit() -> None:  # pragma: no cover - exercised via env
+    try:
+        export_graph()
+    except Exception:  # noqa: BLE001 — exit-hook export is best-effort; a failed write must not mask the test result
+        pass
+
+
+if enabled() and os.environ.get(EXPORT_ENV_VAR, ""):
+    import atexit
+
+    atexit.register(_export_at_exit)
+
+
 def _find_path(src: str, dst: str) -> Optional[List[str]]:
     """Shortest observed-order path src -> ... -> dst (BFS). Caller
     holds ``_state_lock``."""
@@ -186,7 +241,7 @@ def _dump_failure(kind: str, detail: dict) -> None:
             from raft_tpu.obs import flight
 
             flight.dump(reason=f"lockwatch:{kind}")
-    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow failure reporting is best-effort; the sanitizer exception itself is the signal
+    except Exception:  # noqa: BLE001 — failure reporting is best-effort; the sanitizer exception itself is the signal
         pass
 
 

@@ -1,11 +1,21 @@
-"""graft-race engine 1 (static): lock-discipline lint over package source.
+"""graft-race engine 1 (static): whole-program lock-discipline lint.
 
 The serving tier (serve engine, registry hot-swap, tombstone mutation,
 fabric router, comms worker groups) is multi-threaded, and CHANGES.md
 records that nearly every post-review fix in PRs 5-6 was a hand-found
 concurrency bug. This engine turns that recurring review-found bug
 class into a mechanical gate, the way graft-lint's GL001-GL009 did for
-TPU numeric/tracing hazards:
+TPU numeric/tracing hazards.
+
+Since r17 the engine is *whole-program* when handed more than one file:
+``lint_paths`` builds a project call graph + type model
+(:mod:`raft_tpu.analysis.callgraph`) and per-function lock summaries to
+fixpoint (:mod:`raft_tpu.analysis.summaries`), keyed by the same
+lockwatch *names* the dynamic sanitizer uses (``serve.mutation``, not
+``self._lock``) — so the static acquisition graph and the runtime one
+are directly comparable, and ``--reconcile <artifact>`` diffs them
+(GL022 hard when the runtime observed an edge the model lacks, GL021
+advisory for modeled edges no test exercised). Rules:
 
 * **GL010 unguarded-shared-state** — infer a *guarded-by* map per
   class: an attribute written inside ``with self.<lock>:`` (or declared
@@ -28,21 +38,31 @@ TPU numeric/tracing hazards:
   ``block_until_ready``, ``device_put``, and index ``build``/``extend``
   helpers inside a ``with <lock>:`` body (the
   side-build-under-the-mutation-RLock class).
-* **GL013 lock-order-cycle** — a per-file static acquisition graph from
-  nested ``with`` statements (multi-item ``with a, b:`` included, plus
-  one hop through same-class method calls); any cycle is reported with
-  its full path. Cross-file and call-depth>1 orders are the dynamic
-  sanitizer's job (:mod:`raft_tpu.analysis.lockwatch`).
+* **GL013 lock-order-cycle** — in whole-program mode, cycles in the
+  interprocedural acquisition graph (call-expanded to fixpoint through
+  the summaries, reentrant re-acquisition excluded to mirror the
+  sanitizer's RLock semantics), reported with the full cycle path
+  naming every edge's file:line and mediating call chain. Single-file
+  runs keep the original per-file nested-``with`` graph.
 * **GL014 unjoined-thread** — ``threading.Thread`` created neither
   ``daemon=True`` nor joined.
+* **GL020 unbalanced-acquire** — path-sensitive pairing of manual
+  ``acquire()``/``release()``: an acquire whose release is skipped on
+  an early return, a fall-through exit, or an exception path with no
+  ``finally`` is flagged at the acquire site. Flag locks
+  (``make_flag_lock`` try-acquire handoffs) are exempt; deliberate
+  ownership transfers carry a reasoned suppression.
+* **GL021/GL022 reconciliation** (``--reconcile``) — see above; GL022
+  anchors at the artifact ("never suppress the evidence"), GL021 at the
+  unexercised static edge's acquire site.
 
 Everything here is a heuristic over syntax (the honest caveat GL001-006
-carry too): it resolves ``self.X``/``cls.X`` and plain-name receivers,
-sees lexical ``with`` blocks only (manual ``acquire()``/``release()``
-pairs and cross-object call chains are invisible), and trusts the
-``*_locked`` suffix. The dynamic half — the ``RAFT_TPU_THREADSAN=1``
-lock sanitizer — observes the real inter-procedural order at test time;
-the two overlap on purpose, like the AST and jaxpr engines do.
+carry too): it resolves ``self.X``/``cls.X``, plain-name receivers, and
+call-site-propagated parameter types, and trusts the ``*_locked``
+suffix. The dynamic half — the ``RAFT_TPU_THREADSAN=1`` lock sanitizer
+(:mod:`raft_tpu.analysis.lockwatch`) — observes the real order at test
+time; reconciliation makes the overlap a checked invariant instead of a
+hope.
 """
 
 from __future__ import annotations
@@ -61,18 +81,18 @@ from raft_tpu.analysis.rules import (
     scan_suppressions,
 )
 
-# calls that construct a lock (guard-capable) or an event-like primitive
-_LOCK_FACTORIES = {
-    "threading.Lock", "threading.RLock", "Lock", "RLock",
-    "lockwatch.make_lock", "lockwatch.make_rlock",
-}
-_CONDITION_FACTORIES = {
-    "threading.Condition", "Condition", "lockwatch.make_condition",
-}
-_EVENT_FACTORIES = {
-    "threading.Event", "Event",
-    "threading.Semaphore", "Semaphore", "threading.BoundedSemaphore",
-}
+# calls that construct a lock (guard-capable) or an event-like
+# primitive, matched by the dotted name's LAST segment so
+# `threading.Lock`, `lockwatch.make_lock`, and a from-imported bare
+# `make_lock` all classify identically (the exact-match tables this
+# replaces missed from-imported sanitizer factories entirely, so a
+# class using `make_rlock()` had no guard inference at all)
+_LOCK_FACTORIES = {"Lock", "RLock", "make_lock", "make_rlock"}
+_CONDITION_FACTORIES = {"Condition", "make_condition"}
+_EVENT_FACTORIES = {"Event", "Semaphore", "BoundedSemaphore"}
+# flag locks are try-acquire handoffs (lockwatch.make_flag_lock):
+# tracked so GL020 and the order graph can exempt them, never guards
+_FLAG_FACTORIES = {"make_flag_lock"}
 
 # attribute names that read as locks when we cannot see the constructor
 # (helper-object receivers, cross-module state)
@@ -109,8 +129,11 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _is_factory(node: ast.AST, names: Set[str]) -> bool:
-    return isinstance(node, ast.Call) and (_dotted(node.func) or "") in names
+def _is_factory(node: ast.AST, last_names: Set[str]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted(node.func) or ""
+    return dotted.rsplit(".", 1)[-1] in last_names
 
 
 # guard keys:
@@ -129,6 +152,7 @@ class _ClassInfo:
     lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
     #   attr -> canonical attr (Condition aliases resolve to their lock)
     event_attrs: Set[str] = dataclasses.field(default_factory=set)
+    flag_attrs: Set[str] = dataclasses.field(default_factory=set)
     guarded: Dict[str, Set[tuple]] = dataclasses.field(default_factory=dict)
     #   attr -> guard keys it was written under (or annotated with)
     methods: Dict[str, ast.FunctionDef] = dataclasses.field(
@@ -139,10 +163,18 @@ class FileRaceLinter:
     """One file's lock-discipline pass. See the module docstring."""
 
     def __init__(self, path: str, source: str,
-                 rules: Optional[Set[str]] = None):
+                 rules: Optional[Set[str]] = None,
+                 skip_gl013: bool = False,
+                 project_guarded: Optional[Set[str]] = None):
         self.path = path
         self.source = source
         self.rules = rules
+        # project mode: the whole-program pass owns GL013, the per-file
+        # graph would only re-report a subset of each cycle
+        self.skip_gl013 = skip_gl013
+        # attr names with a guarded-by contract ANYWHERE in the project
+        # (extends GL011's notion of interesting shared state)
+        self.project_guarded = project_guarded or set()
         self.findings: List[Finding] = []
         self.tree = ast.parse(source, filename=path)
         self._comments = self._scan_comments(source)
@@ -151,6 +183,7 @@ class FileRaceLinter:
         self._fn_class: Dict[ast.AST, Optional[_ClassInfo]] = {}
         self._entry_fns: Set[ast.AST] = set()
         self._reach_fns: Set[ast.AST] = set()
+        self._prepared = False
         # receiver-aggregated guard inference: attr name -> lock attr
         # names it was written under (via `with <recv>.<lockattr>:`)
         self._recv_guarded: Dict[str, Set[str]] = {}
@@ -177,11 +210,27 @@ class FileRaceLinter:
             pass
         return out
 
-    def run(self) -> List[Finding]:
+    def prepare(self) -> None:
+        """The discovery half of :meth:`run` — split out so project
+        mode can pool every file's guarded-by contracts before any
+        file's checks fire."""
+        if self._prepared:
+            return
+        self._prepared = True
         self._collect_classes()
         self._collect_module_locks()
         self._collect_entries()
         self._infer_guarded()
+
+    def guarded_attr_names(self) -> Set[str]:
+        """Attr names this file declares or infers a guard for."""
+        out: Set[str] = set(self._recv_guarded)
+        for cls in self.classes:
+            out |= set(cls.guarded)
+        return out
+
+    def run(self) -> List[Finding]:
+        self.prepare()
         for cls in self.classes:
             for fn in self._class_fns(cls):
                 self._check_fn(fn, cls)
@@ -239,7 +288,9 @@ class FileRaceLinter:
 
     def _classify_lock_assign(self, ci: _ClassInfo, attr: str,
                               value: ast.AST) -> None:
-        if _is_factory(value, _LOCK_FACTORIES):
+        if _is_factory(value, _FLAG_FACTORIES):
+            ci.flag_attrs.add(attr)
+        elif _is_factory(value, _LOCK_FACTORIES):
             ci.lock_attrs.setdefault(attr, attr)
         elif _is_factory(value, _CONDITION_FACTORIES):
             target = attr
@@ -649,6 +700,7 @@ class FileRaceLinter:
         if cls is not None:
             self._expand_call_edges(fn, cls)
         self._check_gl011(fn, cls)
+        self._check_gl020(fn, cls)
 
     def _expand_call_edges(self, fn: ast.AST, cls: _ClassInfo) -> None:
         acquires: Dict[str, List[Tuple[tuple, int]]] = {}
@@ -714,7 +766,8 @@ class FileRaceLinter:
         def interesting(recv: str, attr: str) -> bool:
             if recv in _SELF_NAMES and cls is not None:
                 return attr in cls.guarded or attr in cls.event_attrs
-            return attr in self._recv_guarded
+            return attr in self._recv_guarded or \
+                attr in self.project_guarded
 
         def act_attr(node: ast.AST) -> Optional[Tuple[str, str]]:
             if isinstance(node, ast.Assign):
@@ -799,9 +852,155 @@ class FileRaceLinter:
 
         self._walk_regions(fn, cls, on_node=on_node)
 
+    # -- GL020 -------------------------------------------------------------
+
+    @staticmethod
+    def _nonblocking_call(node: ast.Call) -> bool:
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is False:
+            return True
+        return any(kw.arg == "blocking" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is False for kw in node.keywords)
+
+    def _gl020_label(self, expr: ast.AST,
+                     cls: Optional[_ClassInfo]) -> Optional[str]:
+        key = self._guard_key(expr, cls)
+        if key is None:
+            return None
+        if key[0] == "self" and cls is not None and \
+                key[2] in cls.flag_attrs:
+            return None               # try-acquire handoff, never held
+        return self._node_label(key)
+
+    def _check_gl020(self, fn: ast.AST, cls: Optional[_ClassInfo]) -> None:
+        """Path-sensitive pairing of manual ``acquire()``/``release()``.
+
+        A ``with`` block cannot leak its lock; a manual pair can, two
+        ways this flags at the ACQUIRE line (one finding per site):
+
+        * an early ``return`` (or the fall-through exit) while still
+          holding the lock, with no enclosing ``finally`` releasing it;
+        * work between acquire and release that can raise, with no
+          enclosing ``try``/``finally`` releasing it — the exception
+          propagates out still holding the lock.
+
+        Non-blocking acquires (``blocking=False`` — the test-and-set
+        idiom), flag locks, and functions that ARE the transfer idiom
+        (``acquire``/``__enter__`` wrappers) are exempt. Intentional
+        ownership transfers suppress with a reason naming the
+        releasing site.
+        """
+        if self.rules is not None and "GL020" not in self.rules:
+            return
+        if getattr(fn, "name", "") in ("acquire", "__enter__",
+                                       "release", "__exit__"):
+            return
+        reported: Set[Tuple[str, int]] = set()
+
+        def emit(label: str, line: int, why: str) -> None:
+            if (label, line) in reported:
+                return
+            reported.add((label, line))
+            self._emit(
+                "GL020", line,
+                f"manual {label}.acquire() can leak: {why}; use `with` "
+                f"or try/finally, or — if ownership transfers to a "
+                f"caller that releases it — suppress with a reason "
+                f"naming the releasing site")
+
+        # held: label -> [acquire line, protected by finally, risky
+        # call count since acquire]
+        def scan(node: ast.AST, held: Dict[str, list],
+                 protectors: Set[str]) -> None:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    label = self._gl020_label(f.value, cls)
+                    if label is None or self._nonblocking_call(sub) or \
+                            label in held:
+                        continue
+                    held[label] = [sub.lineno, label in protectors, 0]
+                elif isinstance(f, ast.Attribute) and f.attr == "release":
+                    label = self._gl020_label(f.value, cls)
+                    rec = held.pop(label, None) if label else None
+                    if rec is not None and not rec[1] and rec[2] > 0:
+                        emit(label, rec[0],
+                             "work between acquire and release can "
+                             "raise, exiting still holding the lock")
+                else:
+                    for rec in held.values():
+                        rec[2] += 1
+
+        def released_in(stmts: List[ast.stmt]) -> Set[str]:
+            out: Set[str] = set()
+            for st in stmts:
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr == "release":
+                        lb = self._gl020_label(sub.func.value, cls)
+                        if lb:
+                            out.add(lb)
+            return out
+
+        def walk(stmts: List[ast.stmt], held: Dict[str, list],
+                 protectors: Set[str]) -> None:
+            for st in stmts:
+                if isinstance(st, ast.Try):
+                    fin = released_in(st.finalbody)
+                    walk(st.body, held, protectors | fin)
+                    for h in st.handlers:
+                        walk(h.body, held, protectors)
+                    walk(st.orelse, held, protectors | fin)
+                    walk(st.finalbody, held, protectors)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        scan(item.context_expr, held, protectors)
+                    walk(st.body, held, protectors)
+                elif isinstance(st, ast.If):
+                    scan(st.test, held, protectors)
+                    other = {k: list(v) for k, v in held.items()}
+                    walk(st.body, held, protectors)
+                    walk(st.orelse, other, protectors)
+                    for k, v in other.items():   # may-hold union
+                        held.setdefault(k, v)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    scan(st.iter, held, protectors)
+                    walk(st.body, held, protectors)
+                    walk(st.orelse, held, protectors)
+                elif isinstance(st, ast.While):
+                    scan(st.test, held, protectors)
+                    walk(st.body, held, protectors)
+                    walk(st.orelse, held, protectors)
+                elif isinstance(st, ast.Return):
+                    for label, rec in held.items():
+                        if label not in protectors and not rec[1]:
+                            emit(label, rec[0],
+                                 f"the return at line {st.lineno} "
+                                 f"exits still holding it")
+                elif isinstance(st, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                else:
+                    scan(st, held, protectors)
+
+        body = fn.body if not isinstance(fn, ast.Lambda) else []
+        held: Dict[str, list] = {}
+        walk(list(body), held, set())
+        for label, rec in held.items():
+            if not rec[1]:
+                emit(label, rec[0],
+                     "no release on the fall-through exit path")
+
     # -- GL013 -------------------------------------------------------------
 
     def _check_gl013_cycles(self) -> None:
+        if self.skip_gl013:
+            return      # project mode: the whole-program graph owns GL013
         if self.rules is not None and "GL013" not in self.rules:
             return
         graph: Dict[str, Dict[str, Tuple[int, str]]] = {}
@@ -912,18 +1111,317 @@ def lint_file(path, rules: Optional[Set[str]] = None) -> List[Finding]:
                         f"syntax error: {e.msg}", engine="races")]
 
 
-def lint_paths(paths: Sequence, rules: Optional[Set[str]] = None
-               ) -> List[Finding]:
-    """Race-lint files and directories (``**/*.py``, sans __pycache__)."""
-    findings: List[Finding] = []
+def _collect_files(paths: Sequence) -> Tuple[List[Path], bool]:
+    files: List[Path] = []
+    any_dir = False
     for raw in paths:
         p = Path(raw)
         if p.is_dir():
-            files = sorted(
-                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
-            )
+            any_dir = True
+            files.extend(sorted(
+                f for f in p.rglob("*.py")
+                if "__pycache__" not in f.parts))
         else:
-            files = [p]
-        for f in files:
-            findings.extend(lint_file(f, rules))
-    return findings
+            files.append(p)
+    return files, any_dir
+
+
+def lint_paths(paths: Sequence, rules: Optional[Set[str]] = None,
+               project: Optional[bool] = None,
+               reconcile: Optional[str] = None) -> List[Finding]:
+    """Race-lint files and directories (``**/*.py``, sans __pycache__).
+
+    With more than one file in scope (or any directory), the pass runs
+    in **whole-program mode**: a project call graph + per-function lock
+    summaries (:mod:`callgraph`/:mod:`summaries`) replace the per-file
+    GL013 graph with the interprocedural one (cycles reported with the
+    full cross-file path), guarded-by contracts propagate across
+    modules (GL010 on typed foreign receivers, GL011's shared-state
+    set), and — when ``reconcile`` names a lockwatch graph artifact —
+    the static model is diffed against the runtime one (GL022 hard /
+    GL021 advisory). ``project=False`` forces the old per-file pass.
+    """
+    files, any_dir = _collect_files(paths)
+    if project is None:
+        # reconciliation diffs the WHOLE-PROGRAM graph by definition,
+        # so --reconcile forces project mode even for one file
+        project = any_dir or len(files) > 1 or reconcile is not None
+    summaries = None
+    if project:
+        try:
+            from raft_tpu.analysis.summaries import build_summaries
+            summaries = build_summaries(paths)
+        except Exception:
+            summaries = None       # degrade to per-file, never crash
+
+    linters: Dict[str, FileRaceLinter] = {}
+    findings: List[Finding] = []
+    for f in files:
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("GL000", str(f), 0,
+                                    f"unreadable: {e}", engine="races"))
+            continue
+        try:
+            linters[str(f)] = FileRaceLinter(
+                str(f), source, rules,
+                skip_gl013=summaries is not None)
+        except SyntaxError as e:
+            findings.append(Finding("GL000", str(f), e.lineno or 0,
+                                    f"syntax error: {e.msg}",
+                                    engine="races"))
+
+    # pool every file's guarded-by contracts BEFORE any checks run
+    project_guarded: Set[str] = set()
+    for lt in linters.values():
+        lt.prepare()
+        project_guarded |= lt.guarded_attr_names()
+    for lt in linters.values():
+        if summaries is not None:
+            lt.project_guarded = project_guarded
+        findings.extend(lt.run())
+
+    if summaries is not None:
+        extra = _global_gl013(summaries, rules)
+        extra += _cross_module_gl010(summaries, linters, rules)
+        if reconcile is not None:
+            extra += _reconcile_findings(summaries, reconcile, rules)
+        findings.extend(_apply_file_suppressions(extra, linters))
+
+    # per-file and whole-program passes overlap on purpose; keep the
+    # first (per-file, already suppression-applied) finding per site.
+    # GL010 dedupes by LINE (the two passes word the same defect
+    # differently); other rules keep distinct messages per line
+    seen: Set[tuple] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line) if f.rule == "GL010" \
+            else (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def _apply_file_suppressions(findings: List[Finding],
+                             linters: Dict[str, FileRaceLinter]
+                             ) -> List[Finding]:
+    """Run global-pass findings through their home file's inline
+    suppressions (GL022 anchors to the runtime artifact, which has no
+    source to suppress in — by design)."""
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path, fs in by_path.items():
+        lt = linters.get(path)
+        if lt is None:
+            out.extend(fs)
+            continue
+        sup = scan_suppressions(lt.source)
+        # drop the GL000s apply_suppressions re-reports for this file:
+        # the per-file pass already emitted them once
+        for f in apply_suppressions(fs, sup, path):
+            if f in fs:
+                out.append(f)
+    return out
+
+
+def _short(path: str) -> str:
+    for marker in ("raft_tpu/", "raft_tpu\\"):
+        i = path.find(marker)
+        if i >= 0:
+            return path[i:].replace("\\", "/")
+    return path
+
+
+def _global_gl013(summaries, rules: Optional[Set[str]]) -> List[Finding]:
+    """Whole-program lock-order cycles, named with the full cross-file
+    path (every edge's site) — the per-file GL013's interprocedural
+    replacement."""
+    if rules is not None and "GL013" not in rules:
+        return []
+    out: List[Finding] = []
+    edges = summaries.edges()
+    for cyc in summaries.cycles():
+        es = [edges[p] for p in zip(cyc, cyc[1:]) if p in edges]
+        if not es:
+            continue
+        first = min(es, key=lambda e: (e.path, e.line))
+        detail = "; ".join(
+            f"{e.a} -> {e.b} at {_short(e.path)}:{e.line} ({e.via})"
+            for e in es)
+        out.append(Finding(
+            "GL013", first.path, first.line,
+            f"whole-program lock-order cycle {' -> '.join(cyc)}: two "
+            f"paths acquire these locks in opposite orders and can "
+            f"deadlock ({detail}); pick one global order "
+            f"(docs/serving.md lock hierarchy) and restructure the "
+            f"out-of-order acquisition", engine="races"))
+    return out
+
+
+def _cross_module_gl010(summaries, linters: Dict[str, FileRaceLinter],
+                        rules: Optional[Set[str]]) -> List[Finding]:
+    """GL010 across module boundaries: an access through a TYPED
+    receiver (param/local/attr annotation, constructor inference) whose
+    home class declares a guarded-by contract for that attribute, made
+    outside the guarding lock.
+
+    The per-file pass sees ``self.X`` and same-module ``w.pending``
+    idioms; this pass is what makes ``hl.state.tombstones`` in fabric
+    answer to the contract ``MutableState`` declared in another file.
+    Held locks are tracked by lockwatch NAME via the project model, so
+    ``with st.lock:`` in the caller satisfies a ``serve.mutation``
+    contract no matter which alias spells it.
+    """
+    if rules is not None and "GL010" not in rules:
+        return []
+    g = summaries.graph
+    # (module path, class name) -> per-file class info (the contracts)
+    infos: Dict[Tuple[str, str], _ClassInfo] = {}
+    for lt in linters.values():
+        for ci in lt.classes:
+            infos[(lt.path, ci.name)] = ci
+
+    def want_names(cls_decl, ci: _ClassInfo, attr: str) -> Set[str]:
+        out: Set[str] = set()
+        for key in ci.guarded.get(attr, ()):
+            lockattr = key[-1]
+            decl = cls_decl.lock_attrs.get(lockattr)
+            out.add(decl.name if decl is not None
+                    else f"{cls_decl.name}.{lockattr}")
+        return out
+
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int, str, str]] = set()
+
+    def check_access(fn, env, held: List[str], recv: str, attr: str,
+                     is_write: bool, line: int) -> None:
+        if recv in _SELF_NAMES:
+            return                 # the per-file pass owns self.X
+        for t in env.get(recv, ()):
+            if t.container is not None:
+                continue
+            ci = infos.get((t.cls.module.path, t.cls.name))
+            if ci is None or attr not in ci.guarded:
+                continue
+            if attr in ci.lock_attrs or attr in ci.event_attrs or \
+                    attr in ci.flag_attrs:
+                continue
+            want = want_names(t.cls, ci, attr)
+            if not want or set(held) & want:
+                continue
+            if not is_write and fn not in g.reachable:
+                continue
+            key = (fn.module.path, line, recv, attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            kind = "write to" if is_write else "thread-reachable read of"
+            locks = ", ".join(sorted(want))
+            out.append(Finding(
+                "GL010", fn.module.path, line,
+                f"{kind} {recv}.{attr} outside its guarding lock "
+                f"({locks}): the guarded-by contract is declared by "
+                f"{t.cls.name} in {_short(t.cls.module.path)} — hold "
+                f"the lock here, or suppress with a reason",
+                engine="races"))
+
+    for fn in summaries.direct:
+        node = fn.node
+        if isinstance(node, ast.Lambda) or \
+                getattr(node, "name", "").endswith("_locked") or \
+                getattr(node, "name", "") in ("__init__", "__new__"):
+            continue
+        env = g.local_types(fn)
+        held: List[str] = []
+
+        def visit(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not fn.node:
+                return
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in n.items:
+                    decl = g.lock_node(item.context_expr, fn)
+                    if decl is not None and decl.kind != "flag":
+                        held.append(decl.name)
+                        pushed += 1
+                for child in n.body:
+                    visit(child)
+                for _ in range(pushed):
+                    held.pop()
+                return
+            ra: Optional[Tuple[str, str, bool]] = None
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    if isinstance(base, ast.Attribute) and \
+                            isinstance(base.value, ast.Name):
+                        ra = (base.value.id, base.attr, True)
+            elif isinstance(n, ast.AugAssign):
+                base = n.target.value \
+                    if isinstance(n.target, ast.Subscript) else n.target
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name):
+                    ra = (base.value.id, base.attr, True)
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _MUTATING_CALLS and \
+                    isinstance(n.func.value, ast.Attribute) and \
+                    isinstance(n.func.value.value, ast.Name):
+                ra = (n.func.value.value.id, n.func.value.attr, True)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.ctx, ast.Load) and \
+                    isinstance(n.value, ast.Name):
+                ra = (n.value.id, n.attr, False)
+            if ra is not None:
+                check_access(fn, env, held, ra[0], ra[1], ra[2],
+                             getattr(n, "lineno", 0))
+            for child in ast.iter_child_nodes(n):
+                visit(child)
+
+        for child in node.body:
+            visit(child)
+    return out
+
+
+def _reconcile_findings(summaries, artifact: str,
+                        rules: Optional[Set[str]]) -> List[Finding]:
+    """Static ↔ dynamic graph diff (``--reconcile``): GL022 for runtime
+    edges the model cannot see (hard — a soundness gap), GL021 for
+    static edges no threadsan run exercised (advisory coverage debt)."""
+    import json as _json
+    try:
+        with open(artifact) as fh:
+            data = _json.load(fh)
+    except (OSError, ValueError) as e:
+        return [Finding("GL000", str(artifact), 0,
+                        f"unreadable lock-graph artifact: {e}",
+                        engine="races")]
+    graph = data.get("graph", data) if isinstance(data, dict) else {}
+    missing, untested = summaries.reconcile(graph)
+    out: List[Finding] = []
+    if rules is None or "GL022" in rules:
+        for a, b, site in missing:
+            where = f" (first seen at {site})" if site else ""
+            out.append(Finding(
+                "GL022", str(artifact), 0,
+                f"runtime lock edge {a} -> {b}{where} is absent from "
+                f"the static model: the sanitizer observed this order "
+                f"under test and the whole-program analysis cannot see "
+                f"it — extend the call-graph typing or annotate the "
+                f"acquisition path (never suppress the evidence)",
+                engine="races"))
+    if rules is None or "GL021" in rules:
+        for e in untested:
+            out.append(Finding(
+                "GL021", e.path, e.line,
+                f"static lock-order edge {e.a} -> {e.b} ({e.via}) was "
+                f"never exercised under the runtime sanitizer — add "
+                f"threadsan coverage driving this path, or the "
+                f"hierarchy claim rests on the static model alone",
+                engine="races", advisory=True))
+    return out

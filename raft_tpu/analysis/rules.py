@@ -35,18 +35,20 @@ class Rule:
     slug: str          # "host-sync" — the token used in suppressions
     summary: str       # one line for --list-rules / docs
     rationale: str     # why this class bites on TPU
+    engine: str = "ast"  # which engine emits it: ast|jaxpr|races|kern
 
 
 RULES: Dict[str, Rule] = {}
 _SLUG_TO_ID: Dict[str, str] = {}
 
 
-def register_rule(rule_id: str, slug: str, summary: str, rationale: str = "") -> Rule:
+def register_rule(rule_id: str, slug: str, summary: str,
+                  rationale: str = "", engine: str = "ast") -> Rule:
     if rule_id in RULES:
         raise ValueError(f"duplicate rule id {rule_id}")
     if slug in _SLUG_TO_ID:
         raise ValueError(f"duplicate rule slug {slug}")
-    rule = Rule(rule_id, slug, summary, rationale)
+    rule = Rule(rule_id, slug, summary, rationale, engine)
     RULES[rule_id] = rule
     _SLUG_TO_ID[slug] = rule_id
     return rule
@@ -98,6 +100,7 @@ register_rule(
     "TPU-KNN holds peak FLOP/s only when steady-state serving never "
     "recompiles; a repeat sweep over identical shapes must add zero "
     "traces",
+    engine="jaxpr",
 )
 register_rule(
     "GL008", "unclassified-swallow",
@@ -138,6 +141,7 @@ register_rule(
     "outside that lock is exactly the unpinned-handle / stale-flag class "
     "every post-review fix in PRs 5-6 chased by hand; methods named "
     "*_locked assert a caller-holds-lock contract instead",
+    engine="races",
 )
 register_rule(
     "GL011", "check-then-act",
@@ -149,6 +153,7 @@ register_rule(
     "single-flight bug class (an Event check-then-set admitted "
     "duplicate background compactions); make it one critical section "
     "or a real test-and-set",
+    engine="races",
 )
 register_rule(
     "GL012", "device-work-under-lock",
@@ -159,6 +164,7 @@ register_rule(
     "delete/upsert/dispatch into tail latency — the side-build-under-"
     "the-mutation-RLock class PR 5's sixth review pass fixed; snapshot "
     "under the lock, compute outside",
+    engine="races",
 )
 register_rule(
     "GL013", "lock-order-cycle",
@@ -168,6 +174,7 @@ register_rule(
     "orders deadlock under the right interleaving; the static graph "
     "catches lexically-visible cycles, the RAFT_TPU_THREADSAN lock "
     "sanitizer (analysis/lockwatch.py) catches the rest at test time",
+    engine="races",
 )
 register_rule(
     "GL014", "unjoined-thread",
@@ -176,6 +183,7 @@ register_rule(
     "its closure (device arrays, servers) and can hang interpreter "
     "exit — the serving tier's convention is daemon threads plus "
     "explicit close/join lifecycles",
+    engine="races",
 )
 register_rule(
     "GL006", "blockspec",
@@ -188,6 +196,7 @@ register_rule(
     "shape binding a contract or dispatch-table winner can inject; the "
     "pre-engine literal heuristic survives only for call sites the "
     "evaluator cannot resolve",
+    engine="kern",
 )
 register_rule(
     "GL015", "kernel-oob",
@@ -200,6 +209,7 @@ register_rule(
     "without an in-kernel mask (jnp.where/pl.when on a bound compare) "
     "pad garbage can win the reduction, the tail-masking bug class every "
     "fused kernel here has hit at least once",
+    engine="kern",
 )
 register_rule(
     "GL016", "tile-align",
@@ -210,6 +220,7 @@ register_rule(
     "anything else relayouts or fails to lower. GL006's literal screen "
     "could not see computed geometry (tile variables, tuning winners, "
     "helper-derived candidate widths) — this rule evaluates it",
+    engine="kern",
 )
 register_rule(
     "GL017", "grid-hazard",
@@ -221,6 +232,7 @@ register_rule(
     "accumulation without a first-step init (pl.when on program_id) "
     "reads uninitialized VMEM — both are silent wrong-answer classes "
     "invisible in interpret mode when the test grid is 1",
+    engine="kern",
 )
 register_rule(
     "GL019", "untraced-rpc",
@@ -245,6 +257,42 @@ register_rule(
     "bf16/int8 contraction without preferred_element_type=f32 keeps the "
     "accumulator low-precision — the 2^24 ordering-collapse class's "
     "matmul cousin",
+    engine="kern",
+)
+register_rule(
+    "GL020", "unbalanced-acquire",
+    "manual lock.acquire() with a path (early return or uncovered "
+    "exception) that exits the function still holding the lock",
+    "a `with` block cannot leak; a manual acquire()/release() pair can "
+    "— one early return or one exception between them and every later "
+    "acquirer deadlocks, the worst failure mode the serving tier has "
+    "(no wrong answer, just a hang the sanitizer's hold budget needs "
+    "30s to even name). Intentional ownership transfers (acquire here, "
+    "release in the caller's finally) suppress with a reason naming "
+    "the releasing site",
+    engine="races",
+)
+register_rule(
+    "GL021", "untested-lock-edge",
+    "static lock-order edge never exercised under the runtime "
+    "sanitizer (reconciliation mode; report-only)",
+    "the static graph claims an acquisition order the threadsan suite "
+    "never witnessed: either dead code, an imprecise static edge, or — "
+    "worst — a real ordering no test drives, which is exactly where "
+    "inversions ship. Advisory: it gates nothing, it names the "
+    "coverage debt",
+    engine="races",
+)
+register_rule(
+    "GL022", "unmodeled-lock-edge",
+    "runtime-observed lock-order edge absent from the static model "
+    "(reconciliation mode)",
+    "the sanitizer WATCHED this order happen under test and the "
+    "whole-program model cannot see it — a soundness gap (unresolved "
+    "dynamic dispatch, an unannotated generic, a closure) that means "
+    "GL013's cycle search is blind on these nodes; fix the model or "
+    "annotate the path, never suppress the evidence",
+    engine="races",
 )
 
 
@@ -262,6 +310,7 @@ class Finding:
     engine: str = "ast"        # "ast" | "jaxpr" | "races" | "kern"
     suppressed: bool = False
     reason: str = ""           # the suppression's reason when suppressed
+    advisory: bool = False     # report-only: never gates the exit code
 
     @property
     def slug(self) -> str:
@@ -277,11 +326,14 @@ class Finding:
             "engine": self.engine,
             "suppressed": self.suppressed,
             "reason": self.reason,
+            "advisory": self.advisory,
         }
 
     def render(self) -> str:
         mark = " [suppressed: %s]" % self.reason if self.suppressed else ""
-        return f"{self.path}:{self.line}: {self.rule} ({self.slug}) {self.message}{mark}"
+        adv = " [advisory]" if self.advisory else ""
+        return (f"{self.path}:{self.line}: {self.rule} ({self.slug}) "
+                f"{self.message}{adv}{mark}")
 
 
 # ---------------------------------------------------------------------------
@@ -362,4 +414,35 @@ def apply_suppressions(
                 hit.used = True
                 break
         out.append(f)
+    return out
+
+
+def stale_suppressions(path: str, source: str,
+                       findings: Iterable[Finding],
+                       engines_run: Iterable[str]) -> List[Finding]:
+    """GL000 findings for suppressions that no longer suppress anything
+    (``--strict-suppressions``).
+
+    A suppression that outlives its finding is debt with a reason
+    attached: the next reader trusts a hazard note describing code that
+    no longer exists. Only slugs whose owning engine actually RAN are
+    judged — an ast-only run cannot call a races suppression stale, it
+    simply never looked."""
+    engines = set(engines_run)
+    matched = set()
+    for f in findings:
+        if f.suppressed and f.path == path:
+            matched.add((f.line, f.slug))
+            matched.add((f.line - 1, f.slug))
+    out: List[Finding] = []
+    for s in scan_suppressions(source):
+        rule = rule_for_slug(s.slug)
+        if rule is None or rule.engine not in engines:
+            continue
+        if (s.line, s.slug) not in matched:
+            out.append(Finding(
+                "GL000", path, s.line,
+                f"stale suppression: allow-{s.slug} matches no current "
+                f"{rule.id} finding on this line — the hazard it "
+                f"documents is gone; delete the marker"))
     return out

@@ -1,0 +1,319 @@
+"""Per-function lock summaries over the project call graph (ISSUE 17).
+
+For every function the :mod:`callgraph` model knows, this module
+computes a **lock summary**:
+
+* ``direct``   — lock nodes the function acquires lexically (``with``
+  blocks, plus manual ``acquire()`` whose receiver resolves — a manual
+  acquire opens a held region until its same-function ``release()``);
+* ``may_acquire`` — the transitive closure over resolvable callees,
+  propagated to fixpoint (mutual recursion converges, it never spins);
+* ``holds_on_entry`` — the ``*_locked`` suffix contract: the caller
+  holds the class lock, so the function's own acquisitions are edges
+  from the *call site's* held set, which the interprocedural expansion
+  attributes caller-side;
+* the **held set at every call site**, which is where the
+  whole-program edges come from.
+
+The resulting project acquisition graph uses exactly the runtime
+sanitizer's semantics (:mod:`raft_tpu.analysis.lockwatch`): an
+acquisition adds an edge from EVERY currently-held lock, nodes are lock
+*names* (``serve.mutation``, not instances), conditions alias to the
+lock they wrap, and flag locks (try-acquire handoffs) are not nodes at
+all. That shared vocabulary is what makes static↔dynamic
+**reconciliation** a set diff: a runtime-observed edge absent here is a
+soundness gap (GL022), a static edge never exercised under threadsan
+is sanitizer-coverage debt (GL021, report-only).
+
+Known blind spots (the reconciliation pass is the audit for all of
+them): nested closures are not separate summary nodes (their lexical
+acquisitions are invisible unless the enclosing function holds the
+region), a manual acquire held ACROSS a return (ownership transfer)
+stops contributing once the function exits, and unannotated generics
+do not resolve.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from raft_tpu.analysis.callgraph import CallGraph, FuncDecl, build_project
+
+
+@dataclasses.dataclass(frozen=True)
+class LockEdge:
+    """One acquisition-order edge ``a -> b`` with its first-seen site."""
+
+    a: str
+    b: str
+    path: str
+    line: int
+    via: str
+
+
+class LockSummaries:
+    """Whole-program lock summaries + the project acquisition graph."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.direct: Dict[FuncDecl, Set[str]] = {}
+        self.may_acquire: Dict[FuncDecl, Set[str]] = {}
+        self.holds_on_entry: Dict[FuncDecl, bool] = {}
+        # fn -> [(callee candidates, held lock names, line)]
+        self._call_sites: Dict[FuncDecl, List[
+            Tuple[List[FuncDecl], Tuple[str, ...], int]]] = {}
+        self._edges: Dict[Tuple[str, str], LockEdge] = {}
+        # lock name -> first construction/acquisition site (GL021 anchor)
+        self.acquire_sites: Dict[str, Tuple[str, int]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, graph: CallGraph) -> "LockSummaries":
+        s = cls(graph)
+        for fn in s._all_fns():
+            s.direct[fn] = set()
+            s._call_sites[fn] = []
+            s.holds_on_entry[fn] = fn.name.endswith("_locked")
+            s._walk_fn(fn)
+        s._fixpoint()
+        s._expand_call_edges()
+        return s
+
+    def _all_fns(self) -> List[FuncDecl]:
+        out: List[FuncDecl] = []
+        for mod in self.graph.modules.values():
+            out.extend(mod.functions.values())
+            for cd in mod.classes.values():
+                out.extend(cd.methods.values())
+        return out
+
+    # -- per-function walk -------------------------------------------------
+
+    def _acquired(self, fn: FuncDecl, name: str, line: int,
+                  held: Sequence[str]) -> None:
+        self.direct[fn].add(name)
+        self.acquire_sites.setdefault(name, (fn.module.path, line))
+        if name in held:
+            # reentrant by name: the sanitizer records NO edges for a
+            # re-acquisition of a held lock (RLock depth > 1 never
+            # reaches _record_acquired) — mirroring that here keeps the
+            # static graph diffable against the runtime one
+            return
+        for h in held:
+            if h != name:
+                self._edges.setdefault(
+                    (h, name),
+                    LockEdge(h, name, fn.module.path, line,
+                             "nested acquisition"))
+
+    @staticmethod
+    def _nonblocking(call: ast.Call) -> bool:
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return any(kw.arg == "blocking" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is False for kw in call.keywords)
+
+    def _walk_fn(self, fn: FuncDecl) -> None:
+        g = self.graph
+        held: List[str] = []
+        manual: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    decl = g.lock_node(item.context_expr, fn)
+                    if decl is not None and decl.kind != "flag":
+                        self._acquired(fn, decl.name, node.lineno, held)
+                        held.append(decl.name)
+                        pushed += 1
+                for child in node.body:
+                    visit(child)
+                for _ in range(pushed):
+                    held.pop()
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    decl = g.lock_node(f.value, fn)
+                    if decl is not None and decl.kind != "flag" and \
+                            not self._nonblocking(node) and \
+                            decl.name not in held:
+                        self._acquired(fn, decl.name, node.lineno, held)
+                        held.append(decl.name)
+                        manual.append(decl.name)
+                elif isinstance(f, ast.Attribute) and f.attr == "release":
+                    decl = g.lock_node(f.value, fn)
+                    if decl is not None and decl.name in manual:
+                        manual.remove(decl.name)
+                        if decl.name in held:
+                            held.remove(decl.name)
+                else:
+                    callees = g.resolve_call(node, fn)
+                    if callees:
+                        self._call_sites[fn].append(
+                            (callees, tuple(held), node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        body = fn.node.body if not isinstance(fn.node, ast.Lambda) \
+            else [fn.node.body]
+        for child in body:
+            visit(child)
+
+    # -- interprocedural closure -------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for fn in self.direct:
+            self.may_acquire[fn] = set(self.direct[fn])
+        changed = True
+        while changed:
+            changed = False
+            for fn, sites in self._call_sites.items():
+                acc = self.may_acquire[fn]
+                for callees, _held, _line in sites:
+                    for c in callees:
+                        extra = self.may_acquire.get(c, set())
+                        if not extra <= acc:
+                            acc |= extra
+                            changed = True
+
+    def _expand_call_edges(self) -> None:
+        for fn, sites in self._call_sites.items():
+            for callees, held, line in sites:
+                if not held:
+                    continue
+                for c in callees:
+                    for m in self.may_acquire.get(c, ()):
+                        if m in held:
+                            continue       # reentrant — see _acquired
+                        for h in held:
+                            if h != m:
+                                self._edges.setdefault(
+                                    (h, m),
+                                    LockEdge(h, m, fn.module.path, line,
+                                             f"call to {c.name}()"))
+
+    # -- results -----------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], LockEdge]:
+        """The project acquisition graph (lockwatch semantics)."""
+        return dict(self._edges)
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        return set(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct lock-order cycle in the project graph, as
+        closed paths (first == last), deduped by node set."""
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in self._edges:
+            graph.setdefault(a, []).append(b)
+        for succs in graph.values():
+            succs.sort()
+        out: List[List[str]] = []
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            path: List[str] = []
+
+            def dfs(n: str) -> Optional[List[str]]:
+                if n in path:
+                    return path[path.index(n):] + [n]
+                if n not in graph:
+                    return None
+                path.append(n)
+                for succ in graph[n]:
+                    cyc = dfs(succ)
+                    if cyc is not None:
+                        return cyc
+                path.pop()
+                return None
+
+            cyc = dfs(start)
+            if cyc is not None and frozenset(cyc) not in reported:
+                reported.add(frozenset(cyc))
+                out.append(cyc)
+        return out
+
+    # -- static <-> dynamic reconciliation ---------------------------------
+
+    def reconcile(self, runtime_graph: Dict[str, dict]
+                  ) -> Tuple[List[Tuple[str, str, str]],
+                             List[LockEdge]]:
+        """Diff the runtime acquisition graph against the static model.
+
+        ``runtime_graph`` is ``lockwatch.order_graph()`` shaped —
+        ``{holder: {acquired: first_seen_site}}`` (a plain list of
+        successors is accepted too). Returns ``(missing, untested)``:
+
+        * ``missing`` — runtime edges absent from the static model,
+          each ``(a, b, site)``: the sanitizer OBSERVED an order the
+          model cannot see — a soundness gap in the static analysis
+          (or an unmodeled dynamic dispatch); hard finding;
+        * ``untested`` — static edges never exercised under threadsan:
+          hierarchy claims with no runtime witness (coverage debt,
+          report-only)."""
+        static = self.edge_set()
+        missing: List[Tuple[str, str, str]] = []
+        runtime: Set[Tuple[str, str]] = set()
+        for a, succs in sorted(runtime_graph.items()):
+            items = succs.items() if isinstance(succs, dict) \
+                else [(b, "") for b in succs]
+            for b, site in sorted(items):
+                runtime.add((a, b))
+                if (a, b) not in static:
+                    missing.append((a, b, site if isinstance(site, str)
+                                    else ""))
+        untested = [e for (a, b), e in sorted(self._edges.items())
+                    if (a, b) not in runtime]
+        return missing, untested
+
+    # -- hierarchy rendering (docs/serving.md §11) -------------------------
+
+    def render_hierarchy(self) -> str:
+        """The documented lock hierarchy, generated from the static
+        graph: every order edge with its first-seen site, grouped by
+        holder, plus the leaf locks (never held across another
+        acquisition). Deterministic output — docs and the drift test
+        compare it verbatim."""
+        by_holder: Dict[str, List[LockEdge]] = {}
+        for e in self._edges.values():
+            by_holder.setdefault(e.a, []).append(e)
+        nodes: Set[str] = set()
+        for (a, b) in self._edges:
+            nodes.add(a)
+            nodes.add(b)
+        lines: List[str] = []
+        for a in sorted(by_holder):
+            lines.append(f"- `{a}` precedes:")
+            for e in sorted(by_holder[a], key=lambda e: e.b):
+                site = f"{_short(e.path)}:{e.line}"
+                lines.append(f"  - `{e.b}` ({e.via} at {site})")
+        leaves = sorted(n for n in nodes if n not in by_holder)
+        if leaves:
+            lines.append("- leaf locks (never held across another "
+                         "acquisition): " +
+                         ", ".join(f"`{n}`" for n in leaves))
+        return "\n".join(lines)
+
+
+def _short(path: str) -> str:
+    """Repo-relative spelling of a module path when possible."""
+    for marker in ("raft_tpu/", "raft_tpu\\"):
+        i = path.find(marker)
+        if i >= 0:
+            return path[i:].replace("\\", "/")
+    return path
+
+
+def build_summaries(paths: Sequence) -> LockSummaries:
+    """Convenience: project model + summaries in one call."""
+    return LockSummaries.build(build_project(paths))

@@ -443,7 +443,7 @@ class HostArraySource(RerankSource):
         # the structural host sync of the tiered pipeline: the
         # shortlist ids must reach the host to drive the gather — this
         # is the ONE device->host hop the architecture is built around
-        ids_host = np.asarray(candidates)  # graft-lint: allow-host-sync shortlist ids drive the host gather; the sync IS the tier boundary
+        ids_host = np.asarray(candidates)  # the sync IS the tier boundary
         if ids_host.ndim != 2:
             raise ValueError(f"candidates must be [m, c], got "
                              f"{ids_host.shape}")

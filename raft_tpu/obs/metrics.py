@@ -195,7 +195,7 @@ def capture_runtime_gauges() -> None:
             continue
         try:
             n = int(size_of())
-        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow private jax API probe; absence of the gauge is the degraded answer
+        except Exception:  # noqa: BLE001 — private jax API probe; absence of the gauge is the degraded answer
             continue
         label = f"{mod_name.rsplit('.', 1)[-1]}.{fn_name}"
         gauge("jit_cache_entries", float(n), fn=label)

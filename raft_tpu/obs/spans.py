@@ -116,7 +116,7 @@ class Span:
         if self._ta is not None:
             try:
                 self._ta.__exit__(exc_type, exc, tb)
-            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow profiler teardown is best-effort (see __enter__)
+            except Exception:  # noqa: BLE001 — profiler teardown is best-effort (see __enter__)
                 pass
         stack = _stack(create=False)
         if stack and stack[-1] is self:

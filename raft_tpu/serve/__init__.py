@@ -92,7 +92,7 @@ def trace_cache_sizes() -> dict:
             continue
         try:
             out[f"{mod_name.rsplit('.', 1)[-1]}.{fn_name}"] = int(size_of())
-        except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow private jax API probe; a missing gauge is the degraded answer
+        except Exception:  # noqa: BLE001 — private jax API probe; a missing gauge is the degraded answer
             continue
     return out
 

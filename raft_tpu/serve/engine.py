@@ -496,6 +496,7 @@ class _IndexServing:
         for _ in range(8):
             gen = self.registry.pin(self.name)
             st = gen.handle.state
+            # graft-lint: allow-unbalanced-acquire ownership transfer: _dispatch_once's finally releases st.lock with gen
             st.lock.acquire()
             if self.registry.get(self.name) is gen:
                 return gen, st
@@ -505,6 +506,7 @@ class _IndexServing:
         # state are still a valid pair for a non-compaction swap)
         gen = self.registry.pin(self.name)
         st = gen.handle.state
+        # graft-lint: allow-unbalanced-acquire ownership transfer: _dispatch_once's finally releases st.lock with gen
         st.lock.acquire()
         return gen, st
 
@@ -1389,7 +1391,7 @@ class Server:
                 with st.lock:
                     if st.seq != epoch:
                         return            # a mutation landed in flight
-            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow cache-insert probe only; a torn-down registry just skips the insert
+            except Exception:  # noqa: BLE001 — cache-insert probe only; a torn-down registry just skips the insert
                 return
             d, i = f.result()
             cache.put(key, gen_v, epoch, (d.copy(), i.copy()))

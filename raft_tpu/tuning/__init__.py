@@ -265,7 +265,7 @@ def _winner_tiles(table, op: str, prefix: str) -> set:
                 tail = w[len(prefix):].split(":", 1)[0]
                 if tail.isdigit():
                     tiles.add(int(tail))
-    except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow malformed table entries only shrink the audited domain to the canonical set
+    except Exception:  # noqa: BLE001 — malformed table entries only shrink the audited domain to the canonical set
         pass
     return tiles
 

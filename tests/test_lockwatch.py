@@ -5,8 +5,9 @@ Covers: the planted lock-order inversion (raises with the cycle path
 named — the ISSUE acceptance), hold-time budget breaches, RLock
 reentrancy (no self-edge, outermost-hold timing), Condition integration
 over both wrapper kinds, cross-thread release (the compacting-flag
-handoff shape), the off-mode plain-primitive fast path, and the
-failure dump through graft-scope."""
+handoff shape, both raw and through make_flag_lock), the graph export
+that feeds ``graft-lint --reconcile``, the off-mode plain-primitive
+fast path, and the failure dump through graft-scope."""
 
 import threading
 import time
@@ -192,6 +193,80 @@ def test_flag_lock_is_exempt():
     try-acquire-only handoff flag cannot deadlock."""
     flag = lockwatch.make_flag_lock("serve.compacting")
     assert isinstance(flag, type(threading.Lock()))
+
+
+def test_flag_lock_cross_thread_handoff():
+    """The real compact() shape end-to-end: the caller try-acquires the
+    flag, a worker thread does the work and releases it from a DIFFERENT
+    thread, all while sanitized locks are in play. The flag must stay
+    out of the order graph (it is a plain Lock), leave no phantom entry
+    in either thread's held-set, and be immediately re-acquirable."""
+    flag = lockwatch.make_flag_lock("serve.compacting")
+    state = lockwatch.make_rlock("serve.mutation")
+    done = threading.Event()
+
+    assert flag.acquire(blocking=False)      # single-flight claim
+    assert not flag.acquire(blocking=False)  # second entrant bounces
+
+    def worker():
+        with state:                          # sanitized work under flag
+            pass
+        flag.release()                       # handoff release, thread B
+        done.set()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert done.wait(timeout=5)
+    t.join(timeout=5)
+
+    # released cross-thread: next single-flight round starts clean
+    assert flag.acquire(blocking=False)
+    flag.release()
+    g = lockwatch.order_graph()
+    assert "serve.compacting" not in g
+    assert not any("serve.compacting" in succs for succs in g.values())
+    assert lockwatch.stats()["inversions"] == 0
+
+
+def test_export_graph_writes_reconcile_artifact(tmp_path):
+    """export_graph dumps the observed graph in the shape
+    --reconcile consumes, and merge=True unions a prior artifact
+    (sharded runs accumulate instead of clobbering)."""
+    import json
+
+    a = lockwatch.make_lock("exp.A")
+    b = lockwatch.make_lock("exp.B")
+    with a:
+        with b:
+            pass
+    target = str(tmp_path / "graph.json")
+    assert lockwatch.export_graph(target) == target
+    doc = json.load(open(target))
+    assert "exp.B" in doc["graph"]["exp.A"]
+    assert doc["stats"]["acquires"] == 2
+
+    # second process observed a different edge: merge keeps both
+    lockwatch.reset()
+    c = lockwatch.make_lock("exp.C")
+    with b:
+        with c:
+            pass
+    lockwatch.export_graph(target, merge=True)
+    doc = json.load(open(target))
+    assert "exp.B" in doc["graph"]["exp.A"]
+    assert "exp.C" in doc["graph"]["exp.B"]
+
+
+def test_export_graph_env_var_default(monkeypatch, tmp_path):
+    target = str(tmp_path / "env_graph.json")
+    monkeypatch.setenv(lockwatch.EXPORT_ENV_VAR, target)
+    lk = lockwatch.make_lock("envexp.A")
+    with lk:
+        pass
+    assert lockwatch.export_graph() == target
+    with pytest.raises(ValueError):
+        monkeypatch.delenv(lockwatch.EXPORT_ENV_VAR)
+        lockwatch.export_graph()
 
 
 def test_failure_dump_reaches_obs(monkeypatch, tmp_path):
