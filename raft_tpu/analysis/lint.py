@@ -611,6 +611,10 @@ class FileLinter:
         # probe/restart is a blind spot exactly when the cluster is
         # degraded and observability matters most
         "probe", "restart",
+        # graft-helm (ISSUE 18): membership mutation and shard movement
+        # are the cluster's most disruptive actions — every
+        # scale/rebalance/balance decision must leave a span
+        "scale", "rebalance", "balance",
     )
 
     def _check_unspanned_entries(self) -> None:

@@ -389,6 +389,12 @@ class ProcGroup:
       the reply payload, or raises the classified failure);
     * :meth:`alive` / :meth:`kill` / :meth:`restart` — process
       lifecycle (``kill`` is SIGKILL: the machine-loss drill);
+    * :meth:`add_worker` / :meth:`retire` — dynamic admission and
+      retirement (ISSUE 18): ranks are append-only and stable; a
+      retired rank's slot stays (dead) so in-flight routing indexed by
+      rank never dangles. Membership mutation is single-actor by
+      contract — the control plane (graft-helm) or the owning test,
+      never concurrent mutators;
     * :meth:`close` — stop everything.
 
     Children inherit the parent environment minus the
@@ -408,11 +414,20 @@ class ProcGroup:
         self.platform = platform
         self._ctx = mp.get_context("spawn")
         self._req_ids = itertools.count(1)
+        # incarnation deaths per rank — the parent-side flap budget
+        # (faultinject.respawned_spec): each child holds its own copy
+        # of the fault plan, so the cross-incarnation charge lives here
+        self._deaths: Dict[int, int] = {}
         self._workers: List[_ProcWorker] = [
             self._spawn(r, fault_spec) for r in range(self.n_workers)
         ]
 
     # -- lifecycle ----------------------------------------------------------
+
+    def ranks(self) -> List[int]:
+        """All member ranks ever admitted (retired/dead slots included —
+        liveness is :meth:`alive`'s question)."""
+        return list(range(len(self._workers)))
 
     def _spawn(self, rank: int, fault_spec: Optional[str]) -> _ProcWorker:
         req_q = self._ctx.Queue()
@@ -554,17 +569,53 @@ class ProcGroup:
         self._fail_pending(w, f"fabric worker {rank} killed")
 
     def restart(self, rank: int,
-                fault_spec: Optional[str] = None) -> None:
+                fault_spec: Optional[str] = None,
+                inherit_faults: bool = False) -> None:
         """Respawn ``rank`` as a fresh process with NO index state (the
-        router must re-sync it) and no inherited fault plan unless one
-        is given explicitly."""
+        router must re-sync it). The fresh incarnation installs no
+        fault plan unless one is given explicitly — or
+        ``inherit_faults=True``, which installs the spawn-time plan
+        rewritten by :func:`faultinject.respawned_spec` (flap budgets
+        charged one death per prior incarnation, dead specs kept
+        permanent): the control plane's respawn path, where the drills
+        need the schedule to survive the respawn it provoked."""
         old = self._workers[rank]
         old.stopping = True
         if old.proc.is_alive():
             old.proc.kill()
         old.proc.join(timeout=10.0)
         self._fail_pending(old, f"fabric worker {rank} restarted")
+        self._deaths[rank] = self._deaths.get(rank, 0) + 1
+        if fault_spec is None and inherit_faults:
+            fault_spec = faultinject.respawned_spec(
+                self.fault_spec, rank, self._deaths[rank])
         self._workers[rank] = self._spawn(rank, fault_spec)
+
+    def add_worker(self, fault_spec: Optional[str] = None) -> int:
+        """Admit one new worker (autoscale-up): spawn it under the next
+        rank and return that rank. The newcomer owns no shards until a
+        generation that places some on it is published
+        (``Fabric.rebalance``)."""
+        rank = len(self._workers)
+        self._workers.append(self._spawn(rank, fault_spec))
+        self.n_workers = len(self._workers)
+        return rank
+
+    def retire(self, rank: int, timeout_s: float = 10.0) -> None:
+        """Retire one worker for good (autoscale-down): graceful stop,
+        SIGKILL past the timeout. The rank slot stays, dead — ranks are
+        stable for the life of the group."""
+        w = self._workers[rank]
+        w.stopping = True
+        try:
+            w.req_q.put((0, "stop", None))
+        except BaseException as e:  # noqa: BLE001 — classified: retiring an already-dead queue
+            _rerrors.classify(e)
+        w.proc.join(timeout=timeout_s)
+        if w.proc.is_alive():
+            w.proc.kill()
+            w.proc.join(timeout=5.0)
+        self._fail_pending(w, f"fabric worker {rank} retired")
 
     def close(self, timeout_s: float = 10.0) -> None:
         for w in self._workers:
@@ -626,6 +677,9 @@ class LocalGroup:
         self._workers: List[_LocalWorker] = [
             self._spawn(r) for r in range(self.n_workers)
         ]
+
+    def ranks(self) -> List[int]:
+        return list(range(len(self._workers)))
 
     def _spawn(self, rank: int) -> _LocalWorker:
         w = _LocalWorker(rank, WorkerRuntime(rank, algo=self.algo,
@@ -716,13 +770,34 @@ class LocalGroup:
                            f"fabric worker {rank} killed")
 
     def restart(self, rank: int,
-                fault_spec: Optional[str] = None) -> None:
+                fault_spec: Optional[str] = None,
+                inherit_faults: bool = False) -> None:
+        # inherit_faults is a no-op here by design: one process, one
+        # AMBIENT plan — a respawned local runtime sees the same specs,
+        # with flap budgets already decremented by the deaths they
+        # caused (the cross-incarnation charge ProcGroup has to
+        # replicate parent-side)
+        del inherit_faults
         old = self._workers[rank]
         self._fail_pending(old, f"fabric worker {rank} restarted")
         old.q.put(None)                 # let the old thread exit
         if fault_spec:
             faultinject.install(fault_spec)
         self._workers[rank] = self._spawn(rank)
+
+    def add_worker(self, fault_spec: Optional[str] = None) -> int:
+        if fault_spec:
+            faultinject.install(fault_spec)
+        rank = len(self._workers)
+        self._workers.append(self._spawn(rank))
+        self.n_workers = len(self._workers)
+        return rank
+
+    def retire(self, rank: int, timeout_s: float = 10.0) -> None:
+        del timeout_s
+        w = self._workers[rank]
+        self._fail_pending(w, f"fabric worker {rank} retired")
+        w.q.put(None)
 
     def close(self, timeout_s: float = 10.0) -> None:
         for w in self._workers:

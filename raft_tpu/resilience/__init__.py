@@ -34,9 +34,11 @@ from raft_tpu.resilience.errors import (
     ShardDropoutError,
     TransientError,
     backend_alive,
+    backoff_jitter_s,
     classify,
     classify_text,
     run,
+    seed_jitter,
 )
 from raft_tpu.resilience.checkpoint import (
     CheckpointMismatchError,
@@ -48,6 +50,6 @@ __all__ = [
     "DEAD_BACKEND", "FATAL", "INTERRUPTED", "KINDS", "OOM", "TRANSIENT",
     "CheckpointMismatchError", "DeadBackendError", "DeadlineExceededError",
     "ResilienceError", "ShardDropoutError", "StreamCheckpoint",
-    "TransientError", "backend_alive", "classify", "classify_text",
-    "degrade", "faultinject", "run",
+    "TransientError", "backend_alive", "backoff_jitter_s", "classify",
+    "classify_text", "degrade", "faultinject", "run", "seed_jitter",
 ]

@@ -32,6 +32,7 @@ Kinds:
 
 from __future__ import annotations
 
+import os
 import re
 import subprocess
 import threading
@@ -192,6 +193,50 @@ def backend_alive(timeout_s: float = 30.0) -> bool:
 
 _DEFAULT_RETRY: Tuple[str, ...] = (TRANSIENT, DEAD_BACKEND)
 
+# full-jitter backoff (ISSUE 18): N replicas retrying against one
+# recovering worker with bare exponential backoff fire in lockstep —
+# every wave lands together, and a rebalancing fleet amplifies the
+# storm (the re-replication traffic rides the same transport). Each
+# sleep is drawn uniformly from [0, backoff_s * mult**attempt] (the
+# AWS "full jitter" schedule), from a process-local seeded RNG so
+# drills and tests are deterministic: seed via RAFT_TPU_JITTER_SEED or
+# seed_jitter().
+
+_jitter_lock = threading.Lock()
+
+
+def _fresh_jitter_rng(seed: Optional[int] = None):
+    import random
+
+    if seed is None:
+        env = os.environ.get("RAFT_TPU_JITTER_SEED", "").strip()
+        seed = int(env) if env else None
+    return random.Random(seed)
+
+
+_jitter_rng = _fresh_jitter_rng()
+
+
+def seed_jitter(seed: Optional[int]) -> None:
+    """Re-seed the backoff-jitter RNG (tests / deterministic drills);
+    ``None`` restores the env-or-entropy default."""
+    global _jitter_rng
+    with _jitter_lock:
+        _jitter_rng = _fresh_jitter_rng(seed)
+
+
+def backoff_jitter_s(attempt: int, backoff_s: float,
+                     mult: float = 2.0, jitter: bool = True) -> float:
+    """The sleep before retry ``attempt`` (0-based): full jitter over
+    the exponential cap ``backoff_s * mult**attempt``, or the bare cap
+    with ``jitter=False`` (callers that need the worst-case bound for
+    deadline math use the cap; the drawn value is always <= it)."""
+    cap = backoff_s * (mult ** attempt)
+    if not jitter or cap <= 0:
+        return cap
+    with _jitter_lock:
+        return _jitter_rng.uniform(0.0, cap)
+
 
 def run(
     fn: Callable,
@@ -200,6 +245,7 @@ def run(
     retries: int = 3,
     backoff_s: float = 0.5,
     backoff_mult: float = 2.0,
+    jitter: bool = True,
     retry_on: Iterable[str] = _DEFAULT_RETRY,
     probe_timeout_s: float = 30.0,
     on_retry: Optional[Callable[[int, str, BaseException], None]] = None,
@@ -210,7 +256,13 @@ def run(
 
     * Exceptions are :func:`classify`\\ d; only kinds in ``retry_on``
       (default transient + dead_backend) are retried, up to ``retries``
-      times with exponential backoff (``backoff_s * backoff_mult**i``).
+      times with full-jitter exponential backoff: each sleep is drawn
+      uniformly from ``[0, backoff_s * backoff_mult**i]``
+      (:func:`backoff_jitter_s` — seeded via ``RAFT_TPU_JITTER_SEED``
+      or :func:`seed_jitter`; ``jitter=False`` restores the bare
+      exponential schedule). The DEADLINE check uses the un-jittered
+      cap, so whether a final retry is attempted does not depend on
+      the RNG draw.
     * ``deadline_s`` is a wall-clock budget over ALL attempts: when a
       retry (including its backoff sleep) cannot start inside it,
       :class:`DeadlineExceededError` is raised with the last failure as
@@ -241,9 +293,14 @@ def run(
             kind = classify(e)
             if kind not in retry_on or attempt >= retries:
                 raise
-            sleep = backoff_s * (backoff_mult ** attempt)
+            # deadline/probe math uses the un-jittered CAP so the
+            # retry-vs-give-up decision is deterministic; the actual
+            # sleep is the jittered draw (always <= cap)
+            cap = backoff_s * (backoff_mult ** attempt)
+            sleep = backoff_jitter_s(attempt, backoff_s, backoff_mult,
+                                     jitter)
             if deadline_s is not None and \
-                    time.monotonic() - start + sleep >= deadline_s:
+                    time.monotonic() - start + cap >= deadline_s:
                 raise DeadlineExceededError(
                     f"deadline {deadline_s}s exhausted after "
                     f"{attempt + 1} attempt(s); last failure: {kind}"
@@ -258,7 +315,7 @@ def run(
                 if deadline_s is not None:
                     probe_budget = min(
                         probe_budget,
-                        deadline_s - (time.monotonic() - start) - sleep,
+                        deadline_s - (time.monotonic() - start) - cap,
                     )
                 if probe_budget <= 0 or not backend_alive(probe_budget):
                     raise DeadBackendError(

@@ -23,6 +23,23 @@ Grammar (env ``RAFT_TPU_FAULTS``, comma-separated)::
                            failed
     drop@rpc:search        the next "search" RPC's response is dropped —
                            the router sees only a timeout
+    flap@proc:1*3          worker 1 FLAPS: it dies, and after the
+                           control plane respawns it, dies again —
+                           three deaths total, then stays up. The
+                           budget is charged one death per incarnation
+                           PARENT-side (:func:`respawned_spec`), which
+                           is what distinguishes it from ``dead@proc``:
+                           a dead machine stays dead under respawn, a
+                           flapping one eventually holds (ISSUE 18 —
+                           the autoscaler-thrash drill)
+    dead@proc:0#after:20   delayed death: worker 0 survives its first
+                           20 data-plane RPCs, then dies — scripted
+                           late-failure schedules (the chaos-curve
+                           loadgen) without runtime re-injection. The
+                           ``#after:N`` arming delay composes with any
+                           proc kind and with ``*count``
+                           (``flap@proc:1#after:10*2``: dies after
+                           every 10 survived RPCs, twice)
 
 The ``proc``/``rpc`` scopes are consumed by the multi-host serving
 fabric's workers (:mod:`raft_tpu.comms.procgroup` via
@@ -62,16 +79,18 @@ from raft_tpu.resilience import errors
 
 ENV_VAR = "RAFT_TPU_FAULTS"
 
-_KINDS = ("oom", "dead", "transient", "shard", "slow", "drop")
+_KINDS = ("oom", "dead", "transient", "shard", "slow", "drop", "flap")
 _SCOPES = ("chunk", "stage", "rank", "proc", "rpc")
 
 # kind/scope compatibility for the process-level grammar: "slow"
 # stalls a worker process's RPCs or a named stage's checkpoints, "drop"
-# only targets an RPC response, and a process can only die or stall (an
-# OOM inside a worker surfaces as a normal classified exception via
-# dead/oom@stage instead)
-_SCOPE_KINDS = {"proc": ("dead", "slow"), "rpc": ("drop",)}
-_KIND_SCOPES = {"slow": ("proc", "stage"), "drop": ("rpc",)}
+# only targets an RPC response, and a process can only die, stall, or
+# flap (an OOM inside a worker surfaces as a normal classified
+# exception via dead/oom@stage instead); "flap" only makes sense where
+# a control plane can respawn the victim, i.e. at proc scope
+_SCOPE_KINDS = {"proc": ("dead", "slow", "flap"), "rpc": ("drop",)}
+_KIND_SCOPES = {"slow": ("proc", "stage"), "drop": ("rpc",),
+                "flap": ("proc",)}
 
 # how long one fired slow@stage spec stalls its checkpoint (seconds);
 # RAFT_TPU_FAULTS_SLOW_MS overrides for tests that need a tighter or
@@ -119,13 +138,18 @@ _EXC = {
 
 @dataclasses.dataclass
 class FaultSpec:
-    kind: str        # oom | dead | transient | shard
-    scope: str       # chunk | stage | rank
+    kind: str        # oom | dead | transient | shard | slow | drop | flap
+    scope: str       # chunk | stage | rank | proc | rpc
     arg: str         # chunk index / stage name / rank
     remaining: int = 1
+    # arming delay (proc scope): the spec stays quiet for its victim's
+    # first `delay` data-plane RPCs, then fires — the scripted
+    # late-death schedule of the chaos-curve drills
+    delay: int = 0
 
     def render(self) -> str:
-        return f"{self.kind}@{self.scope}:{self.arg}*{self.remaining}"
+        after = f"#after:{self.delay}" if self.delay else ""
+        return f"{self.kind}@{self.scope}:{self.arg}{after}*{self.remaining}"
 
 
 def parse(spec: str) -> List[FaultSpec]:
@@ -156,13 +180,27 @@ def parse(spec: str) -> List[FaultSpec]:
                 f"fault kind {kind!r} needs scope "
                 f"{_KIND_SCOPES[kind]}, got {scope!r}"
             )
+        arg = m.group("arg").strip()
+        delay = 0
+        if scope == "proc" and "#" in arg:
+            # delayed proc spec: R#after:N — arms after N survived
+            # data-plane RPCs
+            arg, _, after = arg.partition("#")
+            if not after.startswith("after:"):
+                raise ValueError(
+                    f"bad proc delay in {part!r}: want "
+                    f"kind@proc:R#after:N")
+            delay = int(after[len("after:"):])
+            if delay < 0:
+                raise ValueError(f"negative delay in {part!r}")
         if scope in ("chunk", "rank", "proc"):
-            int(m.group("arg"))          # validate now, fail loudly
-        if scope == "stage" and "#" in m.group("arg"):
-            int(m.group("arg").rpartition("#")[2])   # stage#chunk form
+            int(arg)                     # validate now, fail loudly
+        if scope == "stage" and "#" in arg:
+            int(arg.rpartition("#")[2])   # stage#chunk form
         out.append(FaultSpec(
-            kind, scope, m.group("arg").strip(),
+            kind, scope, arg,
             int(m.group("count") or 1),
+            delay,
         ))
     return out
 
@@ -309,12 +347,16 @@ def proc_action(rank: int) -> Optional[str]:
     """Consume the first live process-scoped spec matching worker
     ``rank`` and name the action it demands:
 
-    * ``"die"``  — a ``dead@proc:R`` spec: the worker must hard-exit
-      with no response (the SIGKILL / machine-loss mode);
+    * ``"die"``  — a ``dead@proc:R`` or ``flap@proc:R*K`` spec: the
+      worker must hard-exit with no response (the SIGKILL /
+      machine-loss mode; flap's death budget is additionally charged
+      parent-side per incarnation — :func:`respawned_spec`);
     * ``"slow"`` — a ``slow@proc:R*K`` spec: the worker must stall this
       response past the router's hedge threshold (the late-answer mode).
 
-    Returns ``None`` when nothing matches. Called by the fabric workers
+    Returns ``None`` when nothing matches. A spec with an ``#after:N``
+    arming delay stays quiet — decrementing its delay — for its
+    victim's first N matching calls. Called by the fabric workers
     (:mod:`raft_tpu.comms.procgroup`) at their data-plane fault points —
     the place a real machine failure would surface."""
     fired: Optional[FaultSpec] = None
@@ -324,12 +366,18 @@ def proc_action(rank: int) -> Optional[str]:
                 continue
             if int(s.arg) != int(rank):
                 continue
+            if s.delay > 0:
+                # not armed yet: this RPC survives, the countdown
+                # advances; keep scanning — an armed later spec may
+                # still claim the call
+                s.delay -= 1
+                continue
             s.remaining -= 1
             fired = s
             break
     if fired is None:
         return None
-    action = "die" if fired.kind == "dead" else "slow"
+    action = "die" if fired.kind in ("dead", "flap") else "slow"
     from raft_tpu import obs
 
     obs.counter("faults_injected", kind=fired.kind,
@@ -338,6 +386,47 @@ def proc_action(rank: int) -> Optional[str]:
               spec=f"{fired.kind}@{fired.scope}:{fired.arg}",
               rank=int(rank), action=action)
     return action
+
+
+def respawned_spec(spec: Optional[str], rank: int,
+                   deaths: int) -> Optional[str]:
+    """The fault plan a RESPAWNED incarnation of worker ``rank`` should
+    install, given the group's spawn-time plan and how many of this
+    rank's incarnations have died so far (``deaths``).
+
+    Each child process holds its own copy of the plan, so a budget that
+    must span incarnations has to be charged where the respawn decision
+    is made — the parent. The rewrite encodes the kind semantics:
+
+    * ``flap@proc:rank*K`` — charged one death per prior incarnation;
+      dropped once the budget is spent (the worker finally holds). Its
+      ``#after:N`` delay is kept, so a flapping worker serves N RPCs
+      between deaths.
+    * ``dead@proc:rank`` — inherited verbatim but with any ``#after:N``
+      delay DROPPED: the delay models when the first death lands; once
+      the machine is dead it stays dead, and every respawned
+      incarnation dies at its first data-plane RPC. This permanence is
+      what distinguishes ``dead`` from ``flap`` under a self-healing
+      control plane (its restart budget, not the fault plan, ends the
+      futile respawn loop).
+    * everything else (other ranks' specs, slow/stage/chunk specs) is
+      inherited verbatim.
+
+    Returns ``None`` when nothing survives the rewrite."""
+    if not spec:
+        return None
+    out: List[str] = []
+    for s in parse(spec):
+        if s.scope == "proc" and int(s.arg) == int(rank):
+            if s.kind == "flap":
+                left = s.remaining - int(deaths)
+                if left <= 0:
+                    continue
+                s.remaining = left
+            elif s.kind == "dead":
+                s.delay = 0
+        out.append(s.render())
+    return ",".join(out) if out else None
 
 
 def rpc_dropped(method: str) -> bool:

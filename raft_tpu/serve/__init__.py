@@ -31,10 +31,17 @@ lives in, and the piece TPU-KNN's peak-FLOP/s numbers quietly assume
   (:mod:`raft_tpu.comms.procgroup`), a router fans each micro-batch to
   shard owners with health-tracked circuit breaking, hedged retries,
   per-row coverage on degraded answers, and a two-phase cross-host
-  hot-swap barrier over the registry (docs/serving.md §10).
+  hot-swap barrier over the registry (docs/serving.md §10);
+* **self-healing control plane** (:mod:`raft_tpu.serve.controller`,
+  ISSUE 18) — graft-helm closes the cluster loops the fabric leaves to
+  an operator: p2c replica load-balancing feeds a controller that
+  rebalances shards off workers whose circuits stay open past the
+  tuning budget and autoscales the worker set on saturated-stage
+  signals with cooldown/hysteresis (docs/serving.md §10).
 """
 
 from raft_tpu.serve.adaptive import AdaptivePolicy, probe_ladder
+from raft_tpu.serve.controller import HelmController, HelmParams
 from raft_tpu.serve.batcher import (
     Batch,
     MicroBatcher,
@@ -105,8 +112,9 @@ def total_trace_count() -> int:
 
 __all__ = [
     "AdaptivePolicy", "Batch", "Fabric", "FabricParams",
-    "FabricSwapError", "Generation", "MicroBatcher", "MutableState",
-    "Overloaded", "Registry", "Request", "ServeParams", "Server",
-    "TRACKED_JITS", "WorkerHealth", "bucket_ladder", "choose_bucket",
-    "probe_ladder", "total_trace_count", "trace_cache_sizes",
+    "FabricSwapError", "Generation", "HelmController", "HelmParams",
+    "MicroBatcher", "MutableState", "Overloaded", "Registry",
+    "Request", "ServeParams", "Server", "TRACKED_JITS", "WorkerHealth",
+    "bucket_ladder", "choose_bucket", "probe_ladder",
+    "total_trace_count", "trace_cache_sizes",
 ]

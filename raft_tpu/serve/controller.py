@@ -1,0 +1,399 @@
+"""graft-helm: the self-healing fabric control plane (ISSUE 18;
+docs/serving.md §10).
+
+The fabric (:mod:`raft_tpu.serve.fabric`) gives every failure a local
+answer — a breaker opens, a hedge covers, a probe readmits — but leaves
+the CLUSTER decisions to a human: when is a worker dead enough that its
+shards should move, when does load justify another worker, when can one
+drain out. :class:`HelmController` closes those three loops:
+
+1. **repair** — a worker whose circuit has been open past the
+   ``fabric_rebalance_budget_ms`` tuning budget is evicted: the current
+   generation is republished over the survivors through the fabric's
+   two-phase barrier, restoring the replication factor; a replacement
+   is admitted when the survivor set is too small to hold it. Before
+   spending the budget the controller respawns a dead process (up to
+   ``restart_budget`` times, fault plan inherited so chaos drills
+   model machines, not processes).
+2. **autoscale** — the saturated-STAGE signal decides growth: mean
+   in-flight RPCs per worker (the queue-depth analog the p2c balancer
+   already tracks) crossing ``scale_up_inflight`` for
+   ``sustain_ticks`` consecutive ticks admits a worker — but only when
+   the waterfall p99s say the bottleneck is worker-side (``rpc`` /
+   ``worker_scan`` stages); a router-bound fleet (``merge`` dominating)
+   holds with a reason instead of wasting a machine. The mirror-image
+   low-water signal drains the highest-rank worker out.
+3. **hysteresis** — every membership action arms a cooldown; sustain
+   counters reset on action or signal loss; the breaker's open-episode
+   clock (:meth:`WorkerHealth` ``open_since``) survives failed
+   half-open probes but clears on readmission — so a FLAPPING worker
+   (recovers, dies, recovers) never accumulates enough open time to
+   get evicted, while a solidly dead one always does. The thrash
+   negative test (tests/test_controller.py) pins this under
+   ``flap@proc``.
+
+Single-actor contract: membership mutation (admit / retire / respawn /
+rebalance) goes through ONE controller per fabric — the same rule
+:class:`~raft_tpu.comms.procgroup.ProcGroup` documents for its rank
+table. The controller state lock ("helm.state") sits ABOVE the fabric's
+locks in the hierarchy; fabric code never calls back into the
+controller.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from raft_tpu import obs, tuning
+from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import trace as obs_trace
+from raft_tpu.resilience import errors as _rerrors
+from raft_tpu.serve.fabric import CLOSED, OPEN, Fabric
+
+
+@dataclasses.dataclass
+class HelmParams:
+    """Control-plane knobs (docs/serving.md §10)."""
+
+    # tick cadence; None -> tuning budget helm_interval_ms (200)
+    interval_s: Optional[float] = None
+    # open-episode ceiling before eviction; None -> tuning budget
+    # fabric_rebalance_budget_ms (1500)
+    rebalance_budget_ms: Optional[float] = None
+    restart_budget: int = 2       # respawns per rank before eviction
+    respawn: bool = True          # try respawn before rebalancing away
+    inherit_faults: bool = True   # respawns keep the rank's fault plan
+    min_workers: int = 2
+    max_workers: int = 8
+    # autoscale watermarks on mean in-flight RPCs per active worker
+    scale_up_inflight: float = 3.0
+    scale_down_inflight: float = 0.25
+    sustain_ticks: int = 3        # consecutive ticks before acting
+    # post-action quiet period; None -> tuning budget helm_cooldown_ms
+    # (2000)
+    cooldown_s: Optional[float] = None
+    # waterfalls sampled per tick for saturated-stage attribution
+    trace_window: int = 64
+    retire_timeout_s: float = 30.0
+
+
+class HelmController:
+    """The fabric's self-healing control loop::
+
+        fab = serve.Fabric(dataset, params=serve.FabricParams())
+        helm = serve.HelmController(fab, params=serve.HelmParams())
+        helm.start()          # background loop
+        ...
+        helm.stop()
+
+    or tick it deterministically (the tests do)::
+
+        report = helm.step()  # {"actions": [...], "held": ..., ...}
+    """
+
+    def __init__(self, fabric: Fabric, *,
+                 params: Optional[HelmParams] = None):
+        self.fabric = fabric
+        self.params = params or HelmParams()
+        p = self.params
+        if p.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        if p.max_workers < p.min_workers:
+            raise ValueError("max_workers must be >= min_workers")
+        interval = p.interval_s
+        if interval is None:
+            interval = tuning.budget("helm_interval_ms", 200) / 1e3
+        self._interval_s = float(interval)
+        budget = p.rebalance_budget_ms
+        if budget is None:
+            budget = tuning.budget("fabric_rebalance_budget_ms", 1500)
+        self._rebalance_budget_ms = float(budget)
+        cooldown = p.cooldown_s
+        if cooldown is None:
+            cooldown = tuning.budget("helm_cooldown_ms", 2000) / 1e3
+        self._cooldown_s = float(cooldown)
+        # graft-race sanitizer node "helm.state" — sits above the
+        # fabric's locks (step() holds it across fabric actions; the
+        # fabric never calls back up)
+        self._lock = lockwatch.make_lock("helm.state")
+        self._restarts: Dict[int, int] = {}
+        self._evicted: set = set()
+        self._hot_ticks = 0
+        self._cold_ticks = 0
+        self._cooldown_until = 0.0
+        self._ticks = 0
+        # bounded membership-action journal — the loadgen's chaos
+        # timeline reads it through stats()["actions"]
+        self._actions_log: collections.deque = collections.deque(
+            maxlen=512)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- the control loop ---------------------------------------------------
+
+    def step(self) -> dict:
+        """One deterministic control tick: repair, then autoscale.
+        Returns a report of what happened —
+        ``{"actions": [(kind, rank), ...], "held": reason|None,
+        "mean_inflight": float, "workers": int}`` — consumed by the
+        tests and the loadgen's chaos timeline."""
+        with obs.span("helm.tick", index=self.fabric.name):
+            with self._lock:
+                self._ticks += 1
+                obs.counter("helm.ticks_total")
+                actions: List[tuple] = []
+                self._repair_locked(actions)
+                held = self._autoscale_locked(actions)
+                active = self.fabric.active_ranks()
+                mean_inflight = self._mean_inflight(active)
+                now = time.monotonic()
+                for kind, rank in actions:
+                    self._actions_log.append(
+                        {"t": now, "action": kind, "worker": rank})
+            obs.gauge("helm.workers", len(active))
+            obs.gauge("helm.mean_inflight", round(mean_inflight, 4))
+            for kind, rank in actions:
+                obs.counter("helm.actions_total", action=kind)
+                obs.event("helm_action", action=kind, worker=rank)
+            if held:
+                obs.counter("helm.held_total", reason=held)
+            return {"actions": actions, "held": held,
+                    "mean_inflight": mean_inflight,
+                    "workers": len(active)}
+
+    def _repair_locked(self, actions: List[tuple]) -> None:
+        """Respawn dead workers while the restart budget lasts; evict
+        any rank whose open episode outlived the rebalance budget."""
+        fab = self.fabric
+        p = self.params
+        episodes = fab.open_episodes()
+        for rank, episode_s in sorted(episodes.items()):
+            hl = fab.health[rank]
+            if hl.state != OPEN and episode_s <= 0.0:
+                continue
+            dead = not fab.group.alive(rank)
+            spent = self._restarts.get(rank, 0)
+            if (dead and p.respawn and spent < p.restart_budget):
+                try:
+                    fab.restart_worker(
+                        rank, inherit_faults=p.inherit_faults)
+                except BaseException as e:  # noqa: BLE001 — classified; a failed respawn burns budget toward eviction
+                    _rerrors.classify(e)
+                self._restarts[rank] = spent + 1
+                actions.append(("respawn", rank))
+                continue
+            if episode_s * 1e3 > self._rebalance_budget_ms:
+                self._evict_locked(rank, actions)
+
+    def _evict_locked(self, rank: int, actions: List[tuple]) -> None:
+        fab = self.fabric
+        p = self.params
+        if rank in self._evicted:
+            return
+        try:
+            fab.retire_worker(rank, timeout_s=p.retire_timeout_s,
+                              reason="evict")
+        except BaseException as e:  # noqa: BLE001 — classified; an unretirable rank stays excluded next tick
+            _rerrors.classify(e)
+            return
+        self._evicted.add(rank)
+        actions.append(("evict", rank))
+        self._arm_cooldown_locked()
+        # the survivor set may be too small to hold the replication
+        # factor — admit a replacement (the "respawned replacement"
+        # arm of the rebalancing loop)
+        floor = max(p.min_workers, fab.params.replication)
+        if len(fab.active_ranks()) < floor:
+            try:
+                new_rank = fab.add_worker()
+            except BaseException as e:  # noqa: BLE001 — classified; next tick retries admission
+                _rerrors.classify(e)
+                return
+            actions.append(("admit", new_rank))
+
+    def _autoscale_locked(self, actions: List[tuple]) -> Optional[str]:
+        """Grow/shrink on the mean-inflight watermark, gated by
+        saturated-stage attribution, sustain, and cooldown. Returns the
+        hold reason when a crossed watermark was NOT acted on."""
+        fab = self.fabric
+        p = self.params
+        active = fab.active_ranks()
+        mean_inflight = self._mean_inflight(active)
+        hot = mean_inflight >= p.scale_up_inflight
+        cold = mean_inflight <= p.scale_down_inflight
+        self._hot_ticks = self._hot_ticks + 1 if hot else 0
+        self._cold_ticks = self._cold_ticks + 1 if cold else 0
+        if actions:
+            # repair already mutated membership this tick — let the
+            # new topology settle before judging load on it
+            self._hot_ticks = self._cold_ticks = 0
+            return None
+        if any(fab.health[r].state != CLOSED for r in active):
+            # degraded fleet: a down worker reads as low load (its
+            # RPCs are not in flight) — scaling on that signal would
+            # drain capacity exactly when the repair loop needs it.
+            # Health first, capacity second.
+            self._hot_ticks = self._cold_ticks = 0
+            return "degraded" if (hot or cold) else None
+        now = time.monotonic()
+        if hot:
+            if self._hot_ticks < p.sustain_ticks:
+                return None
+            if now < self._cooldown_until:
+                return "cooldown"
+            if len(active) >= p.max_workers:
+                return "max_workers"
+            if not self._worker_bound():
+                return "router_bound"
+            try:
+                rank = fab.add_worker()
+            except BaseException as e:  # noqa: BLE001 — classified; admission retried next sustained window
+                _rerrors.classify(e)
+                return "admit_failed"
+            actions.append(("scale_up", rank))
+            self._hot_ticks = 0
+            self._arm_cooldown_locked()
+            return None
+        if cold:
+            if self._cold_ticks < p.sustain_ticks:
+                return None
+            if now < self._cooldown_until:
+                return "cooldown"
+            floor = max(p.min_workers, fab.params.replication)
+            if len(active) <= floor:
+                return "min_workers"
+            # drain the newest admission first: highest live rank —
+            # deterministic, and shard movement is smallest at the
+            # round-robin tail
+            candidates = [r for r in active if fab.group.alive(r)]
+            if not candidates:
+                return "no_candidate"
+            rank = max(candidates)
+            try:
+                fab.retire_worker(rank, timeout_s=p.retire_timeout_s,
+                                  reason="scale_down")
+            except BaseException as e:  # noqa: BLE001 — classified; drain retried next sustained window
+                _rerrors.classify(e)
+                return "retire_failed"
+            actions.append(("scale_down", rank))
+            self._cold_ticks = 0
+            self._arm_cooldown_locked()
+            return None
+        return None
+
+    def _arm_cooldown_locked(self) -> None:
+        self._cooldown_until = time.monotonic() + self._cooldown_s
+
+    def _mean_inflight(self, active: List[int]) -> float:
+        snap = self.fabric.load_snapshot()
+        inflight = snap["inflight"]
+        if not active:
+            return 0.0
+        return sum(inflight.get(r, 0) for r in active) / len(active)
+
+    def _worker_bound(self) -> bool:
+        """Saturated-stage attribution over the recent waterfalls:
+        scaling workers only helps when worker-side stages (``rpc``,
+        which brackets queueing + ``worker_scan``) dominate the
+        router-side ``merge``. With too few samples, default to
+        worker-bound — the sustain/cooldown gates already damp a wrong
+        early guess."""
+        wfs = obs_trace.trace_report(limit=self.params.trace_window)
+        if not wfs:
+            return True
+        per = obs_trace.stage_stats(wfs)
+        rpc = per.get("rpc", {})
+        merge = per.get("merge", {})
+        rpc_p99 = rpc.get("p99_ms")
+        merge_p99 = merge.get("p99_ms")
+        if rpc_p99 is None or merge_p99 is None:
+            return True
+        return merge_p99 <= rpc_p99
+
+    # -- explicit operator actions (spanned serve entry points) -------------
+
+    def scale_up(self) -> int:
+        """Admit one worker now (operator override; same placement path
+        as the autoscaler). Returns the new rank."""
+        with obs.span("helm.scale_up", index=self.fabric.name):
+            with self._lock:
+                rank = self.fabric.add_worker()
+                self._arm_cooldown_locked()
+                return rank
+
+    def scale_down(self, rank: Optional[int] = None) -> int:
+        """Drain one worker out now (highest live rank when
+        unspecified). Returns the retired rank."""
+        with obs.span("helm.scale_down", index=self.fabric.name):
+            with self._lock:
+                fab = self.fabric
+                if rank is None:
+                    candidates = [r for r in fab.active_ranks()
+                                  if fab.group.alive(r)]
+                    if not candidates:
+                        raise RuntimeError("no live worker to drain")
+                    rank = max(candidates)
+                fab.retire_worker(
+                    rank, timeout_s=self.params.retire_timeout_s,
+                    reason="scale_down")
+                self._arm_cooldown_locked()
+                return int(rank)
+
+    def rebalance(self, exclude=(), *, reason: str = "manual") -> int:
+        """Republish the current generation over the membership minus
+        ``exclude`` (operator override of the repair loop)."""
+        with obs.span("helm.rebalance", index=self.fabric.name):
+            with self._lock:
+                return self.fabric.rebalance(exclude, reason=reason)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Run the control loop on a background daemon thread."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"raft-tpu-helm-{self.fabric.name}")
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.step()
+            except BaseException as e:  # noqa: BLE001 — classified: the controller must outlive any single bad tick
+                _rerrors.classify(e)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "ticks": self._ticks,
+                "restarts": dict(self._restarts),
+                "evicted": sorted(self._evicted),
+                "actions": list(self._actions_log),
+                "hot_ticks": self._hot_ticks,
+                "cold_ticks": self._cold_ticks,
+                "cooldown_remaining_s": max(
+                    self._cooldown_until - time.monotonic(), 0.0),
+                "rebalance_budget_ms": self._rebalance_budget_ms,
+            }
+
+    def __enter__(self) -> "HelmController":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 import threading
 import time
 from concurrent.futures import (
@@ -103,8 +104,18 @@ class FabricSwapError(RuntimeError):
 class FabricParams:
     """Fabric knobs (docs/serving.md §10)."""
 
-    n_workers: int = 3            # worker processes == shards
+    n_workers: int = 3            # initial worker processes
+    # shard count is FIXED for the fabric's lifetime (None -> initial
+    # n_workers); the worker set is not — the control plane admits and
+    # retires workers (ISSUE 18), and each published generation places
+    # the same shards over the membership of its moment
+    n_shards: Optional[int] = None
     replication: int = 2          # owners per shard (hedge/failover pool)
+    # read routing policy: "p2c" spreads each shard read over ALL live
+    # owners by power-of-two-choices on an inflight x EWMA-latency
+    # score (replicas contribute THROUGHPUT); "primary" is the
+    # pre-ISSUE-18 primary-first order (the A/B baseline)
+    balance: str = "p2c"
     worker_algo: str = "brute_force"   # per-shard index ("ivf_flat" too)
     rpc_deadline_s: float = 5.0   # per-shard RPC budget (all attempts)
     rpc_retries: int = 2          # classified retries per shard
@@ -115,6 +126,9 @@ class FabricParams:
     coverage_floor: float = 0.0   # min per-row coverage before raising
     fail_threshold: int = 3       # consecutive failures -> circuit opens
     halfopen_after_s: float = 0.25
+    # consecutive successes before a readmitted worker's failure budget
+    # refills — until then ONE failure re-opens it (flap hysteresis)
+    probation_successes: int = 3
     probe_interval_s: Optional[float] = None  # None -> tuning budget
     probe_timeout_s: float = 5.0
     swap_deadline_s: float = 120.0
@@ -136,18 +150,32 @@ class WorkerHealth:
     passed → CLOSED again on a successful probe, or back to OPEN on a
     failed one. Transitions are gauged/counted through graft-scope
     (``fabric.worker_health{worker}``,
-    ``fabric.circuit_transitions{worker,to}``)."""
+    ``fabric.circuit_transitions{worker,to}``).
+
+    Readmission is PROBATIONAL (ISSUE 18 flap hysteresis): a half-open
+    probe success closes the circuit but does NOT refund the failure
+    budget — a worker that flaps straight back down re-opens on its
+    first post-probe failure, not after ``fail_threshold`` fresh ones.
+    The budget refills only after ``probation_successes`` consecutive
+    successes."""
 
     def __init__(self, rank: int, fail_threshold: int,
-                 halfopen_after_s: float):
+                 halfopen_after_s: float,
+                 probation_successes: int = 3):
         self.rank = int(rank)
         self.fail_threshold = int(fail_threshold)
         self.halfopen_after_s = float(halfopen_after_s)
+        self.probation_successes = int(probation_successes)
         # graft-race sanitizer node "fabric.health"
         self.lock = lockwatch.make_lock("fabric.health")
         self.state = CLOSED
         self.failures = 0
-        self.opened_at = 0.0
+        self.successes = 0      # consecutive — the probation counter
+        self.opened_at = 0.0    # last trip (half-open scheduling)
+        # first trip of the CURRENT open episode: survives failed
+        # half-open probes, ends on readmission — what the control
+        # plane's rebalance budget is measured against
+        self.open_since = 0.0
         obs.gauge("fabric.worker_health", 1.0, worker=self.rank)
 
     def _transition_locked(self, to: str) -> None:
@@ -161,17 +189,27 @@ class WorkerHealth:
 
     def record_success(self) -> None:
         with self.lock:
-            self.failures = 0
+            self.successes += 1
             if self.state != CLOSED:
+                # probational readmission: the failure budget stays
+                # spent, so the next failure re-opens immediately
+                self.failures = max(self.failures, self.fail_threshold)
+                self.successes = 1
+                self.open_since = 0.0
                 self._transition_locked(CLOSED)
+            if self.successes >= self.probation_successes:
+                self.failures = 0
 
     def record_failure(self, kind: str) -> None:
         with self.lock:
+            self.successes = 0
             self.failures += 1
             trip = (self.state == HALF_OPEN
                     or kind == _rerrors.DEAD_BACKEND
                     or self.failures >= self.fail_threshold)
             if trip:
+                if self.state == CLOSED:
+                    self.open_since = time.monotonic()
                 if self.state != OPEN:
                     self._transition_locked(OPEN)
                 self.opened_at = time.monotonic()
@@ -193,11 +231,14 @@ class WorkerHealth:
     def force_open(self) -> None:
         """Used by restart: a respawned worker is not routable until a
         half-open probe admits it (``opened_at`` reset to the epoch so
-        the probe is due immediately)."""
+        the probe is due immediately). The open EPISODE restarts — a
+        fresh incarnation gets a fresh rebalance budget; the
+        controller's restart budget bounds the total attempts."""
         with self.lock:
             if self.state != OPEN:
                 self._transition_locked(OPEN)
             self.opened_at = 0.0
+            self.open_since = time.monotonic()
 
 
 class _ClusterGen:
@@ -287,23 +328,45 @@ class Fabric:
                                        dtype=np.float32)
         if dataset.ndim != 2:
             raise ValueError("dataset must be [rows, dim]")
-        if dataset.shape[0] < p.n_workers:
+        if p.balance not in ("p2c", "primary"):
             raise ValueError(
-                f"dataset rows {dataset.shape[0]} < n_workers "
-                f"{p.n_workers}: every worker needs a non-empty shard")
+                f"balance must be 'p2c' or 'primary', got {p.balance!r}")
+        self.n_shards = int(p.n_shards or p.n_workers)
+        if self.n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {self.n_shards}")
+        if dataset.shape[0] < self.n_shards:
+            raise ValueError(
+                f"dataset rows {dataset.shape[0]} < n_shards "
+                f"{self.n_shards}: every shard needs a non-empty slice")
         self.name = name
         self.dim = int(dataset.shape[1])
         self.registry = Registry()
         self.health = [
-            WorkerHealth(r, p.fail_threshold, p.halfopen_after_s)
+            WorkerHealth(r, p.fail_threshold, p.halfopen_after_s,
+                         p.probation_successes)
             for r in range(p.n_workers)
         ]
         self._counters: collections.Counter = collections.Counter()
-        # graft-race sanitizer nodes "fabric.stats" / "fabric.swap"
+        # graft-race sanitizer nodes "fabric.stats" / "fabric.swap" /
+        # "fabric.load"
         self._stats_lock = lockwatch.make_lock("fabric.stats")
         self._lat_ms: collections.deque = collections.deque(maxlen=256)
+        self._cov_ewma: Optional[float] = None
         self._gen_counter = 0
         self._swap_lock = lockwatch.make_lock("fabric.swap")
+        # replica read load-balancing state (ISSUE 18): per-worker
+        # outstanding RPC count + EWMA latency, read by the p2c router
+        # and the helm controller. LEAF lock — metrics are emitted
+        # outside it.
+        self._load_lock = lockwatch.make_lock("fabric.load")
+        self._inflight: Dict[int, int] = {}
+        self._ewma_ms: Dict[int, float] = {}
+        # seeded: p2c sampling is deterministic per fabric instance
+        self._rng = random.Random(0x9E3779B9)
+        # ranks retired by the control plane: never routed, probed, or
+        # placed in a generation again (ranks are append-only, so the
+        # set only grows)
+        self._retired: set = set()
         self._closed = False
         self._dataset = dataset
         if isinstance(group, str):
@@ -409,8 +472,14 @@ class Fabric:
             coverage = (validity.mean(axis=0, dtype=np.float32) if m
                         else np.ones((0,), np.float32))
             cov_min = float(coverage.min()) if m else 1.0
-            obs.gauge("fabric.coverage",
-                      float(coverage.mean()) if m else 1.0)
+            cov_mean = float(coverage.mean()) if m else 1.0
+            obs.gauge("fabric.coverage", cov_mean)
+            with self._stats_lock:
+                # smoothed coverage for the helm controller's rebalance
+                # signal — one bad batch should not trigger a publish
+                self._cov_ewma = (cov_mean if self._cov_ewma is None
+                                  else 0.5 * self._cov_ewma
+                                  + 0.5 * cov_mean)
             uncovered = sorted(s for s, r in results.items() if r is None)
             if uncovered:
                 self._count("dropouts", len(uncovered))
@@ -448,18 +517,76 @@ class Fabric:
 
     # -- per-shard routing --------------------------------------------------
 
+    def member_ranks(self) -> List[int]:
+        """Every rank the fabric has ever admitted (append-only; a
+        retired rank keeps its number). Falls back to the initial
+        ``n_workers`` for caller-supplied group objects without a
+        ``ranks()`` surface."""
+        ranks = getattr(self.group, "ranks", None)
+        if ranks is None:
+            return list(range(self.params.n_workers))
+        return list(ranks())
+
     def _route_order(self, owners: Sequence[int],
                      exclude: Sequence[int]) -> List[int]:
         """Owner preference for one attempt: healthy (closed) owners
-        first in declared order, then half-open ones as a last resort
-        (their probe-in-flight state tolerates one trial request);
-        open-circuit owners and already-tried primaries are out."""
+        first, then half-open ones as a last resort (their
+        probe-in-flight state tolerates one trial request);
+        open-circuit owners, retired ranks, and already-tried primaries
+        are out.
+
+        Under ``balance="p2c"`` the closed set is reordered by
+        power-of-two-choices: sample two owners, lead with the one
+        whose ``(inflight + 1) x EWMA-latency`` score is lower — so
+        replicas contribute THROUGHPUT instead of idling as failover
+        spares, and a slow-but-alive owner sheds load without tripping
+        its breaker. ``balance="primary"`` keeps the declared order
+        (the pre-ISSUE-18 behaviour, and the A/B baseline)."""
         closed = [r for r in owners
-                  if r not in exclude and self.health[r].routable()]
+                  if r not in exclude and r not in self._retired
+                  and self.health[r].routable()]
         half = [r for r in owners
-                if r not in exclude
+                if r not in exclude and r not in self._retired
                 and self.health[r].state == HALF_OPEN]
+        if self.params.balance == "p2c" and len(closed) >= 2:
+            closed = self._balanced_order(closed)
         return closed + half
+
+    def _balanced_order(self, closed: List[int]) -> List[int]:
+        with self._load_lock:
+            a, b = self._rng.sample(closed, 2)
+            lead = (a if self._score_locked(a) <= self._score_locked(b)
+                    else b)
+        return [lead] + [r for r in closed if r != lead]
+
+    def _score_locked(self, rank: int) -> float:
+        # an unmeasured worker scores 0 — strictly optimistic, so a
+        # fresh replica wins its first comparisons and gets measured
+        # instead of starving behind sub-millisecond incumbents
+        ewma = self._ewma_ms.get(rank)
+        return ((self._inflight.get(rank, 0) + 1)
+                * (ewma if ewma is not None else 0.0))
+
+    def _load_begin(self, rank: int) -> None:
+        with self._load_lock:
+            n = self._inflight.get(rank, 0) + 1
+            self._inflight[rank] = n
+        # gauge OUTSIDE the load lock: obs sinks may take their own
+        # locks and fabric.load must stay a leaf
+        obs.gauge("fabric.worker_inflight", n, worker=rank)
+
+    def _load_end(self, rank: int) -> None:
+        with self._load_lock:
+            n = max(self._inflight.get(rank, 0) - 1, 0)
+            self._inflight[rank] = n
+        obs.gauge("fabric.worker_inflight", n, worker=rank)
+
+    def load_snapshot(self) -> dict:
+        """Per-worker routing-load view (the helm controller's primary
+        utilization signal): outstanding RPC count and EWMA latency."""
+        with self._load_lock:
+            return {"inflight": dict(self._inflight),
+                    "ewma_ms": dict(self._ewma_ms)}
 
     def _search_shard(self, h: _ClusterGen, shard: int, q: np.ndarray,
                       k: int,
@@ -490,8 +617,13 @@ class Fabric:
             attempt += 1
             if attempt > p.rpc_retries:
                 return None
-            backoff = p.retry_backoff_s * (2 ** (attempt - 1))
-            if time.monotonic() + backoff >= deadline:
+            # full-jitter sleep under the UNJITTERED cap for deadline
+            # math — the conservative bound keeps the retry budget
+            # honest while the jitter decorrelates retry stampedes
+            cap = p.retry_backoff_s * (2 ** (attempt - 1))
+            backoff = _rerrors.backoff_jitter_s(attempt - 1,
+                                                p.retry_backoff_s)
+            if time.monotonic() + cap >= deadline:
                 return None
             self._count("retries")
             obs.counter("fabric.rpc_retries_total")
@@ -511,6 +643,7 @@ class Fabric:
         attempt — winner, hedge loser, failure, timeout — lands in the
         query's waterfall as an ``rpc`` stage with its status."""
         p = self.params
+        self._load_begin(primary)
         outstanding: List[Tuple[int, Future]] = [
             # graft-lint: allow-untraced-rpc payload pre-threaded by _search_shard via obs.trace.traced_payload
             (primary, self.group.call(primary, "search", payload))
@@ -540,6 +673,7 @@ class Fabric:
                     # that never comes (dropped RPC, hung worker) does
                     # not pin its Future + query payload forever
                     self.group.forget(rank, f)
+                    self._load_end(rank)
                 return None
             wait_s = remaining
             if not hedged and alternates:
@@ -551,6 +685,7 @@ class Fabric:
                 if not hedged and alternates:
                     alt = alternates[0]
                     sent[alt] = time.perf_counter()
+                    self._load_begin(alt)
                     outstanding.append(
                         # graft-lint: allow-untraced-rpc payload pre-threaded by _search_shard via obs.trace.traced_payload
                         (alt, self.group.call(alt, "search", payload)))
@@ -564,6 +699,7 @@ class Fabric:
                 if f not in done:
                     continue
                 outstanding.remove((rank, f))
+                self._load_end(rank)
                 rpc_ms = (time.perf_counter() - sent[rank]) * 1e3
                 try:
                     res = f.result()
@@ -627,6 +763,7 @@ class Fabric:
                     # reply cleans itself up on arrival, but a reply
                     # that never comes would leak the Future
                     self.group.forget(loser, lf)
+                    self._load_end(loser)
                     obs_trace.stage(
                         ctx, "rpc",
                         ms=(time.perf_counter() - sent[loser]) * 1e3,
@@ -668,6 +805,12 @@ class Fabric:
                     buckets=_RPC_LAT_BUCKETS, worker=rank)
         with self._stats_lock:
             self._lat_ms.append(ms)
+        with self._load_lock:
+            # success-only EWMA: failures route through the breaker,
+            # not the balancer score
+            prev = self._ewma_ms.get(rank)
+            self._ewma_ms[rank] = (ms if prev is None
+                                   else 0.8 * prev + 0.2 * ms)
 
     # -- two-phase cluster hot-swap -----------------------------------------
 
@@ -687,35 +830,72 @@ class Fabric:
                 raise ValueError(
                     f"dataset must be [rows, {self.dim}], "
                     f"got {dataset.shape}")
-            if dataset.shape[0] < self.params.n_workers:
+            if dataset.shape[0] < self.n_shards:
                 # same contract as __init__ — and a ValueError, not a
                 # transient FabricSwapError a resilience-aware client
                 # would retry forever
                 raise ValueError(
-                    f"dataset rows {dataset.shape[0]} < n_workers "
-                    f"{self.params.n_workers}: every worker needs a "
-                    "non-empty shard")
+                    f"dataset rows {dataset.shape[0]} < n_shards "
+                    f"{self.n_shards}: every shard needs a non-empty "
+                    "slice")
             if self._closed:
                 raise RuntimeError("fabric is closed")
             return self._publish_generation(dataset)
 
-    def _publish_generation(self, dataset: np.ndarray,
-                            initial: bool = False) -> int:
+    def rebalance(self, exclude: Sequence[int] = (), *,
+                  reason: str = "manual") -> int:
+        """Re-replicate the CURRENT dataset over the current
+        membership minus ``exclude`` — the shard-rebalancing move
+        (ISSUE 18): when a worker dies for good, excluding it places
+        its shards' replicas on the survivors through the SAME
+        two-phase prepare/publish barrier as a content swap, restoring
+        the replication factor without dropping an in-flight search
+        (old-generation pins drain on the old owner map). Returns the
+        new generation id."""
+        with obs.span("fabric.rebalance", index=self.name,
+                      reason=reason):
+            if self._closed:
+                raise RuntimeError("fabric is closed")
+            gen = self._publish_generation(exclude=exclude)
+            self._count("rebalances")
+            obs.counter("fabric.rebalances_total", reason=reason)
+            obs.event("fabric_rebalance", gen=gen, reason=reason,
+                      exclude=sorted(set(int(r) for r in exclude)))
+            return gen
+
+    def _publish_generation(self, dataset: Optional[np.ndarray] = None,
+                            initial: bool = False,
+                            exclude: Sequence[int] = ()) -> int:
         p = self.params
         with self._swap_lock:
+            if dataset is None:
+                dataset = self._dataset
             self._gen_counter += 1
             gen_id = self._gen_counter
-            bounds = shard_bounds(dataset.shape[0], p.n_workers)
+            bounds = shard_bounds(dataset.shape[0], self.n_shards)
+            # placement = current members minus retired/excluded ranks
+            # — NOT live-only: a briefly-down worker keeps its slots
+            # (the half-open resync heals it in place); only an
+            # explicit eviction moves shards
+            out = set(self._retired)
+            out.update(int(r) for r in exclude)
+            placement = [r for r in self.member_ranks() if r not in out]
+            if not placement:
+                raise FabricSwapError(
+                    f"generation {gen_id} impossible: no admissible "
+                    f"workers (members {self.member_ranks()}, "
+                    f"excluded {sorted(out)})")
             owners = {
-                s: tuple((s + j) % p.n_workers
-                         for j in range(min(p.replication, p.n_workers)))
-                for s in range(p.n_workers)
+                s: tuple(placement[(s + j) % len(placement)]
+                         for j in range(min(p.replication,
+                                            len(placement))))
+                for s in range(self.n_shards)
             }
-            live = [r for r in range(p.n_workers) if self.group.alive(r)]
-            if initial and len(live) < p.n_workers:
+            live = [r for r in placement if self.group.alive(r)]
+            if initial and len(live) < len(placement):
                 raise RuntimeError(
                     "fabric bootstrap needs every worker alive, got "
-                    f"{live} of {p.n_workers}")
+                    f"{live} of {placement}")
             for s, ranks in owners.items():
                 if not any(r in live for r in ranks):
                     raise FabricSwapError(
@@ -810,8 +990,8 @@ class Fabric:
                 self.group.forget(r, f)
 
     def _retire_cluster(self, gen_id: int) -> None:
-        for r in range(self.params.n_workers):
-            if not self.group.alive(r):
+        for r in self.member_ranks():
+            if r in self._retired or not self.group.alive(r):
                 continue
             try:
                 self._call_control(r, "retire", {"gen": gen_id})
@@ -828,7 +1008,10 @@ class Fabric:
         before re-admission. Returns the post-round state map."""
         with obs.span("fabric.probe_round", index=self.name):
             now = time.monotonic()
-            for rank in range(self.params.n_workers):
+            members = self.member_ranks()
+            for rank in members:
+                if rank in self._retired:
+                    continue
                 hl = self.health[rank]
                 if hl.state == OPEN:
                     if not hl.due_for_probe(now):
@@ -836,7 +1019,7 @@ class Fabric:
                     hl.to_half_open()
                 self._probe_worker(rank)
             return {r: self.health[r].state
-                    for r in range(self.params.n_workers)}
+                    for r in members if r not in self._retired}
 
     def _probe_worker(self, rank: int) -> bool:
         p = self.params
@@ -899,18 +1082,93 @@ class Fabric:
         obs.event("fabric_worker_resync", worker=rank, gen=gen_id)
         return True
 
-    def restart_worker(self, rank: int) -> None:
+    def restart_worker(self, rank: int, *,
+                       inherit_faults: bool = False) -> None:
         """Respawn a lost worker and stage it for HALF-OPEN
         re-admission: the fresh process holds no index state, so it is
         forced open (unrouted) and the next probe round re-syncs it to
-        the current generation before closing its circuit."""
+        the current generation before closing its circuit.
+
+        ``inherit_faults=True`` (the helm controller's respawn path)
+        re-installs the rank's remaining spawn-time fault plan on the
+        replacement — a ``dead@proc`` rank stays dead, a
+        ``flap@proc:R*K`` rank keeps flapping until its budget is
+        spent — so chaos drills model machines, not processes."""
+        if rank in self._retired:
+            raise ValueError(f"worker {rank} is retired")
         with obs.span("fabric.restart_worker", index=self.name,
                       worker=rank):
-            self.group.restart(rank)
+            if inherit_faults:
+                self.group.restart(rank, inherit_faults=True)
+            else:
+                self.group.restart(rank)
             self.health[rank].force_open()
             self._count("restarts")
             obs.counter("fabric.worker_restarts_total", worker=rank)
             obs.event("fabric_worker_restart", worker=rank)
+
+    # -- control plane: membership ------------------------------------------
+
+    def add_worker(self, fault_spec: Optional[str] = None) -> int:
+        """Admit one fresh worker (scale-up): spawn it at the next
+        rank, then republish the current generation over the grown
+        membership so the newcomer owns shards before it takes
+        traffic. Returns the new rank."""
+        if self._closed:
+            raise RuntimeError("fabric is closed")
+        with obs.span("fabric.add_worker", index=self.name):
+            p = self.params
+            rank = self.group.add_worker(fault_spec)
+            while len(self.health) <= rank:
+                self.health.append(
+                    WorkerHealth(len(self.health), p.fail_threshold,
+                                 p.halfopen_after_s,
+                                 p.probation_successes))
+            try:
+                self.rebalance(reason="scale_up")
+            except BaseException:
+                # the spawn succeeded but placement failed — evict the
+                # orphan so it never takes traffic half-synced
+                self._retired.add(rank)
+                self.group.retire(rank)
+                raise
+            self._count("adds")
+            obs.counter("fabric.worker_adds_total", worker=rank)
+            obs.event("fabric_worker_add", worker=rank)
+            return rank
+
+    def retire_worker(self, rank: int, timeout_s: float = 30.0, *,
+                      reason: str = "scale_down") -> None:
+        """Drain one worker out of the fabric (scale-down or eviction)
+        WITHOUT dropping a query: republish the current generation with
+        the rank excluded, wait for the prior generation (whose owner
+        map may still route to it) to drain its in-flight pins, then
+        stop the process. The rank number is never reused."""
+        rank = int(rank)
+        if rank in self._retired:
+            return
+        if self._closed:
+            raise RuntimeError("fabric is closed")
+        with obs.span("fabric.retire_worker", index=self.name,
+                      worker=rank, reason=reason):
+            prior = self.registry.get(self.name)
+            self._retired.add(rank)
+            try:
+                self.rebalance(reason=reason)
+            except BaseException:
+                self._retired.discard(rank)
+                raise
+            # in-flight searches pinned the PRIOR generation and may
+            # still read this rank; the pin-drain event bounds the wait
+            if prior is not None:
+                prior.drained.wait(timeout=timeout_s)
+            self.health[rank].force_open()
+            self.group.retire(rank)
+            self._count("retires")
+            obs.counter("fabric.worker_retires_total", worker=rank,
+                        reason=reason)
+            obs.event("fabric_worker_retire", worker=rank,
+                      reason=reason)
 
     def _probe_loop(self) -> None:
         while not self._closed:
@@ -930,6 +1188,32 @@ class Fabric:
             return 0
         return cur.handle.gen_id
 
+    def coverage_ewma(self) -> Optional[float]:
+        """Smoothed mean coverage over recent searches (``None``
+        before the first) — the helm controller's rebalance trigger."""
+        with self._stats_lock:
+            return self._cov_ewma
+
+    def active_ranks(self) -> List[int]:
+        """Members minus retired — the ranks the control plane manages."""
+        return [r for r in self.member_ranks()
+                if r not in self._retired]
+
+    def open_episodes(self, now: Optional[float] = None) -> Dict[int, float]:
+        """Seconds each active worker's circuit has been in its current
+        OPEN episode (0.0 when closed). Flapping resets nothing here —
+        the episode clock survives failed half-open probes and only a
+        real readmission clears it, so the controller's rebalance
+        budget distinguishes solid death from flapping."""
+        now = time.monotonic() if now is None else float(now)
+        out: Dict[int, float] = {}
+        for r in self.active_ranks():
+            hl = self.health[r]
+            with hl.lock:
+                since = hl.open_since
+            out[r] = (now - since) if since > 0.0 else 0.0
+        return out
+
     def collect_metrics(self, include_router: bool = True,
                         timeout_s: Optional[float] = None) -> dict:
         """Fleet metrics federation (ISSUE 13): scrape every live
@@ -947,8 +1231,8 @@ class Fabric:
                        else self.params.probe_timeout_s)
             futs = {
                 r: self._call_control(r, "collect_metrics", {})
-                for r in range(self.params.n_workers)
-                if self.group.alive(r)
+                for r in self.member_ranks()
+                if r not in self._retired and self.group.alive(r)
             }
             # ONE shared deadline across the fleet, not timeout-per-rank:
             # a scrape endpoint over N hung workers must answer in
@@ -990,7 +1274,7 @@ class Fabric:
             fed["generation"] = self.generation()
             fed["worker_health"] = {
                 f"w{r}": self.health[r].state
-                for r in range(self.params.n_workers)
+                for r in self.member_ranks() if r not in self._retired
             }
             return fed
 
@@ -1005,12 +1289,16 @@ class Fabric:
         with self._stats_lock:
             counters = dict(self._counters)
             lat = list(self._lat_ms)
+        active = self.active_ranks()
         return {
             "generation": self.generation(),
-            "n_workers": self.params.n_workers,
+            "n_workers": len(active),
+            "n_shards": self.n_shards,
+            "members": self.member_ranks(),
+            "retired": sorted(self._retired),
             "replication": self.params.replication,
-            "health": {r: self.health[r].state
-                       for r in range(self.params.n_workers)},
+            "balance": self.params.balance,
+            "health": {r: self.health[r].state for r in active},
             "counters": counters,
             "rpc_p50_ms": (round(float(np.percentile(lat, 50)), 3)
                            if lat else None),

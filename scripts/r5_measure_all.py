@@ -97,6 +97,18 @@ OPTIONAL_STAGES = [
       "--ab-obs", "--out", "FABRIC_r13.json",
       "--federate-out", "OBS_r13/FEDERATED_r13.json",
       "--obs-snapshot", "FABRIC_r13.obs.json"], 1200),
+    # graft-helm acceptance (ISSUE 18): the self-healing chaos curve —
+    # primary-vs-p2c balancer A/B at matched topology, then a scripted
+    # slow/flap/permanent-dead schedule under the HelmController with a
+    # low/high/low traffic ramp; coverage timeline, repair latency,
+    # autoscale trace, and bitwise oracle checks land in FABRIC_r18.json
+    ("fabric_helm",
+     [PY, "scripts/serve_loadgen.py", "--chaos-curve", "--n", "60000",
+      "--dim", "64", "--fabric-workers", "4",
+      "--fabric-replication", "2", "--concurrency", "16",
+      "--duration-s", "15", "--k", "1,10,100",
+      "--out", "FABRIC_r18.json",
+      "--obs-snapshot", "FABRIC_r18.obs.json"], 1200),
     # tiered-memory acceptance (ISSUE 12, ROADMAP item 3): host/mmap
     # originals + shortlist-only fetch vs the full-upload baseline,
     # then a Zipf(1.0) serve run whose hot-row hit-rate / zero-retrace

@@ -150,6 +150,20 @@ def main() -> int:
     ap.add_argument("--fault", default=None,
                     help="RAFT_TPU_FAULTS-grammar spec installed in the "
                          "fabric workers (e.g. 'slow@proc:1*50')")
+    ap.add_argument("--balance", default=None,
+                    choices=["p2c", "primary"],
+                    help="fabric replica read balancer (default: the "
+                         "FabricParams default, p2c; 'primary' is the "
+                         "always-first-owner A/B baseline)")
+    ap.add_argument("--chaos-curve", action="store_true",
+                    help="the ISSUE 18 self-healing drill (implies "
+                         "--fabric): a matched-topology primary-vs-p2c "
+                         "balancer A/B, then a scripted "
+                         "slow/flap/permanent-dead schedule under a "
+                         "running HelmController with a low/high/low "
+                         "traffic ramp — coverage timeline, repair "
+                         "latency, autoscale events, and oracle checks "
+                         "land in FABRIC_r18.json")
     ap.add_argument("--ab-obs", action="store_true",
                     help="fabric only: run an uninstrumented "
                          "(RAFT_TPU_OBS=off) leg first and record the "
@@ -190,6 +204,8 @@ def main() -> int:
                     help="also write the graft-scope metrics snapshot here")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    if args.chaos_curve:
+        args.fabric = True
 
     from raft_tpu import obs, serve
 
@@ -222,7 +238,11 @@ def main() -> int:
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
 
     if args.out is None:
-        args.out = "FABRIC_r13.json" if args.fabric else "SERVE_r05.json"
+        args.out = ("FABRIC_r18.json" if args.chaos_curve
+                    else "FABRIC_r13.json" if args.fabric
+                    else "SERVE_r05.json")
+    if args.chaos_curve:
+        return _run_chaos_curve(args, ks, dataset, rng, obs, serve)
     if args.fabric:
         return _run_fabric(args, ks, dataset, rng, obs, serve)
 
@@ -807,6 +827,7 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
         n_workers=args.fabric_workers,
         replication=args.fabric_replication,
         worker_algo=args.fabric_algo,
+        **({"balance": args.balance} if args.balance else {}),
     )
     obs_ab = None
     if args.ab_obs:
@@ -904,6 +925,7 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
             "dim": args.dim, "workers": args.fabric_workers,
             "replication": args.fabric_replication,
             "group": args.fabric_group, "fault": args.fault,
+            "balance": params.balance,
             "concurrency": args.concurrency, "qps_target": args.qps,
             "k": ks, "duration_s": round(wall_s, 2),
             "build_s": round(build_s, 2),
@@ -942,6 +964,309 @@ def _run_fabric(args, ks, dataset, rng, obs, serve) -> int:
           flush=True)
     print(f"wrote {args.out} (measured {report['date']})", flush=True)
     return 0
+
+
+def _chaos_oracle(dataset, q, k, n_shards):
+    """The surviving-owner oracle: the same per-shard build + merge the
+    workers run, so a full-coverage fabric answer must match BITWISE
+    (identical tie-breaking, identical reduction order)."""
+    from raft_tpu.comms import procgroup
+    from raft_tpu.serve import fabric as fabmod
+
+    bounds = fabmod.shard_bounds(dataset.shape[0], n_shards)
+    results = {}
+    for s in range(n_shards):
+        entry = procgroup.build_shard_entry(
+            dataset[bounds[s]:bounds[s + 1]], bounds[s], "brute_force")
+        d, i = procgroup.search_shard_entry(entry, q, k)
+        results[s] = (0, d, i)
+    d, i, _ = fabmod.merge_shard_results(n_shards, results, q.shape[0], k)
+    return d, i
+
+
+def _run_chaos_curve(args, ks, dataset, rng, obs, serve) -> int:
+    """--chaos-curve (ISSUE 18): the self-healing acceptance drill.
+
+    Leg 1 — balancer A/B: two fault-free fabrics at MATCHED topology,
+    identical seeds, ``balance="primary"`` vs ``"p2c"`` — the p2c
+    replica read balancer must win on throughput.
+
+    Leg 2 — the chaos curve: one fabric under a scripted spawn-time
+    schedule (``#after:N`` delays — one transient-slow worker, one
+    flapping worker, one PERMANENTLY dead worker) with a
+    :class:`~raft_tpu.serve.HelmController` closing the repair and
+    autoscale loops, driven by a low/high/low closed-loop traffic ramp.
+    A sampler thread records the coverage/membership timeline; after a
+    bounded settle the report asserts coverage back at 1.0, replication
+    restored over the survivors (dead rank evicted, flapping rank
+    healed in place), zero mixed-generation answers, bitwise oracle
+    agreement on full-coverage samples, and a grew-then-shrank
+    autoscale trace with no thrash."""
+    import copy
+
+    from raft_tpu.serve.controller import HelmController, HelmParams
+    from raft_tpu.serve.fabric import CLOSED
+
+    W, R = args.fabric_workers, args.fabric_replication
+    if W < 3:
+        print("--chaos-curve needs --fabric-workers >= 3 (one slow, one "
+              "flapping, one dead rank)", flush=True)
+        return 2
+
+    def _params(balance):
+        return serve.FabricParams(
+            n_workers=W, replication=R, worker_algo=args.fabric_algo,
+            balance=balance)
+
+    # -- leg 1: the balancer A/B at matched topology, fault-free ------------
+    ab_qps = {}
+    for balance in ("primary", "p2c"):
+        fab = serve.Fabric(dataset, params=_params(balance),
+                           group=args.fabric_group)
+        leg = _drive_fabric(fab, args, ks, args.duration_s / 2,
+                            args.seed + 7000, serve)
+        fab.close()
+        qps = leg["counts"]["completed"] / max(leg["wall_s"], 1e-9)
+        ab_qps[balance] = round(qps, 1)
+        print(f"balance A/B {balance}: {qps:.1f} QPS", flush=True)
+    balance_ab = {
+        "primary_qps": ab_qps["primary"],
+        "p2c_qps": ab_qps["p2c"],
+        "speedup": (round(ab_qps["p2c"] / ab_qps["primary"], 4)
+                    if ab_qps["primary"] else None),
+        "p2c_wins": ab_qps["p2c"] > ab_qps["primary"],
+    }
+
+    # -- leg 2: the chaos curve under the helm ------------------------------
+    # early arming delays: the repair story should resolve during the
+    # ramp, not after it — and the rebalance budget must exceed one
+    # respawn + readmission round trip (process spawn + imports + shard
+    # rebuild, seconds on a busy host), or a respawned worker is
+    # evicted while it is still booting
+    slow_rank, flap_rank, dead_rank = 0, W - 2, W - 1
+    fault = (f"slow@proc:{slow_rank}#after:10*12,"
+             f"flap@proc:{flap_rank}#after:60*2,"
+             f"dead@proc:{dead_rank}#after:20")
+    if obs.enabled():
+        obs.reset()
+    t_build = time.perf_counter()
+    fab = serve.Fabric(dataset, params=_params(args.balance or "p2c"),
+                       group=args.fabric_group, fault_spec=fault)
+    build_s = time.perf_counter() - t_build
+    helm = HelmController(fab, params=HelmParams(
+        interval_s=0.05,
+        rebalance_budget_ms=6000.0,
+        restart_budget=2,
+        # floor at the provisioned topology: the ramp's shrink releases
+        # SURGE capacity only (and an eviction under the floor admits a
+        # replacement, restoring both replication and capacity)
+        min_workers=W,
+        max_workers=W + 2,
+        scale_up_inflight=2.0,
+        scale_down_inflight=0.75,
+        sustain_ticks=4,
+        cooldown_s=1.0,
+        retire_timeout_s=20.0,
+    ))
+    print(f"chaos fabric up: {W} workers x {R} replicas "
+          f"(spawn+load {build_s:.1f}s), faults '{fault}'", flush=True)
+
+    timeline: list = []
+    t0 = time.monotonic()
+    stop_sample = threading.Event()
+
+    def sampler():
+        while not stop_sample.is_set():
+            now = time.monotonic()
+            open_eps = fab.open_episodes(now)
+            snap = fab.load_snapshot()
+            active = fab.active_ranks()
+            cov = fab.coverage_ewma()
+            timeline.append({
+                "t_s": round(now - t0, 3),
+                "active": active,
+                "open": sorted(r for r, e in open_eps.items() if e > 0.0),
+                "coverage_ewma": (round(cov, 5) if cov is not None
+                                  else None),
+                "mean_inflight": round(
+                    sum(snap["inflight"].get(r, 0) for r in active)
+                    / max(len(active), 1), 3),
+                "generation": fab.generation(),
+            })
+            stop_sample.wait(0.25)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    helm.start()
+    sampler_t.start()
+
+    # closed-loop traffic ramp: low -> high (the scale-up window) ->
+    # low (the scale-down window); each phase reuses the standard
+    # measurement leg against the SAME fabric while the helm runs
+    low_c = max(2, args.concurrency // 4)
+    phases = [
+        ("ramp_low", low_c, args.duration_s * 0.5),
+        ("ramp_high", max(args.concurrency, 16), args.duration_s),
+        ("ramp_cool", 1, args.duration_s * 0.5),
+    ]
+    ver_rng = np.random.default_rng(args.seed + 1234)
+    oracle = {"checked": 0, "mismatches": 0, "degraded_skipped": 0}
+
+    def _oracle_sample(n_queries):
+        k = int(max(ks))
+        for _ in range(n_queries):
+            q = ver_rng.standard_normal((1, args.dim)).astype(np.float32)
+            try:
+                d, ids, cov = fab.search(q, k)
+            except Exception:  # noqa: BLE001  # graft-lint: allow-unclassified-swallow sampling only; the fabric already classified the failure
+                continue
+            if float(cov.min()) < 1.0:
+                oracle["degraded_skipped"] += 1
+                continue
+            od, oi = _chaos_oracle(dataset, q, k, fab.n_shards)
+            oracle["checked"] += 1
+            if not (np.array_equal(ids, oi) and np.array_equal(d, od)):
+                oracle["mismatches"] += 1
+
+    phase_rows = []
+    for i, (name, conc, dur) in enumerate(phases):
+        pa = copy.copy(args)
+        pa.concurrency = int(conc)
+        pa.requests = 0
+        pa.qps = 0.0
+        leg = _drive_fabric(fab, pa, ks, dur,
+                            args.seed + 9000 + 100 * i, serve)
+        done = leg["counts"]["completed"]
+        phase_rows.append({
+            "phase": name, "concurrency": int(conc),
+            "qps": round(done / max(leg["wall_s"], 1e-9), 1),
+            **leg["counts"],
+            "cov_min": round(leg["cov_min"], 5),
+            "p99_ms": _percentiles(leg["lat_ms"]).get("p99"),
+        })
+        _oracle_sample(8)   # between-phase spot checks, chaos included
+        print(f"phase {name} (c={conc}): {phase_rows[-1]['qps']} QPS, "
+              f"cov_min {phase_rows[-1]['cov_min']}", flush=True)
+
+    # bounded settle: let the repair loop finish (respawns, eviction,
+    # replacement admission) and the breakers re-close
+    settle_deadline = time.monotonic() + 30.0
+    while time.monotonic() < settle_deadline:
+        active = fab.active_ranks()
+        if active and all(fab.health[r].state == CLOSED for r in active) \
+                and all(e <= 0.0 for e in fab.open_episodes().values()):
+            break
+        time.sleep(0.2)
+    _oracle_sample(24)      # post-repair: every sample full-coverage
+    waterfall = _waterfall_columns(obs) if obs.enabled() else None
+    stats = fab.stats()
+    helm_stats = helm.stats()
+    helm.stop()
+    stop_sample.set()
+    sampler_t.join(timeout=5)
+
+    actions = [{"t_s": round(a["t"] - t0, 3), "action": a["action"],
+                "worker": a["worker"]} for a in helm_stats["actions"]]
+    cur = fab.registry.get(fab.name)
+    owners = (dict(cur.handle.owners)
+              if cur is not None and cur.handle is not None else {})
+    fab.close()
+
+    active = stats["members"]
+    active = [r for r in active if r not in stats["retired"]]
+    want_repl = min(R, len(active))
+    replication_ok = bool(owners) and all(
+        len(set(o)) == want_repl
+        and all(r not in stats["retired"] for r in o)
+        for o in owners.values())
+    first_fault_t = min(
+        (s["t_s"] for s in timeline if s["open"]), default=None)
+    repair_actions = [a for a in actions
+                     if a["action"] in ("respawn", "evict", "admit")]
+    last_repair_t = max((a["t_s"] for a in repair_actions),
+                        default=first_fault_t)
+    repaired_t = None
+    if first_fault_t is not None:
+        for s in timeline:
+            if s["t_s"] >= (last_repair_t or 0.0) and not s["open"] \
+                    and (s["coverage_ewma"] or 0.0) >= 0.999:
+                repaired_t = s["t_s"]
+                break
+    ups = [a["t_s"] for a in actions if a["action"] == "scale_up"]
+    downs = [a["t_s"] for a in actions if a["action"] == "scale_down"]
+    respawns = helm_stats["restarts"]
+    final_cov = next((s["coverage_ewma"] for s in reversed(timeline)
+                      if s["coverage_ewma"] is not None), None)
+    acceptance = {
+        "p2c_beats_primary": balance_ab["p2c_wins"],
+        "coverage_restored": repaired_t is not None,
+        "final_coverage_ewma": final_cov,
+        "time_to_repair_s": (round(repaired_t - first_fault_t, 3)
+                             if repaired_t is not None
+                             and first_fault_t is not None else None),
+        "replication_restored": replication_ok,
+        "evicted": helm_stats["evicted"],
+        "evicted_only_dead": helm_stats["evicted"] == [dead_rank],
+        "flap_healed_in_place": (flap_rank in active
+                                 and respawns.get(flap_rank, 0) >= 1),
+        "mixed_gen": stats["counters"].get("mixed_gen", 0),
+        "oracle": oracle,
+        "grew_then_shrank": (bool(ups) and bool(downs)
+                             and min(ups) < max(downs)),
+        "scale_actions": len(ups) + len(downs),
+        "no_thrash": (len(ups) + len(downs) <= 4
+                      and all(n <= 2 for n in respawns.values())),
+    }
+    ok = (acceptance["p2c_beats_primary"]
+          and acceptance["coverage_restored"]
+          and acceptance["replication_restored"]
+          and acceptance["evicted_only_dead"]
+          and acceptance["flap_healed_in_place"]
+          and acceptance["mixed_gen"] == 0
+          and oracle["checked"] > 0 and oracle["mismatches"] == 0
+          and acceptance["grew_then_shrank"]
+          and acceptance["no_thrash"])
+
+    report = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "mode": "chaos_curve", "algo": args.fabric_algo,
+            "n": args.n, "dim": args.dim, "workers": W,
+            "replication": R, "group": args.fabric_group,
+            "balance": args.balance or "p2c", "fault": fault,
+            "k": ks, "duration_s": args.duration_s,
+            "build_s": round(build_s, 2), "seed": args.seed,
+        },
+        "balance_ab": balance_ab,
+        "phases": phase_rows,
+        "helm": {"ticks": helm_stats["ticks"],
+                 "restarts": respawns,
+                 "evicted": helm_stats["evicted"],
+                 "actions": actions,
+                 "rebalance_budget_ms":
+                     helm_stats["rebalance_budget_ms"]},
+        "fabric": stats,
+        "owners": {str(s): list(o) for s, o in sorted(owners.items())},
+        "timeline": timeline,
+        "waterfall": waterfall,
+        "acceptance": acceptance,
+        "pass": ok,
+    }
+    with open(os.path.join(ROOT, args.out), "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    if args.obs_snapshot:
+        obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    # artifact + date ride the summary line (GL005 contract)
+    print(json.dumps({"pass": ok, "balance_ab": balance_ab,
+                      "acceptance": {k: acceptance[k] for k in
+                                     ("time_to_repair_s", "evicted",
+                                      "mixed_gen", "grew_then_shrank",
+                                      "no_thrash")},
+                      "oracle": oracle,
+                      "artifact": args.out, "date": report["date"]}),
+          flush=True)
+    print(f"wrote {args.out} (measured {report['date']})", flush=True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,519 @@
+"""graft-helm control plane (ISSUE 18; markers ``multihost`` +
+``threadsan``).
+
+Covers: the WorkerHealth probational-readmission hysteresis (a
+probe-pass-then-fail worker re-opens WITHOUT a refilled failure
+budget), p2c replica load-balancing spreading one shard's reads over
+ALL its owners, dynamic membership (admit/drain) with bitwise answer
+continuity and zero mixed-generation merges, the repair loop
+(respawn-then-evict against the rebalance budget, replication factor
+restored on the survivors), the autoscaler's grow-then-shrink with
+cooldown/sustain hysteresis and saturated-stage hold reasons, and the
+thrash NEGATIVE test: a ``flap@proc`` worker is respawned, never
+evicted, and never causes a scale action.
+
+All tests run the in-process :class:`LocalGroup` transport under
+sanitized locks; the spawn-worker chaos acceptance lives in
+tests/test_fabric.py and the shipped FABRIC artifact.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import serve, tuning
+from raft_tpu.analysis import lockwatch
+from raft_tpu.resilience import faultinject
+from raft_tpu.serve import fabric as fabmod
+from raft_tpu.serve.fabric import CLOSED, HALF_OPEN, OPEN, WorkerHealth
+
+pytestmark = [pytest.mark.multihost, pytest.mark.threadsan]
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    faultinject.clear()
+    tuning.reload()
+    yield
+    faultinject.clear()
+    tuning.reload()
+
+
+def _params(**kw):
+    base = dict(
+        n_workers=3, replication=2, rpc_deadline_s=3.0,
+        rpc_retries=2, retry_backoff_s=0.01, hedge_after_ms=25.0,
+        halfopen_after_s=0.02, probe_timeout_s=10.0,
+        swap_deadline_s=30.0, slow_ms=150.0, auto_probe=False,
+        fail_threshold=2,
+    )
+    base.update(kw)
+    return serve.FabricParams(**base)
+
+
+def _helm_params(**kw):
+    base = dict(
+        interval_s=0.02, rebalance_budget_ms=150.0, restart_budget=0,
+        min_workers=2, max_workers=5, sustain_ticks=2, cooldown_s=0.05,
+        retire_timeout_s=5.0,
+    )
+    base.update(kw)
+    return serve.HelmParams(**base)
+
+
+def _data(n=96, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, dim)).astype(np.float32),
+            rng.standard_normal((4, dim)).astype(np.float32))
+
+
+def _spin(fab, helm, pred, timeout_s=10.0, probe=True):
+    """Tick controller + prober until ``pred()`` or timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        helm.step()
+        if probe:
+            fab.probe_now()
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# WorkerHealth flapping hysteresis (satellite: pinned breaker contract)
+# ---------------------------------------------------------------------------
+
+
+def test_health_probational_readmission_keeps_budget_spent():
+    hl = WorkerHealth(0, fail_threshold=3, halfopen_after_s=0.0,
+                      probation_successes=3)
+    for _ in range(3):
+        hl.record_failure("transient")
+    assert hl.state == OPEN
+    hl.to_half_open()
+    assert hl.state == HALF_OPEN
+    # probe passes: closed again — but the failure budget stays spent
+    hl.record_success()
+    assert hl.state == CLOSED
+    # ONE failure re-opens (pre-ISSUE-18 it took fail_threshold fresh
+    # ones — the flapping worker served 2 more failing requests per
+    # flap cycle)
+    hl.record_failure("transient")
+    assert hl.state == OPEN
+
+
+def test_health_budget_refills_after_probation():
+    hl = WorkerHealth(0, fail_threshold=3, halfopen_after_s=0.0,
+                      probation_successes=3)
+    for _ in range(3):
+        hl.record_failure("transient")
+    hl.to_half_open()
+    hl.record_success()
+    assert hl.state == CLOSED
+    # probation: 2 more consecutive successes refill the budget
+    hl.record_success()
+    hl.record_success()
+    hl.record_failure("transient")
+    assert hl.state == CLOSED          # budget refilled: 1 of 3 spent
+    hl.record_failure("transient")
+    hl.record_failure("transient")
+    assert hl.state == OPEN
+
+
+def test_health_open_episode_survives_failed_halfopen_probe():
+    hl = WorkerHealth(0, fail_threshold=1, halfopen_after_s=0.0,
+                      probation_successes=2)
+    hl.record_failure("dead_backend")
+    assert hl.state == OPEN
+    first_since = hl.open_since
+    assert first_since > 0.0
+    # failed half-open probe: back to OPEN, but the EPISODE clock keeps
+    # its original start — a dead worker's time-to-evict is measured
+    # from its first trip, not its latest failed probe
+    hl.to_half_open()
+    hl.record_failure("transient")
+    assert hl.state == OPEN
+    assert hl.open_since == first_since
+    # readmission ends the episode
+    hl.to_half_open()
+    hl.record_success()
+    assert hl.state == CLOSED and hl.open_since == 0.0
+
+
+# ---------------------------------------------------------------------------
+# p2c replica load balancing
+# ---------------------------------------------------------------------------
+
+
+def test_p2c_spreads_one_shards_reads_over_all_owners():
+    ds, q = _data()
+    # ONE shard, TWO owners: primary-first routing would pin every read
+    # on worker 0 while worker 1 idles as a failover spare
+    p = _params(n_workers=2, replication=2, n_shards=1)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        for _ in range(24):
+            d, i, cov = fab.search(q, 5)
+            assert (cov == 1.0).all()
+        ewma = fab.load_snapshot()["ewma_ms"]
+        # both owners measured => both actually served reads
+        assert set(ewma) == {0, 1}, ewma
+
+
+def test_primary_baseline_keeps_declared_order():
+    ds, q = _data()
+    p = _params(n_workers=2, replication=2, n_shards=1,
+                balance="primary")
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        for _ in range(24):
+            fab.search(q, 5)
+        ewma = fab.load_snapshot()["ewma_ms"]
+        # primary-first: worker 1 never serves a healthy-path read
+        assert 0 in ewma and 1 not in ewma, ewma
+
+
+def test_p2c_answers_stay_bitwise_vs_oracle():
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        bounds_shards = fab.n_shards
+        for _ in range(8):
+            d, i, cov = fab.search(q, 5)
+            assert (cov == 1.0).all()
+            # replicas hold identical shard slices and run the same
+            # search path — routing choice can never change the answer
+            od, oi, _ = _oracle_local(ds, q, 5, bounds_shards)
+            np.testing.assert_array_equal(i, oi)
+            np.testing.assert_array_equal(d, od)
+        assert fab.stats()["counters"].get("mixed_gen", 0) == 0
+
+
+def _oracle_local(dataset, q, k, n_shards):
+    from raft_tpu.comms import procgroup
+    bounds = fabmod.shard_bounds(dataset.shape[0], n_shards)
+    results = {}
+    for s in range(n_shards):
+        entry = procgroup.build_shard_entry(
+            dataset[bounds[s]:bounds[s + 1]], bounds[s], "brute_force")
+        d, i = procgroup.search_shard_entry(entry, q, k)
+        results[s] = (0, d, i)
+    return fabmod.merge_shard_results(n_shards, results, q.shape[0], k)
+
+
+# ---------------------------------------------------------------------------
+# dynamic membership on the fabric surface
+# ---------------------------------------------------------------------------
+
+
+def test_add_and_retire_worker_bitwise_continuity():
+    ds, q = _data()
+    with serve.Fabric(ds, params=_params(), group="local") as fab:
+        od, oi, _ = _oracle_local(ds, q, 5, fab.n_shards)
+        rank = fab.add_worker()
+        assert rank == 3 and fab.member_ranks() == [0, 1, 2, 3]
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        np.testing.assert_array_equal(i, oi)
+        fab.retire_worker(0, timeout_s=5.0)
+        assert fab.active_ranks() == [1, 2, 3]
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        # shard count never changed; every shard kept `replication`
+        # distinct owners drawn from the survivors
+        owners = fab.registry.get(fab.name).handle.owners
+        assert len(owners) == fab.n_shards
+        for ranks in owners.values():
+            assert len(set(ranks)) == 2
+            assert all(r in (1, 2, 3) for r in ranks)
+        assert fab.stats()["counters"].get("mixed_gen", 0) == 0
+        # a retired rank is permanently out
+        with pytest.raises(ValueError):
+            fab.restart_worker(0)
+
+
+def test_retire_below_one_admissible_worker_raises():
+    ds, _q = _data()
+    p = _params(n_workers=2, replication=2)
+    with serve.Fabric(ds, params=p, group="local") as fab:
+        fab.retire_worker(0, timeout_s=5.0)
+        with pytest.raises(fabmod.FabricSwapError):
+            fab.retire_worker(1, timeout_s=5.0)
+        # the failed retire rolled back: rank 1 still serves
+        d, i, cov = fab.search(_q, 5)
+        assert (cov == 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# helm repair loop: respawn, then evict against the rebalance budget
+# ---------------------------------------------------------------------------
+
+
+def test_helm_evicts_dead_worker_and_restores_replication():
+    ds, q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local",
+                       fault_spec="dead@proc:2")
+    helm = serve.HelmController(fab, params=_helm_params())
+    try:
+        fab.search(q, 5)                      # trips dead@proc:2
+        assert fab.stats()["health"][2] == "open"
+        assert _spin(fab, helm,
+                     lambda: 2 in helm.stats()["evicted"])
+        # survivors hold full coverage AND the full replication factor
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        owners = fab.registry.get(fab.name).handle.owners
+        for ranks in owners.values():
+            assert len(set(ranks)) == 2 and 2 not in ranks
+        od, oi, _ = _oracle_local(ds, q, 5, fab.n_shards)
+        np.testing.assert_array_equal(i, oi)
+        assert fab.stats()["counters"].get("mixed_gen", 0) == 0
+    finally:
+        helm.stop()
+        fab.close()
+
+
+def test_helm_respawns_before_spending_rebalance_budget():
+    ds, q = _data()
+    # ambient LocalGroup plan: dead@proc:2 fires ONCE — the respawned
+    # worker is genuinely healthy, so repair ends at readmission
+    fab = serve.Fabric(ds, params=_params(), group="local",
+                       fault_spec="dead@proc:2")
+    helm = serve.HelmController(
+        fab, params=_helm_params(restart_budget=2))
+    try:
+        fab.search(q, 5)
+        assert fab.stats()["health"][2] == "open"
+        assert _spin(fab, helm,
+                     lambda: fab.stats()["health"].get(2) == "closed")
+        st = helm.stats()
+        assert st["restarts"].get(2, 0) == 1
+        assert st["evicted"] == []
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+    finally:
+        helm.stop()
+        fab.close()
+
+
+def test_helm_thrash_negative_under_flap():
+    """The ISSUE 18 anti-thrash contract: a FLAPPING worker (dies,
+    respawns, dies again — ``flap@proc:1*2``) is repaired in place and
+    never triggers an eviction, a scale action, or a generation churn:
+    every readmission clears the open-episode clock, the degraded-fleet
+    gate parks the autoscaler, and membership ends exactly where it
+    started."""
+    ds, q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local",
+                       fault_spec="flap@proc:1*2")
+    helm = serve.HelmController(
+        fab, params=_helm_params(restart_budget=5,
+                                 rebalance_budget_ms=2000.0))
+    gen0 = fab.generation()
+    try:
+        deadline = time.monotonic() + 12.0
+        flaps_done = 0
+        while time.monotonic() < deadline:
+            try:
+                d, i, cov = fab.search(q, 5)
+                assert (cov == 1.0).all()
+            except Exception:
+                pass                      # a batch mid-death may drop
+            helm.step()
+            fab.probe_now()
+            st = helm.stats()
+            if (st["restarts"].get(1, 0) >= 2
+                    and fab.stats()["health"].get(1) == "closed"):
+                flaps_done = st["restarts"][1]
+                break
+            time.sleep(0.01)
+        assert flaps_done >= 2, helm.stats()
+        st = helm.stats()
+        c = fab.stats()["counters"]
+        assert st["evicted"] == []                      # no eviction
+        assert c.get("adds", 0) == 0                    # no scale-up
+        assert c.get("retires", 0) == 0                 # no drain
+        assert c.get("rebalances", 0) == 0              # no gen churn
+        assert fab.generation() == gen0
+        assert fab.active_ranks() == [0, 1, 2]
+        # steady state: everyone closed, answers exact
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle_local(ds, q, 5, fab.n_shards)
+        np.testing.assert_array_equal(i, oi)
+    finally:
+        helm.stop()
+        fab.close()
+
+
+# ---------------------------------------------------------------------------
+# helm autoscaler: grow-then-shrink with hysteresis
+# ---------------------------------------------------------------------------
+
+
+def _force_inflight(fab, value):
+    with fab._load_lock:
+        for r in fab.active_ranks():
+            fab._inflight[r] = value
+
+
+def test_helm_scales_up_then_down_with_hysteresis():
+    ds, q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local")
+    helm = serve.HelmController(
+        fab, params=_helm_params(sustain_ticks=3, cooldown_s=0.05,
+                                 scale_up_inflight=3.0,
+                                 scale_down_inflight=0.25))
+    try:
+        # saturate the load signal: sustain gate holds the first two
+        # ticks, the third admits a worker
+        _force_inflight(fab, 10)
+        assert helm.step()["actions"] == []
+        assert helm.step()["actions"] == []
+        rep = helm.step()
+        assert rep["actions"] == [("scale_up", 3)]
+        assert fab.active_ranks() == [0, 1, 2, 3]
+        # still hot, but the cooldown parks further growth
+        _force_inflight(fab, 10)
+        for _ in range(3):
+            rep = helm.step()
+        assert rep["held"] == "cooldown" and rep["workers"] == 4
+        time.sleep(0.06)
+        # load drains: sustained cold signal drains the NEWEST rank
+        _force_inflight(fab, 0)
+        for _ in range(3):
+            rep = helm.step()
+        assert rep["actions"] == [("scale_down", 3)]
+        assert fab.active_ranks() == [0, 1, 2]
+        # the fleet never goes below max(min_workers, replication)
+        time.sleep(0.06)
+        rep = None
+        for _ in range(3):
+            rep = helm.step()
+        assert rep["actions"] == [("scale_down", 2)]
+        time.sleep(0.06)
+        for _ in range(3):
+            rep = helm.step()
+        assert rep["held"] == "min_workers"
+        assert fab.active_ranks() == [0, 1]
+        # answers remain exact through every membership change
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle_local(ds, q, 5, fab.n_shards)
+        np.testing.assert_array_equal(i, oi)
+        assert fab.stats()["counters"].get("mixed_gen", 0) == 0
+    finally:
+        helm.stop()
+        fab.close()
+
+
+def test_helm_holds_when_router_bound(monkeypatch):
+    ds, _q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local")
+    helm = serve.HelmController(
+        fab, params=_helm_params(sustain_ticks=1, cooldown_s=0.0))
+    try:
+        monkeypatch.setattr(helm, "_worker_bound", lambda: False)
+        _force_inflight(fab, 10)
+        rep = helm.step()
+        # merge-dominated waterfalls: another worker would not move the
+        # p99 — hold with the reason instead of spending a machine
+        assert rep["held"] == "router_bound" and rep["actions"] == []
+        assert fab.active_ranks() == [0, 1, 2]
+    finally:
+        helm.stop()
+        fab.close()
+
+
+def test_helm_max_workers_ceiling():
+    ds, _q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local")
+    helm = serve.HelmController(
+        fab, params=_helm_params(sustain_ticks=1, cooldown_s=0.0,
+                                 max_workers=3))
+    try:
+        _force_inflight(fab, 10)
+        rep = helm.step()
+        assert rep["held"] == "max_workers" and rep["actions"] == []
+    finally:
+        helm.stop()
+        fab.close()
+
+
+# ---------------------------------------------------------------------------
+# real multiprocessing: fault-plan inheritance across respawns
+# ---------------------------------------------------------------------------
+
+
+def test_helm_multiprocess_flap_heals_dead_evicts():
+    """ProcGroup-only semantics (each child owns a COPY of the plan, so
+    cross-incarnation budgets are charged parent-side via
+    ``respawned_spec``): a ``flap@proc`` worker dies, is respawned with
+    a decremented flap budget, dies again, and finally holds — while a
+    ``dead@proc`` worker stays dead through every inherited respawn,
+    exhausts the restart budget, and is evicted with its shards
+    re-replicated onto the survivors."""
+    ds, q = _data(n=120)
+    p = _params(rpc_deadline_s=5.0, probe_timeout_s=10.0,
+                swap_deadline_s=60.0, halfopen_after_s=0.05)
+    fab = serve.Fabric(ds, params=p, group="proc",
+                       fault_spec="flap@proc:1*2,dead@proc:2")
+    helm = serve.HelmController(
+        fab, params=_helm_params(restart_budget=3,
+                                 rebalance_budget_ms=500.0,
+                                 retire_timeout_s=20.0))
+    try:
+        deadline = time.monotonic() + 120.0
+        rng = np.random.default_rng(9)
+
+        def settled():
+            st = helm.stats()
+            h = fab.stats()["health"]
+            return (2 in st["evicted"]
+                    and st["restarts"].get(1, 0) >= 2
+                    and h.get(1) == "closed")
+
+        while time.monotonic() < deadline and not settled():
+            try:
+                fab.search(rng.standard_normal(
+                    (1, 8)).astype(np.float32), 4)
+            except Exception:
+                pass                       # mid-death batches may drop
+            helm.step()
+            fab.probe_now()
+            time.sleep(0.05)
+        assert settled(), (helm.stats(), fab.stats())
+        # worker 1 held after its flap budget spent; worker 2 is out
+        # and every shard kept `replication` owners on the survivors
+        owners = fab.registry.get(fab.name).handle.owners
+        for ranks in owners.values():
+            assert len(set(ranks)) == 2 and 2 not in ranks
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+        od, oi, _ = _oracle_local(ds, q, 5, fab.n_shards)
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_array_equal(d, od)
+        assert fab.stats()["counters"].get("mixed_gen", 0) == 0
+    finally:
+        helm.stop()
+        fab.close()
+
+
+def test_helm_operator_overrides_spanned():
+    ds, q = _data()
+    fab = serve.Fabric(ds, params=_params(), group="local")
+    helm = serve.HelmController(fab, params=_helm_params())
+    try:
+        rank = helm.scale_up()
+        assert rank == 3 and len(fab.active_ranks()) == 4
+        gone = helm.scale_down()
+        assert gone == 3 and len(fab.active_ranks()) == 3
+        gen = helm.rebalance(reason="drill")
+        assert gen == fab.generation()
+        d, i, cov = fab.search(q, 5)
+        assert (cov == 1.0).all()
+    finally:
+        helm.stop()
+        fab.close()

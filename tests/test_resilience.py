@@ -246,6 +246,117 @@ def test_run_probe_clamped_to_deadline(monkeypatch):
         resilience.DEAD_BACKEND
 
 
+def test_faultinject_flap_and_delay_grammar():
+    specs = faultinject.parse(
+        "flap@proc:1#after:10*2,dead@proc:0#after:20,slow@proc:2*3")
+    assert [(s.kind, s.arg, s.delay, s.remaining) for s in specs] == [
+        ("flap", "1", 10, 2), ("dead", "0", 20, 1), ("slow", "2", 0, 3),
+    ]
+    # render round-trips the full grammar (the respawn rewrite depends
+    # on it)
+    again = faultinject.parse(",".join(s.render() for s in specs))
+    assert [(s.kind, s.arg, s.delay, s.remaining) for s in again] == \
+        [(s.kind, s.arg, s.delay, s.remaining) for s in specs]
+    with pytest.raises(ValueError):
+        faultinject.parse("flap@stage:x")        # flap is proc-only
+    with pytest.raises(ValueError):
+        faultinject.parse("flap@rpc:search")
+    with pytest.raises(ValueError):
+        faultinject.parse("dead@proc:1#later:3")  # only #after:N
+    with pytest.raises(ValueError):
+        faultinject.parse("dead@proc:1#after:x")
+    with pytest.raises(ValueError):
+        faultinject.parse("dead@proc:1#after:-2")
+
+
+def test_faultinject_delayed_proc_action_arms_after_n():
+    with faultinject.inject("dead@proc:0#after:2"):
+        assert faultinject.proc_action(0) is None      # survives 1
+        assert faultinject.proc_action(1) is None      # other rank
+        assert faultinject.proc_action(0) is None      # survives 2
+        assert faultinject.proc_action(0) == "die"     # armed
+        assert faultinject.proc_action(0) is None      # consumed
+
+
+def test_faultinject_flap_fires_per_count():
+    with faultinject.inject("flap@proc:1*2"):
+        assert faultinject.proc_action(1) == "die"
+        assert faultinject.proc_action(1) == "die"
+        assert faultinject.proc_action(1) is None      # budget spent
+
+
+def test_faultinject_respawned_spec_rewrite():
+    spec = "flap@proc:1#after:3*3,dead@proc:0#after:20,slow@proc:2*2"
+    # rank 1's first respawn: one death charged, delay kept
+    out = faultinject.respawned_spec(spec, rank=1, deaths=1)
+    (flap,) = [s for s in faultinject.parse(out) if s.kind == "flap"]
+    assert (flap.remaining, flap.delay) == (2, 3)
+    # budget exhausted: the flap spec vanishes — the worker holds
+    out = faultinject.respawned_spec(spec, rank=1, deaths=3)
+    assert not any(s.kind == "flap" for s in faultinject.parse(out))
+    # dead is permanent: the respawned incarnation dies at its FIRST
+    # RPC (the #after delay modeled the first death only)
+    out = faultinject.respawned_spec(spec, rank=0, deaths=1)
+    (dead,) = [s for s in faultinject.parse(out) if s.kind == "dead"]
+    assert (dead.remaining, dead.delay) == (1, 0)
+    # other ranks' specs ride along verbatim
+    (slow,) = [s for s in faultinject.parse(out) if s.kind == "slow"]
+    assert (slow.arg, slow.remaining) == ("2", 2)
+    assert faultinject.respawned_spec(None, 0, 1) is None
+    assert faultinject.respawned_spec("flap@proc:0*1", 0, 1) is None
+
+
+# ---------------------------------------------------------------------------
+# full-jitter backoff (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    resilience.seed_jitter(42)
+    try:
+        a = [resilience.backoff_jitter_s(n, 0.1) for n in range(6)]
+        resilience.seed_jitter(42)
+        b = [resilience.backoff_jitter_s(n, 0.1) for n in range(6)]
+        assert a == b                       # seeded => reproducible
+        for n, s in enumerate(a):
+            assert 0.0 <= s <= 0.1 * (2.0 ** n)
+        # jitter=False returns the deterministic cap (legacy schedule)
+        assert resilience.backoff_jitter_s(3, 0.1, jitter=False) == \
+            pytest.approx(0.8)
+        assert resilience.backoff_jitter_s(0, 0.0) == 0.0
+    finally:
+        resilience.seed_jitter(None)
+
+
+def test_run_jittered_backoff_respects_deadline():
+    # deadline math uses the UNJITTERED cap: a lucky small jitter draw
+    # must not let the loop start an attempt it cannot afford
+    resilience.seed_jitter(7)
+    try:
+        def always():
+            raise resilience.TransientError("blip")
+
+        t0 = time.monotonic()
+        with pytest.raises(resilience.DeadlineExceededError):
+            resilience.run(always, retries=50, backoff_s=0.2,
+                           deadline_s=0.3)
+        assert time.monotonic() - t0 < 5.0
+        # and jitter=False restores the exact legacy sleep schedule
+        calls = []
+
+        def twice():
+            calls.append(time.monotonic())
+            if len(calls) < 3:
+                raise resilience.TransientError("blip")
+            return 9
+
+        assert resilience.run(twice, retries=3, backoff_s=0.01,
+                              jitter=False) == 9
+        assert len(calls) == 3
+    finally:
+        resilience.seed_jitter(None)
+
+
 def test_faultinject_env(monkeypatch):
     monkeypatch.setenv(faultinject.ENV_VAR, "transient@stage:probe")
     faultinject.clear()
