@@ -26,7 +26,9 @@ import io
 import re
 import tokenize
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple,
+)
 
 from raft_tpu.analysis.rules import (
     Finding,
@@ -162,6 +164,53 @@ def _names_in(node: ast.AST) -> Set[str]:
     return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
 
 
+# -- GL023 catalog loading --------------------------------------------------
+
+# a metric name as the catalog (and obs.metrics) spells it
+_METRIC_NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_.]*")
+# resolved doc path -> (mtime_ns, documented-name set); mtime-keyed so a
+# test that rewrites a planted catalog sees the rewrite
+_METRIC_CATALOG_CACHE: Dict[str, Tuple[int, FrozenSet[str]]] = {}
+
+
+def documented_metric_names(doc_text: str) -> FrozenSet[str]:
+    """Every metric name docs/observability.md catalogs: a backticked
+    token shaped like a metric name, with any example-label suffix
+    (``serve.batches_total{bucket}``) stripped. Table rows and prose
+    rows both count — the contract is the name being findable, not the
+    markdown construct holding it."""
+    names: Set[str] = set()
+    for tok in re.findall(r"`([^`\n]+)`", doc_text):
+        tok = tok.split("{", 1)[0].strip()
+        if _METRIC_NAME_RE.fullmatch(tok):
+            names.add(tok)
+    return frozenset(names)
+
+
+def _metric_catalog_for(path) -> Optional[FrozenSet[str]]:
+    """Walk up from the linted file for a ``docs/observability.md``;
+    None when no ancestor has one (nothing to check against)."""
+    try:
+        cur = Path(path).resolve().parent
+    except OSError:
+        return None
+    for d in (cur, *cur.parents):
+        doc = d / "docs" / "observability.md"
+        try:
+            if not doc.is_file():
+                continue
+            mtime = doc.stat().st_mtime_ns
+            cached = _METRIC_CATALOG_CACHE.get(str(doc))
+            if cached is not None and cached[0] == mtime:
+                return cached[1]
+            names = documented_metric_names(doc.read_text())
+        except (OSError, UnicodeDecodeError):
+            return None
+        _METRIC_CATALOG_CACHE[str(doc)] = (mtime, names)
+        return names
+    return None
+
+
 @dataclasses.dataclass
 class _FnInfo:
     node: ast.AST                       # FunctionDef / Lambda
@@ -198,6 +247,7 @@ class FileLinter:
         self._lint_comments_and_docstrings()
         self._check_unspanned_entries()
         self._check_untraced_rpc()
+        self._check_undocumented_metric()
         # nested defs are revisited by the per-function GL003 pass; dedupe
         seen: Set[Tuple[str, int, str]] = set()
         unique: List[Finding] = []
@@ -778,6 +828,78 @@ class FileLinter:
                        "the worker's spans share the query's trace id, "
                        "or suppress with a reason for control-plane "
                        "RPCs that belong to no query")
+
+    # -- GL023 undocumented metric -----------------------------------------
+
+    # the obs.metrics emission surface: the three writers whose first
+    # positional arg IS the metric name
+    _METRIC_EMITTERS = ("counter", "gauge", "observe")
+
+    def _metric_call_name(self, node: ast.Call) -> Optional[ast.AST]:
+        """Return the metric-name argument node if ``node`` is an obs
+        metric emission, else None.
+
+        Accepted shapes: ``<…>.obs.counter(...)`` / ``<…>.metrics.
+        gauge(...)`` (the two import idioms in the tree), plus bare
+        ``counter(...)``/``gauge(...)``/``observe(...)`` — but only in
+        modules under ``obs/`` itself, where the writers are local
+        names; elsewhere a bare name is someone else's function."""
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in self._METRIC_EMITTERS:
+            owner = (_dotted(fn.value) or "").rsplit(".", 1)[-1]
+            if owner not in ("obs", "metrics"):
+                return None
+        elif (isinstance(fn, ast.Name) and fn.id in self._METRIC_EMITTERS
+              and "obs" in Path(self.path).parts):
+            pass
+        else:
+            return None
+        if node.args:
+            return node.args[0]
+        return next((kw.value for kw in node.keywords
+                     if kw.arg == "name"), None)
+
+    def _check_undocumented_metric(self) -> None:
+        """GL023: every obs metric name emitted in package code
+        (``raft_tpu`` in the path) must have a catalog row in
+        docs/observability.md — the operator contract the dashboards
+        and alert thresholds are written against. A metric name that
+        is not a string literal is flagged too: the catalog check
+        cannot read it, and neither can the operator grepping for it."""
+        if self.rules is not None and "GL023" not in self.rules:
+            return
+        if "raft_tpu" not in Path(self.path).parts:
+            return
+        sites: List[Tuple[ast.Call, Optional[ast.AST]]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                arg = self._metric_call_name(node)
+                if arg is not None:
+                    sites.append((node, arg))
+        if not sites:
+            return
+        catalog = _metric_catalog_for(self.path)
+        if catalog is None:
+            # no docs/observability.md above this file (detached
+            # fixture tree): there is no contract to check against
+            return
+        for node, arg in sites:
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                self._emit("GL023", node,
+                           "metric name is built dynamically: the "
+                           "catalog check (and the operator's grep) "
+                           "cannot read it — emit a literal name per "
+                           "series, or suppress with a reason naming "
+                           "the catalog rows it expands to")
+                continue
+            if arg.value not in catalog:
+                self._emit("GL023", node,
+                           f"metric {arg.value!r} has no catalog row in "
+                           "docs/observability.md: add one (name, "
+                           "labels, who emits it) so dashboards and "
+                           "alerts have a contract, or suppress with a "
+                           "reason for a deliberately internal series")
 
     # -- GL004 f64 ---------------------------------------------------------
 

@@ -284,6 +284,19 @@ register_rule(
     engine="races",
 )
 register_rule(
+    "GL023", "undocumented-metric",
+    "obs metric emitted in package code with no catalog row in "
+    "docs/observability.md (or a dynamically-built name the check "
+    "cannot read)",
+    "the metric catalog is the operator's contract: a counter/gauge/"
+    "histogram that ships without a row is a dashboard nobody can "
+    "interpret and an alert nobody wires — graft-gauge's recall gauges "
+    "(ISSUE 19) exist precisely so thresholds can be stated against "
+    "documented semantics. Add the row (name, labels, who emits it); "
+    "a deliberately internal/experimental series suppresses with a "
+    "reason saying why operators never see it",
+)
+register_rule(
     "GL022", "unmodeled-lock-edge",
     "runtime-observed lock-order edge absent from the static model "
     "(reconciliation mode)",

@@ -47,6 +47,7 @@ from raft_tpu.obs.trace import (
 )
 from raft_tpu.obs.metrics import (
     DEFAULT_MS_BUCKETS,
+    UNIT_BUCKETS,
     capture_runtime_gauges,
     counter,
     export_prometheus,
@@ -92,6 +93,7 @@ def reset() -> None:
 
 __all__ = [
     "DEFAULT_MS_BUCKETS", "DIR_VAR", "ENV_VAR", "MODES", "Span",
+    "UNIT_BUCKETS",
     "TraceContext", "capture_runtime_gauges", "counter", "current",
     "enabled", "entry_span", "event", "export_prometheus", "federation",
     "flight_dump", "flight_events", "gauge", "last_dump_path", "mode",

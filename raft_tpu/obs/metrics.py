@@ -33,6 +33,17 @@ DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
     1000, 2500, 5000, 10000, 30000, 60000,
 )
 
+# the unit-interval preset (ISSUE 19): [0,1]-valued metrics — recall,
+# coverage, fill/hit ratios — collapse into DEFAULT_MS_BUCKETS' first
+# bucket (every value <= 0.5). These edges spend their resolution where
+# quality metrics live: coarse below 0.5, fine toward 1.0 (a recall
+# drop from 0.99 to 0.95 must move mass across an edge, not vanish
+# inside one).
+UNIT_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+    0.875, 0.9, 0.925, 0.95, 0.975, 0.99, 1.0,
+)
+
 _COUNTER = "counter"
 _GAUGE = "gauge"
 _HISTOGRAM = "histogram"

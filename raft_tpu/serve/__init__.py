@@ -37,7 +37,14 @@ lives in, and the piece TPU-KNN's peak-FLOP/s numbers quietly assume
   an operator: p2c replica load-balancing feeds a controller that
   rebalances shards off workers whose circuits stay open past the
   tuning budget and autoscales the worker set on saturated-stage
-  signals with cooldown/hysteresis (docs/serving.md §10).
+  signals with cooldown/hysteresis (docs/serving.md §10);
+* **online quality control** (:mod:`raft_tpu.serve.quality`, ISSUE 19)
+  — graft-gauge samples answered live queries onto a best-effort
+  shadow lane, re-runs them through the generation-pinned exhaustive
+  oracle, exports windowed Wilson-interval recall estimates
+  (``serve.recall_estimate{index,rung}``), and closes the loop:
+  bounded ``AdaptivePolicy`` retunes under the stated recall band and
+  probation rollback of a degrading hot-swap (docs/serving.md §14).
 """
 
 from raft_tpu.serve.adaptive import AdaptivePolicy, probe_ladder
@@ -58,6 +65,7 @@ from raft_tpu.serve.fabric import (
     WorkerHealth,
 )
 from raft_tpu.serve.mutation import MutableState
+from raft_tpu.serve.quality import QualityMonitor, wilson_interval
 from raft_tpu.serve.registry import Generation, Registry
 
 # the jitted hot-path entry points whose trace caches must stay FLAT in
@@ -113,8 +121,9 @@ def total_trace_count() -> int:
 __all__ = [
     "AdaptivePolicy", "Batch", "Fabric", "FabricParams",
     "FabricSwapError", "Generation", "HelmController", "HelmParams",
-    "MicroBatcher", "MutableState", "Overloaded", "Registry",
+    "MicroBatcher", "MutableState", "Overloaded", "QualityMonitor",
+    "Registry",
     "Request", "ServeParams", "Server", "TRACKED_JITS", "WorkerHealth",
     "bucket_ladder", "choose_bucket", "probe_ladder",
-    "total_trace_count", "trace_cache_sizes",
+    "total_trace_count", "trace_cache_sizes", "wilson_interval",
 ]

@@ -173,6 +173,30 @@ class AdaptivePolicy:
         return tuple(sorted({self.refine_for(i)
                              for i in range(len(self.ladder))}))
 
+    # -- the graft-gauge closed loop (ISSUE 19) ----------------------------
+
+    def tightened(self, max_refine: int = 16) -> "AdaptivePolicy":
+        """One bounded quality-retune step toward recall (graft-gauge's
+        closed loop): double both margin thresholds — more queries read
+        as "hard" and interpolate to higher rungs, and more fall under
+        the exhaustive escape floor — and double the rabitq over-fetch
+        one notch (capped at ``max_refine``). The ladder itself never
+        changes: a margin retune only REWEIGHTS the already-warmed
+        rungs, so it cannot mint a new traced shape; the refine bump is
+        the one shape-bearing change, and the engine re-warms exactly
+        when :meth:`refine_ladder` grew. The monitor applies retunes as
+        ``base.tightened()^n`` so a relax step is exact (n-1), not a
+        drifting inverse."""
+        easy = min(self.easy_margin * 2.0, 0.95)
+        floor = (self.floor_margin * 2.0 if self.floor_margin > 0
+                 else easy / 8.0)
+        floor = min(floor, easy * 0.99)
+        rr = self.refine_ratio
+        if rr > 1:
+            rr = min(rr * 2, max(int(max_refine), rr))
+        return dataclasses.replace(self, easy_margin=easy,
+                                   floor_margin=floor, refine_ratio=rr)
+
 
 def service_estimate_ms(bucket: int,
                         rung: Optional[int] = None) -> Optional[float]:

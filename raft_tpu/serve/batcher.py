@@ -41,9 +41,10 @@ from raft_tpu.obs import trace as obs_trace
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.utils.math import next_pow2
 
-# batch_fill_ratio histogram edges: rows / bucket after padding
-FILL_BUCKETS: Tuple[float, ...] = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
-                                   0.875, 1.0)
+# batch_fill_ratio histogram edges: rows / bucket after padding — the
+# shared unit-interval preset (ISSUE 19), so fill ratios land on the
+# same [0,1] resolution as the recall estimates
+FILL_BUCKETS: Tuple[float, ...] = obs.UNIT_BUCKETS
 
 
 class Overloaded(RuntimeError):
@@ -132,6 +133,11 @@ class Request:
     # batch as a span LINK (one batch serves many traces), completed at
     # delivery — None when obs is off
     trace: Optional[obs_trace.TraceContext] = None
+    # graft-gauge shadow payload (ISSUE 19): the quality monitor's
+    # sample record (pinned generation + the SERVED ids to score
+    # against the oracle re-run). Non-None marks a shadow request —
+    # the future is a placeholder nobody awaits.
+    shadow: object = None
 
     @property
     def rows(self) -> int:
@@ -155,6 +161,10 @@ class Batch:
     # non-adaptive/exhaustive path; set by the engine's split-by-rung
     # partition (and by warmup, which forces each ladder rung once)
     rung: Optional[int] = None
+    # graft-gauge (ISSUE 19): True for a shadow-oracle batch drained
+    # from the best-effort lane — the engine routes it to the quality
+    # monitor's exhaustive re-run instead of the serving path
+    shadow: bool = False
 
     @property
     def k_max(self) -> int:
@@ -180,6 +190,7 @@ class MicroBatcher:
         max_batch_rows: int = 256,
         max_wait_ms: float = 2.0,
         max_queue_rows: int = 4096,
+        shadow_queue_rows: int = 256,
         name: str = "default",
     ):
         self.ladder = bucket_ladder(max_batch_rows)
@@ -193,6 +204,16 @@ class MicroBatcher:
         # here and are drained ahead of the normal lane — an SLO-bound
         # request must not wait behind a backlog of best-effort work
         self._qp: "collections.deque[Request]" = collections.deque()
+        # the best-effort shadow lane (ISSUE 19): quality-monitor
+        # oracle re-runs queue here and drain ONLY when both live lanes
+        # are empty. Its rows never count against ``max_queue_rows``
+        # (a full shadow lane must not backpressure live admission) —
+        # it is bounded separately by ``shadow_queue_rows`` with
+        # drop-oldest overflow, surfaced to the caller so generation
+        # pins ride out with the dropped samples.
+        self._qs: "collections.deque[Request]" = collections.deque()
+        self._shadow_cap = int(shadow_queue_rows)
+        self._shadow_rows = 0
         # per-bucket service-time samples (ms), fed back by the engine
         # after each dispatch; the deadline-aware linger reads their p95
         # (falling back to the dispatch table's serve_service medians —
@@ -276,6 +297,39 @@ class MicroBatcher:
             obs.gauge("serve.queue_depth", depth, index=self.name)
             obs.counter("serve.requests_total", index=self.name)
             return req.future
+
+    # graft-lint: allow-unspanned-entry shadow lane is off the latency path by contract; its only telemetry is the serve.shadow_* counters
+    def submit_shadow(self, req: Request) -> List[Request]:
+        """Enqueue a shadow-oracle sample on the best-effort lane
+        (ISSUE 19). Never raises and never backpressures live traffic:
+        past ``shadow_queue_rows`` the OLDEST queued samples are
+        dropped to make room (fresh samples estimate current quality;
+        stale ones estimate history). Returns the dropped requests —
+        ``req`` itself when the batcher is closed or the sample alone
+        exceeds the cap — so the caller can release their generation
+        pins and count the drops."""
+        dropped: List[Request] = []
+        with self._cond:
+            if self._closed or req.rows > self._shadow_cap:
+                return [req]
+            while self._qs and \
+                    self._shadow_rows + req.rows > self._shadow_cap:
+                old = self._qs.popleft()
+                self._shadow_rows -= old.rows
+                dropped.append(old)
+            self._qs.append(req)
+            self._shadow_rows += req.rows
+            self._cond.notify_all()
+        return dropped
+
+    def drain_shadow(self) -> List[Request]:
+        """Remove and return every queued shadow sample (close-time
+        cleanup: the caller releases their generation pins)."""
+        with self._cond:
+            leftovers = list(self._qs)
+            self._qs.clear()
+            self._shadow_rows = 0
+        return leftovers
 
     # -- knobs -------------------------------------------------------------
 
@@ -410,11 +464,23 @@ class MicroBatcher:
     def _next_batch(self) -> Optional[Batch]:
         with self._cond:
             while True:
-                while not self._q and not self._qp and not self._closed:
+                while not self._q and not self._qp and not self._qs \
+                        and not self._closed:
                     self._cond.wait(timeout=0.1)
                 lane = self._qp if self._qp else self._q
                 if not lane:
-                    return None                  # closed and drained
+                    if self._closed:
+                        # leftover shadow samples are NOT dispatched on
+                        # close — drain_shadow() hands them back so the
+                        # owner can release their pins
+                        return None              # closed and drained
+                    if self._qs:
+                        # both live lanes idle: drain one shadow batch
+                        # immediately, no linger — best-effort work
+                        # must never hold the lock waiting for more
+                        # best-effort work while live requests queue
+                        return self._drain_shadow_locked()
+                    continue                     # spurious wake
                 # linger: let the queue fill toward the ceiling, but
                 # never hold the head request past max_wait_ms — and
                 # never past a deadline request's slack: when the head's
@@ -506,6 +572,33 @@ class MicroBatcher:
         return Batch(requests=taken, rows=rows, bucket=bucket,
                      prefilter=head.prefilter, seq=self._seq,
                      linger_ms=linger_ms)
+
+    def _drain_shadow_locked(self) -> Batch:
+        """Drain one filter-homogeneous run off the shadow lane into a
+        ``shadow=True`` batch (caller holds ``_cond``). Deliberately
+        skips ALL live-lane bookkeeping — no ``_pending_rows``, no
+        fill-ratio/queue-wait series, no trace stages (shadow requests
+        carry no trace): the shadow lane must not perturb the signals
+        the live dispatcher and its SLOs are steered by."""
+        head = self._qs[0]
+        key = id(head.prefilter) if head.prefilter is not None else None
+        cap = max(self._ceiling, head.rows)
+        taken: List[Request] = []
+        rows = 0
+        while self._qs:
+            r = self._qs[0]
+            rk = id(r.prefilter) if r.prefilter is not None else None
+            if rk != key or (taken and rows + r.rows > cap):
+                break
+            taken.append(self._qs.popleft())
+            rows += r.rows
+        self._shadow_rows -= rows
+        bucket = choose_bucket(self.ladder, rows, ceiling=cap)
+        self._seq += 1
+        obs.counter("serve.shadow_batches_total", index=self.name)
+        return Batch(requests=taken, rows=rows, bucket=bucket,
+                     prefilter=head.prefilter, seq=self._seq,
+                     shadow=True)
 
 
 def pad_rows(queries: np.ndarray, bucket: int) -> np.ndarray:

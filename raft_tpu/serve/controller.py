@@ -79,6 +79,17 @@ class HelmParams:
     # waterfalls sampled per tick for saturated-stage attribution
     trace_window: int = 64
     retire_timeout_s: float = 30.0
+    # graft-gauge quality alarms (ISSUE 19): when on, each tick scrapes
+    # the fleet's federated recall estimates
+    # (:meth:`Fabric.recall_estimates`) and surfaces any pooled
+    # ``rung="all"`` CI upper bound under ``recall_band`` into the
+    # action journal as a ``quality_alarm`` — the helm does NOT act on
+    # it (retune/rollback live with the per-index QualityMonitor that
+    # owns the estimate); it makes the fleet-level breach visible where
+    # operators already watch membership actions. None band -> tuning
+    # budget serve_recall_band_bp (9000 = 0.90).
+    quality_alarms: bool = False
+    recall_band: Optional[float] = None
 
 
 class HelmController:
@@ -116,6 +127,10 @@ class HelmController:
         if cooldown is None:
             cooldown = tuning.budget("helm_cooldown_ms", 2000) / 1e3
         self._cooldown_s = float(cooldown)
+        band = p.recall_band
+        if band is None:
+            band = tuning.budget("serve_recall_band_bp", 9000) / 1e4
+        self._recall_band = float(band)
         # graft-race sanitizer node "helm.state" — sits above the
         # fabric's locks (step() holds it across fabric actions; the
         # fabric never calls back up)
@@ -154,6 +169,19 @@ class HelmController:
                 for kind, rank in actions:
                     self._actions_log.append(
                         {"t": now, "action": kind, "worker": rank})
+            if self.params.quality_alarms:
+                # OUTSIDE the state lock: the federated scrape RPCs a
+                # timeout's worth of workers — holding helm.state that
+                # long would stall manual scale/rebalance entry points
+                alarms = self._quality_alarms()
+                if alarms:
+                    now = time.monotonic()
+                    with self._lock:
+                        for kind, key in alarms:
+                            self._actions_log.append(
+                                {"t": now, "action": kind,
+                                 "worker": key})
+                    actions = actions + alarms
             obs.gauge("helm.workers", len(active))
             obs.gauge("helm.mean_inflight", round(mean_inflight, 4))
             for kind, rank in actions:
@@ -164,6 +192,29 @@ class HelmController:
             return {"actions": actions, "held": held,
                     "mean_inflight": mean_inflight,
                     "workers": len(active)}
+
+    def _quality_alarms(self) -> List[tuple]:
+        """Fleet-level recall breaches (graft-gauge, ISSUE 19): every
+        pooled (``rung="all"``) federated estimate whose CI upper bound
+        sits under the band. Surfaced, not acted on — the per-index
+        :class:`~raft_tpu.serve.quality.QualityMonitor` that owns the
+        estimate also owns the retune/rollback actuators."""
+        try:
+            ests = self.fabric.recall_estimates()
+        except BaseException as e:  # noqa: BLE001 — classified: a mute fleet scrape degrades the alarm, never the tick
+            _rerrors.classify(e)
+            return []
+        out: List[tuple] = []
+        for key, vals in sorted(ests.items()):
+            if not key.endswith("|all"):
+                continue
+            hi = vals.get("ci_high")
+            if hi is not None and float(hi) < self._recall_band:
+                out.append(("quality_alarm", key))
+                obs.event("helm_quality_alarm", key=key,
+                          ci_high=round(float(hi), 4),
+                          band=self._recall_band)
+        return out
 
     def _repair_locked(self, actions: List[tuple]) -> None:
         """Respawn dead workers while the restart budget lasts; evict

@@ -49,6 +49,7 @@ import numpy as np
 
 from raft_tpu import obs, tuning
 from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import config as _obs_config
 from raft_tpu.obs import trace as obs_trace
 from raft_tpu.core import pipeline as _pipeline
 from raft_tpu.core.bitset import Bitset
@@ -68,6 +69,7 @@ from raft_tpu.serve.batcher import (
     pad_rows,
 )
 from raft_tpu.serve.mutation import MutableState
+from raft_tpu.serve.quality import QualityMonitor
 from raft_tpu.serve.registry import Registry
 
 ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
@@ -139,6 +141,26 @@ class ServeParams:
     # reject with Overloaded(reason="quota") (transient).
     admission_quotas: Optional[Dict[str, int]] = None
     max_total_queue_rows: Optional[int] = None
+    # graft-gauge online quality control (ISSUE 19, docs/serving.md
+    # §14): sample this fraction of answered live requests onto the
+    # batcher's best-effort shadow lane, re-run them through the
+    # generation-pinned exhaustive oracle, and export windowed
+    # Wilson-interval recall estimates. 0 disables the whole subsystem
+    # (the delivery hook is then a single attribute read).
+    quality_sample_rate: float = 0.0
+    # stated recall floor the closed loop defends; None draws from
+    # tuning.budget("serve_recall_band_bp") (default 9000 = 0.90)
+    quality_band: Optional[float] = None
+    quality_window: int = 128        # samples per estimate window
+    quality_min_samples: int = 24    # no verdicts below this many
+    # actuators: margin/refine retune (bounded, with hysteresis) and
+    # post-swap probation rollback
+    quality_retune: bool = True
+    quality_rollback: bool = True
+    quality_max_retunes: int = 8
+    # shadow-lane row bound (drop-oldest past it; never backpressures
+    # live admission)
+    shadow_queue_rows: int = 256
     # graft-flow dispatch pipelining (docs/serving.md §12): the batcher
     # thread stops at ASYNC dispatch and hands the in-flight batch (a
     # ticket holding its pinned generation) to a per-index completion
@@ -226,14 +248,40 @@ class _Handle:
         verbatim. A rung override replaces only ``n_probes`` — the
         trace key is the VALUE, so the top rung dispatches the exact
         program the non-adaptive path compiled (bitwise escape
-        hatch)."""
-        if rung is None or self.adaptive is None:
+        hatch). A rung on a NON-adaptive ivf handle is the shadow
+        oracle's full-probe override (ISSUE 19) — same replace, same
+        trace-key-is-the-value discipline."""
+        if rung is None:
+            return self.search_params, self.pipeline_rr()
+        if self.adaptive is None:
+            if self.algo in ("ivf_flat", "ivf_pq"):
+                sp = dataclasses.replace(self.search_params,
+                                         n_probes=int(rung))
+                return sp, self.pipeline_rr()
             return self.search_params, self.pipeline_rr()
         pol = self.adaptive
         idx = pol.ladder.index(rung) if rung in pol.ladder \
             else len(pol.ladder) - 1
         sp = dataclasses.replace(self.search_params, n_probes=int(rung))
         return sp, pol.refine_for(idx)
+
+    def oracle_rung(self) -> Optional[int]:
+        """The shadow oracle's ground-truth rung (graft-gauge, ISSUE
+        19): the index's FULL probe count when the serving ceiling sits
+        below it, else None (the resolved exhaustive program already IS
+        the top tier). The distinction matters for the under-trained-
+        swap failure mode: a generation configured with a crippled
+        ``n_probes`` would otherwise be its own oracle and score its
+        own degraded answers as perfect. ivf_flat at ``n_lists`` probes
+        is exact over the filtered index whatever the training quality;
+        ivf_pq's refined pipeline reranks its shortlist with exact
+        distances — both outrank any ceiling a bad swap can configure.
+        brute_force/cagra have no probe axis to escalate."""
+        if self.algo not in ("ivf_flat", "ivf_pq"):
+            return None
+        n_lists = int(self.index.n_lists)
+        cur = int(getattr(self.search_params, "n_probes", n_lists))
+        return n_lists if n_lists > cur else None
 
     def raw_dev(self):
         """Device-resident raw row store (refine operand) — transferred
@@ -461,8 +509,13 @@ class _IndexServing:
             max_batch_rows=self.params.max_batch_rows,
             max_wait_ms=self.params.max_wait_ms,
             max_queue_rows=self.params.max_queue_rows,
+            shadow_queue_rows=self.params.shadow_queue_rows,
             name=name,
         )
+        # graft-gauge (ISSUE 19): None when disabled, so the delivery
+        # hook costs exactly one attribute read
+        self.quality = (QualityMonitor(self, name)
+                        if self.params.quality_sample_rate > 0 else None)
         # an OOM survivor recorded by an earlier server in this process
         # clamps the starting ceiling (same contract as the streaming
         # paths' budget names)
@@ -675,6 +728,9 @@ class _IndexServing:
         part retries/splits independently — a failure in one rung's
         sub-batch must not re-dispatch requests another rung already
         delivered."""
+        if batch.shadow:
+            self._dispatch_shadow(batch)
+            return
         for i, part in enumerate(self._partition(batch)):
             if i:
                 # later parts queued behind their siblings' device time:
@@ -708,6 +764,81 @@ class _IndexServing:
                                  error=type(e).__name__)
                 if not r.future.done():
                     r.future.set_exception(e)
+
+    def _dispatch_shadow(self, batch: Batch) -> None:
+        """graft-gauge's oracle re-run (ISSUE 19; docs/serving.md §14):
+        answer each shadow sample EXHAUSTIVELY on the generation that
+        served it, then hand the truth to the quality monitor for
+        scoring.
+
+        Trace discipline: the re-run is :meth:`_Handle.oracle_rung` —
+        the resolved exhaustive program when the ceiling is already the
+        full probe count, else the full-probe override warmup traced
+        alongside the ladder — over the same padded buckets and
+        k-ladder rungs as live dispatch, so a shadow batch can NEVER
+        mint a new XLA trace. It runs synchronously on the batcher thread, which is
+        idle by construction (the shadow lane only drains when both
+        live lanes are empty); a failure is counted and swallowed —
+        quality sampling must never take serving down with it."""
+        mon = self.quality
+        try:
+            if mon is None:
+                return
+            # group by pinned generation: a hot-swap between two
+            # samples' deliveries means one shadow batch can carry
+            # samples from two generations, each of which must be
+            # scored against ITS OWN index
+            groups: List[Tuple[object, List[Request]]] = []
+            for r in batch.requests:
+                gen = r.shadow.gen
+                if groups and groups[-1][0] is gen:
+                    groups[-1][1].append(r)
+                else:
+                    groups.append((gen, [r]))
+            for gen, reqs in groups:
+                h: _Handle = gen.handle
+                if h is None:      # impossible while pinned; belt+braces
+                    continue
+                st = h.state
+                with st.lock:
+                    if batch.prefilter is None:
+                        main_bits = st.tombstone_bits()
+                        side_bits = st.side_keep_bits()
+                    else:
+                        main_bits, side_bits = st.compose_user_filter(
+                            batch.prefilter)
+                    side_snap = h.side_snapshot_locked()
+                side_idx, side_ids = h.side_build(side_snap)
+                rows = sum(r.rows for r in reqs)
+                sub = Batch(
+                    requests=reqs, rows=rows,
+                    bucket=choose_bucket(self.batcher.ladder, rows,
+                                         ceiling=self.batcher.ceiling),
+                    prefilter=batch.prefilter, seq=batch.seq,
+                    rung=h.oracle_rung(), shadow=True)
+                with obs.span("serve.shadow_batch", index=self.name,
+                              bucket=sub.bucket, rows=rows,
+                              generation=gen.version):
+                    d, i = self._run_search(h, sub, main_bits,
+                                            side_bits, side_idx,
+                                            side_ids)
+                    jax.block_until_ready((d, i))
+                d = np.asarray(d)
+                i = np.asarray(i)
+                ext = st.translate_out(i.astype(np.int64)) \
+                    if st.has_translation else i
+                sent = np.inf if h.select_min else -np.inf
+                ext = np.where(d == sent, np.asarray(-1, ext.dtype),
+                               ext)
+                mon.score_batch(sub, ext)
+        except BaseException as e:  # noqa: BLE001 — quality is advisory: classify + count, never fail serving
+            _rerrors.classify(e)
+            obs.counter("serve.shadow_errors_total", index=self.name,
+                        error=type(e).__name__)
+        finally:
+            for r in batch.requests:
+                if r.shadow is not None:
+                    r.shadow.gen.release()
 
     def _downshift(self, new_ceiling: int) -> None:
         new_ceiling = max(int(new_ceiling), self.batcher.ladder[0])
@@ -997,6 +1128,13 @@ class _IndexServing:
         obs.observe("serve.batch_latency_ms", latency_ms,
                     buckets=_LAT_BUCKETS, index=self.name,
                     bucket=str(batch.bucket))
+        # graft-gauge sampling (ISSUE 19) — AFTER the futures resolved,
+        # so the client's latency never includes it. Disabled: one
+        # attribute read. Obs off: one module-attribute read (offer is
+        # never entered).
+        mon = self.quality
+        if mon is not None and _obs_config.ENABLED:
+            mon.offer(batch, gen, h, ext)
 
     # -- warmup ------------------------------------------------------------
 
@@ -1022,6 +1160,15 @@ class _IndexServing:
             rungs: List[Optional[int]] = [None]
             if h.adaptive is not None:
                 rungs += list(h.adaptive.ladder[:-1])
+            # graft-gauge (ISSUE 19): the shadow oracle's full-probe
+            # override is one more program per (bucket, k) — warmed
+            # here so a quality re-run can never retrace in steady
+            # state (the distinct-VALUE trace key rule: when the
+            # ceiling already equals n_lists, oracle_rung() is None
+            # and the exhaustive program above covers it)
+            orung = h.oracle_rung()
+            if self.quality is not None and orung is not None:
+                rungs.append(orung)
             for bucket in self.batcher.ladder:
                 if oom:
                     break
@@ -1243,7 +1390,20 @@ class Server:
         with self._lock:
             if self._closed:
                 raise RuntimeError("server is closed")
-            return self.registry.publish(name, h)
+            serving = self._servings.get(name)
+            mon = serving.quality if serving is not None else None
+            # graft-gauge swap probation (ISSUE 19): pin + baseline the
+            # outgoing generation BEFORE publish retires it — the
+            # rollback path needs its handle alive until the successor
+            # proves itself. Deliberately NOT hooked into compaction's
+            # direct registry.publish: a compaction folds the same
+            # content, so its predecessor is no quality baseline.
+            if mon is not None:
+                mon.before_publish()
+            gen = self.registry.publish(name, h)
+            if mon is not None:
+                mon.after_publish(gen)
+            return gen
 
     # -- the data plane ----------------------------------------------------
 
@@ -1669,6 +1829,9 @@ class Server:
             "probe_ladder": (list(handle.adaptive.ladder)
                              if handle is not None
                              and handle.adaptive is not None else None),
+            "quality": (serving.quality.stats()
+                        if serving is not None
+                        and serving.quality is not None else None),
         }
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -1685,6 +1848,12 @@ class Server:
             # drain the graft-flow completion queue so every in-flight
             # batch resolves its futures and releases its pin
             s.close_pipeline(timeout_s=timeout_s)
+        for s in servings:
+            # shadow samples still queued at close are dropped, not
+            # dispatched — their generation pins (and the probation
+            # pin) must release or the retired generations never drain
+            if s.quality is not None:
+                s.quality.close(s.batcher.drain_shadow())
         for name in self.registry.names():
             self.registry.drop(name)
 

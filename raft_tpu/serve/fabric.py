@@ -1285,6 +1285,32 @@ class Fabric:
         fed = self.collect_metrics()
         return obs_federation.render_prometheus(fed["metrics"])
 
+    def recall_estimates(self) -> Dict[str, dict]:
+        """The fleet's graft-gauge quality view (ISSUE 19): every
+        ``serve.recall_estimate`` / ``_ci_low`` / ``_ci_high`` series
+        from :meth:`collect_metrics`, regrouped per
+        ``(worker, index, rung)`` as ``{"estimate": ..., "ci_low": ...,
+        "ci_high": ...}``. The recall series federate like any other
+        registry metric — this just gives the helm/quality-alarm
+        consumers (and ``obs_report.py recall``) the stitched view
+        without re-walking the snapshot shape."""
+        fed = self.collect_metrics()
+        out: Dict[str, dict] = {}
+        fields = {"serve.recall_estimate": "estimate",
+                  "serve.recall_ci_low": "ci_low",
+                  "serve.recall_ci_high": "ci_high"}
+        for name, field in fields.items():
+            m = fed.get("metrics", {}).get(name)
+            if not m:
+                continue
+            for p in m.get("points", ()):
+                lab = p.get("labels", {})
+                key = "|".join((lab.get("worker", "router"),
+                                lab.get("index", "?"),
+                                lab.get("rung", "all")))
+                out.setdefault(key, {})[field] = p.get("value")
+        return out
+
     def stats(self) -> dict:
         with self._stats_lock:
             counters = dict(self._counters)

@@ -1,0 +1,493 @@
+"""graft-gauge: online recall estimation + closed-loop quality control
+(ISSUE 19; docs/serving.md §14).
+
+Recall is the metric this reproduction exists to serve, yet until now it
+was only ever measured OFFLINE (the ann-bench harness) — rungs were
+calibrated once and trusted forever while drift arrived with the data
+distribution, the mutation load, and every hot-swap. graft-gauge closes
+that gap with a shadow-oracle lane:
+
+* **sampling** — :meth:`QualityMonitor.offer` runs at delivery for
+  every answered live batch and picks ~``quality_sample_rate`` of the
+  requests by a deterministic counter stride (no RNG, no allocation on
+  the skip path). A sampled request's queries + SERVED ids are copied
+  and queued on the batcher's best-effort shadow lane with an extra pin
+  on the generation that answered — a hot-swap between sampling and
+  re-run cannot re-point the oracle at a different index, so the score
+  is always "what we served" vs "that same generation's exact answer";
+* **the oracle** — the engine re-runs each shadow batch through
+  :meth:`_IndexServing._run_search` at ``rung=None``: the exhaustive
+  top rung, the very program warmup already traced for every
+  (bucket, k) — so the shadow lane adds ZERO steady-state traces and
+  only ever runs when both live lanes are idle;
+* **estimation** — per-slot matches aggregate into a sliding window of
+  (matched, slots) counts per probe rung; each scored batch refreshes
+  Wilson score intervals exported as ``serve.recall_estimate`` /
+  ``serve.recall_ci_low`` / ``serve.recall_ci_high`` gauges (per rung
+  plus the pooled ``rung="all"``) and a ``serve.recall_sample``
+  histogram on the unit-interval buckets — all of which federate
+  across a fabric exactly like every other registry series;
+* **the closed loop** — when the pooled CI's UPPER bound drops below
+  the stated recall band, quality is degraded beyond statistical doubt:
+  a post-swap probation window whose estimate also degrades versus the
+  predecessor's rolls the swap back
+  (:meth:`raft_tpu.serve.registry.Registry.rollback`); otherwise the
+  generation's :class:`~raft_tpu.serve.adaptive.AdaptivePolicy` is
+  retuned one bounded step toward recall
+  (:meth:`~raft_tpu.serve.adaptive.AdaptivePolicy.tightened`), with
+  cooldown windows between steps and a hysteresis band before any
+  relax — no human in the loop.
+
+Everything here is OFF the latency path: with
+``quality_sample_rate=0`` the delivery hook is one attribute read; with
+obs off the sampling decision is one module-attribute read; shadow
+re-runs ride the best-effort lane that only drains when no live work is
+queued.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from concurrent.futures import Future
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.analysis import lockwatch
+from raft_tpu.obs import config as _obs_config
+from raft_tpu.serve.batcher import Batch, Request
+from raft_tpu.serve.registry import Generation
+
+# recall band default in BASIS POINTS (the unit tuning budgets carry):
+# below 0.90 pooled recall the closed loop acts
+DEFAULT_RECALL_BAND_BP = 9000
+
+# normal z for the 95% Wilson score interval
+_WILSON_Z = 1.96
+
+# CI-low must clear band + hysteresis before a relax step — without the
+# dead zone the loop would tighten/relax forever around the band edge
+RELAX_HYSTERESIS = 0.02
+
+# a successor must estimate this far under its predecessor before the
+# degradation reads as "the swap did it" rather than noise
+ROLLBACK_MARGIN = 0.02
+
+
+def wilson_interval(successes: float, trials: float,
+                    z: float = _WILSON_Z) -> Tuple[float, float]:
+    """The Wilson score interval for a binomial proportion — the small-n
+    honest version of the normal approximation: never escapes [0, 1]
+    and stays informative at the handful-of-samples scale a 0.1%%
+    shadow lane starts from."""
+    n = float(trials)
+    if n <= 0:
+        return 0.0, 1.0
+    p = min(max(float(successes) / n, 0.0), 1.0)
+    z2 = z * z
+    denom = 1.0 + z2 / n
+    center = (p + z2 / (2.0 * n)) / denom
+    half = (z / denom) * math.sqrt(
+        p * (1.0 - p) / n + z2 / (4.0 * n * n))
+    return max(0.0, center - half), min(1.0, center + half)
+
+
+class ShadowSample:
+    """One sampled request's scoring payload, carried through the
+    shadow lane on :attr:`Request.shadow`: the pinned generation that
+    served it, the probe rung it served at, and the ids the client
+    actually received. The pin is THIS sample's: released after
+    scoring, on drop-oldest overflow, and at close."""
+
+    __slots__ = ("gen", "rung", "served", "k")
+
+    def __init__(self, gen: Generation, rung: Optional[int],
+                 served: np.ndarray, k: int):
+        self.gen = gen
+        self.rung = rung
+        self.served = served
+        self.k = int(k)
+
+
+def _rung_label(rung: Optional[int]) -> str:
+    return "exhaustive" if rung is None else str(rung)
+
+
+class QualityMonitor:
+    """Per-index online recall estimator + quality-control actuator.
+
+    Created by the engine's serving unit when
+    ``ServeParams.quality_sample_rate > 0``; all entry points are
+    internal to serving:
+
+    * :meth:`offer` — the delivery-side sampler (batcher or completion
+      thread);
+    * :meth:`score_batch` — called by the engine's shadow dispatch with
+      the oracle's answers;
+    * :meth:`before_publish` / :meth:`after_publish` — the swap
+      probation hooks (pin the predecessor, baseline its estimate);
+    * :meth:`stats` — the introspection surface ``Server.stats`` and
+      the drift drill read.
+    """
+
+    def __init__(self, serving, name: str):
+        self.serving = serving
+        self.name = name
+        p = serving.params
+        rate = float(p.quality_sample_rate)
+        # deterministic stride sampling: request j is sampled iff
+        # j % stride == 0 — no RNG state, nothing allocated per skip
+        self.stride = max(1, int(round(1.0 / rate))) if rate > 0 else 0
+        band = p.quality_band
+        if band is None:
+            from raft_tpu import tuning
+
+            band = tuning.budget("serve_recall_band_bp",
+                                 DEFAULT_RECALL_BAND_BP) / 1e4
+        self.band = float(band)
+        self.window = max(int(p.quality_window), 8)
+        self.min_samples = max(int(p.quality_min_samples), 4)
+        self.retune_enabled = bool(p.quality_retune)
+        self.rollback_enabled = bool(p.quality_rollback)
+        self.max_retunes = int(p.quality_max_retunes)
+        # graft-race sanitizer node "serve.quality" — below the engine
+        # lock (publish hooks run under it), above registry/generation
+        self._lock = lockwatch.make_lock("serve.quality")
+        self._tick = 0
+        # sliding sample window: (matched_slots, total_slots, rung_label)
+        self._samples: Deque[Tuple[int, int, str]] = collections.deque(
+            maxlen=self.window)
+        self._since_action = 0          # samples since the last retune
+        self._est: Optional[Tuple[float, float, float, int]] = None
+        # retune state: the policy the current generation STARTED with,
+        # so step n is base.tightened()^n and a relax is exactly n-1
+        self._base_policy = None
+        self._base_version: Optional[int] = None
+        self._steps = 0
+        # a refine-ladder retune's re-warm, handed out of the lock by
+        # score_batch (warmup acquires the mutation-state lock)
+        self._deferred_rewarm = None
+        # swap probation: a pin + baseline on the predecessor until the
+        # successor proves itself (or degrades and is rolled back)
+        self._prev_gen: Optional[Generation] = None
+        self._prev_est: Optional[float] = None
+        self._succ_version: Optional[int] = None
+        self._succ_samples = 0
+        self._closed = False
+        # action log for the drill / stats: (kind, detail) tuples
+        self.actions: Deque[Tuple[str, dict]] = collections.deque(
+            maxlen=64)
+
+    # -- sampling (the delivery hook) --------------------------------------
+
+    def offer(self, batch: Batch, gen: Generation, h,
+              ext: np.ndarray) -> None:
+        """Sample answered requests out of a delivered live batch onto
+        the shadow lane. Called by ``_deliver`` AFTER the futures
+        resolve — the client's latency never includes this. The skip
+        path is a counter increment and a modulo per request; only a
+        sampled hit copies its queries/ids and takes a pin."""
+        if not _obs_config.ENABLED or self.stride <= 0:
+            return
+        picked: List[Tuple[Request, int]] = []
+        with self._lock:
+            if self._closed:
+                return
+            row = 0
+            for r in batch.requests:
+                self._tick += 1
+                if self._tick % self.stride == 0:
+                    picked.append((r, row))
+                row += r.rows
+        if not picked:
+            return
+        # the copies + pins happen OUTSIDE the monitor lock: nothing
+        # here races (the slices are this thread's delivery arrays)
+        for r, start in picked:
+            served = np.array(ext[start:start + r.rows, :r.k],
+                              copy=True)
+            try:
+                gen.pin()
+            except RuntimeError:
+                continue       # drained under us: sample dies unscored
+            sample = ShadowSample(gen, batch.rung, served, r.k)
+            req = Request(
+                queries=np.array(r.queries, copy=True, dtype=h.dtype),
+                k=r.k, prefilter=batch.prefilter, future=Future(),
+                shadow=sample)
+            dropped = self.serving.batcher.submit_shadow(req)
+            for dr in dropped:
+                dr.shadow.gen.release()
+            if dropped:
+                obs.counter("serve.shadow_dropped_total", len(dropped),
+                            index=self.name)
+
+    # -- scoring (the shadow-dispatch callback) ----------------------------
+
+    def score_batch(self, batch: Batch, oracle_ext: np.ndarray) -> None:
+        """Score each shadow sample's SERVED ids against the oracle's
+        exhaustive answer and fold the counts into the estimate window.
+        recall@k per row = |served ∩ oracle| / |oracle's valid slots|
+        (masked ``-1`` slots — tombstoned / beyond the live row count —
+        count for neither side)."""
+        row = 0
+        scored = 0
+        with self._lock:
+            if self._closed:
+                return
+            for r in batch.requests:
+                s: ShadowSample = r.shadow
+                matched = 0
+                slots = 0
+                for j in range(r.rows):
+                    truth = oracle_ext[row + j, :s.k]
+                    truth = set(int(x) for x in truth if int(x) >= 0)
+                    got = set(int(x) for x in s.served[j] if int(x) >= 0)
+                    matched += len(got & truth)
+                    slots += max(len(truth), 1)
+                row += r.rows
+                self._samples.append(
+                    (matched, slots, _rung_label(s.rung)))
+                self._since_action += 1
+                self._succ_samples += 1
+                scored += 1
+                obs.observe("serve.recall_sample",
+                            matched / slots if slots else 0.0,
+                            buckets=obs.UNIT_BUCKETS, index=self.name,
+                            rung=_rung_label(s.rung))
+            if scored:
+                obs.counter("serve.shadow_samples_total", scored,
+                            index=self.name)
+                self._update_estimates_locked()
+                self._act_locked()
+            rewarm = self._deferred_rewarm
+            self._deferred_rewarm = None
+        # the refine-ladder re-warm acquires the mutation-state lock
+        # (warmup snapshots tombstone bits); run it AFTER releasing the
+        # monitor lock or the quality->mutation edge closes a GL013
+        # cycle with _publish_guarded (engine->quality) and compaction
+        # (mutation->engine)
+        if rewarm is not None and self.serving.warmup_enabled:
+            self.serving.warmup_handle(rewarm)
+
+    def _update_estimates_locked(self) -> None:
+        by_rung: Dict[str, List[int]] = {}
+        for matched, slots, rung in self._samples:
+            agg = by_rung.setdefault(rung, [0, 0])
+            agg[0] += matched
+            agg[1] += slots
+        total = [0, 0]
+        for matched, slots in by_rung.values():
+            total[0] += matched
+            total[1] += slots
+        for rung, (matched, slots) in list(by_rung.items()) + \
+                [("all", tuple(total))]:
+            if not slots:
+                continue
+            est = matched / slots
+            lo, hi = wilson_interval(matched, slots)
+            obs.gauge("serve.recall_estimate", est, index=self.name,
+                      rung=rung)
+            obs.gauge("serve.recall_ci_low", lo, index=self.name,
+                      rung=rung)
+            obs.gauge("serve.recall_ci_high", hi, index=self.name,
+                      rung=rung)
+            if rung == "all":
+                self._est = (est, lo, hi, slots)
+
+    # -- the closed loop ---------------------------------------------------
+
+    def _act_locked(self) -> None:
+        if self._est is None or len(self._samples) < self.min_samples:
+            return
+        est, lo, hi, _slots = self._est
+        degraded = hi < self.band
+        if (self._prev_gen is not None and not degraded
+                and self._succ_samples >= self.window):
+            # the successor held the band for a full window of its own
+            # samples: probation over, the predecessor may drain (its
+            # device arrays are only as free as this pin)
+            self._clear_probation_locked()
+        if degraded:
+            obs.event("recall_alarm", index=self.name,
+                      estimate=round(est, 4), ci_high=round(hi, 4),
+                      band=self.band)
+        if degraded and self._rollback_due_locked(hi):
+            self._rollback_locked(est, hi)
+            return
+        if degraded and self.retune_enabled:
+            if (self._since_action >= self.min_samples
+                    and self._steps < self.max_retunes):
+                self._retune_locked("tighten", est, hi)
+            return
+        if (not degraded and self.retune_enabled and self._steps > 0
+                and lo > self.band + RELAX_HYSTERESIS
+                and self._since_action >= self.window):
+            self._retune_locked("relax", est, hi)
+
+    def _rollback_due_locked(self, ci_high: float) -> bool:
+        """A degraded estimate is pinned on the SWAP (not drift) when a
+        probation window is open, the successor has enough of its own
+        samples, and the predecessor's baseline was measurably
+        better."""
+        if not self.rollback_enabled or self._prev_gen is None:
+            return False
+        if self._succ_samples < self.min_samples:
+            return False
+        if self._prev_est is None:
+            # no pre-swap estimate to compare against: the band breach
+            # alone convicts the swap — the predecessor served inside
+            # the band long enough that no alarm ever fired
+            return True
+        return ci_high < self._prev_est - ROLLBACK_MARGIN
+
+    def _rollback_locked(self, est: float, hi: float) -> None:
+        prev = self._prev_gen
+        registry = self.serving.registry
+        try:
+            new = registry.rollback(self.name, prev)
+        except (ValueError, KeyError):
+            # predecessor drained in the window (e.g. compaction
+            # retired it): nothing left to restore — fall through to
+            # the retune path on the next scored batch
+            self._clear_probation_locked()
+            return
+        self.actions.append(("rollback", {
+            "to_version": prev.version, "version": new.version,
+            "estimate": round(est, 4), "ci_high": round(hi, 4),
+            "prev_estimate": self._prev_est}))
+        self._clear_probation_locked()
+        # fresh verdicts for the restored generation
+        self._samples.clear()
+        self._est = None
+        self._since_action = 0
+        self._base_policy = None
+        self._steps = 0
+
+    def _retune_locked(self, direction: str, est: float,
+                       hi: float) -> None:
+        cur = self.serving.registry.get(self.name)
+        h = cur.handle if cur is not None else None
+        if h is None or h.adaptive is None:
+            return          # nothing to actuate on a non-adaptive index
+        if self._base_policy is None or \
+                self._base_version != cur.version:
+            self._base_policy = h.adaptive
+            self._base_version = cur.version
+            self._steps = 0
+        self._steps += 1 if direction == "tighten" else -1
+        self._steps = max(self._steps, 0)
+        pol = self._base_policy
+        for _ in range(self._steps):
+            pol = pol.tightened()
+        old_refines = h.adaptive.refine_ladder()
+        h.adaptive = pol
+        # a margin retune only reweights already-warmed rungs; the
+        # refine_ratio bump is the one shape-bearing change — re-warm
+        # exactly then (the upsert growth precedent), or the next
+        # shadow/live batch at the new over-fetch would retrace. The
+        # warmup itself is DEFERRED to score_batch's unlock (lock
+        # order: warmup takes the mutation-state lock)
+        if pol.refine_ladder() != old_refines:
+            self._deferred_rewarm = h
+        obs.counter("serve.recall_retunes_total", index=self.name,
+                    direction=direction)
+        obs.event("recall_retune", index=self.name, direction=direction,
+                  step=self._steps, estimate=round(est, 4),
+                  ci_high=round(hi, 4),
+                  easy_margin=round(pol.easy_margin, 5),
+                  floor_margin=round(pol.floor_margin, 5),
+                  refine_ratio=pol.refine_ratio)
+        self.actions.append((direction, {
+            "step": self._steps, "estimate": round(est, 4),
+            "easy_margin": round(pol.easy_margin, 5),
+            "floor_margin": round(pol.floor_margin, 5),
+            "refine_ratio": pol.refine_ratio}))
+        # verdicts must come from POST-retune samples only
+        self._samples.clear()
+        self._est = None
+        self._since_action = 0
+
+    # -- swap probation hooks (called by Server._publish_guarded) ----------
+
+    def before_publish(self) -> None:
+        """Pin the outgoing generation and baseline its estimate BEFORE
+        the registry retires it — after publish its refcount may
+        already be zero and the handle gone."""
+        prev = self.serving.registry.get(self.name)
+        with self._lock:
+            if self._closed or prev is None:
+                return
+            try:
+                prev.pin()
+            except RuntimeError:
+                return
+            if self._prev_gen is not None:
+                self._prev_gen.release()
+            self._prev_gen = prev
+            self._prev_est = (self._est[0] if self._est is not None
+                              and len(self._samples) >= self.min_samples
+                              else None)
+
+    def after_publish(self, gen: Generation) -> None:
+        """Reset the estimator for the successor: its quality verdicts
+        must come from its own samples, and its retune base is its own
+        freshly-derived policy."""
+        with self._lock:
+            self._succ_version = gen.version
+            self._succ_samples = 0
+            self._samples.clear()
+            self._est = None
+            self._since_action = 0
+            self._base_policy = None
+            self._steps = 0
+
+    def _clear_probation_locked(self) -> None:
+        if self._prev_gen is not None:
+            self._prev_gen.release()
+            self._prev_gen = None
+        self._prev_est = None
+        self._succ_samples = 0
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def release_samples(self, reqs: List[Request]) -> None:
+        """Release the generation pins of shadow requests that will
+        never be scored (batcher overflow hand-back, close-time
+        drain)."""
+        for r in reqs:
+            if r.shadow is not None:
+                r.shadow.gen.release()
+        if reqs:
+            obs.counter("serve.shadow_dropped_total", len(reqs),
+                        index=self.name)
+
+    def close(self, leftovers: Optional[List[Request]] = None) -> None:
+        with self._lock:
+            self._closed = True
+            self._clear_probation_locked()
+        if leftovers:
+            self.release_samples(leftovers)
+
+    def stats(self) -> dict:
+        with self._lock:
+            est = self._est
+            return {
+                "band": self.band,
+                "samples": len(self._samples),
+                "estimate": None if est is None else round(est[0], 4),
+                "ci_low": None if est is None else round(est[1], 4),
+                "ci_high": None if est is None else round(est[2], 4),
+                "slots": None if est is None else est[3],
+                "retune_steps": self._steps,
+                "probation_open": self._prev_gen is not None,
+                "actions": [list(a) for a in self.actions],
+            }
+
+
+__all__ = [
+    "DEFAULT_RECALL_BAND_BP", "QualityMonitor", "RELAX_HYSTERESIS",
+    "ROLLBACK_MARGIN", "ShadowSample", "wilson_interval",
+]

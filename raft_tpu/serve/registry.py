@@ -63,6 +63,20 @@ class Generation:
         self._refs += 1
         return self
 
+    def pin(self) -> "Generation":
+        """Take an ADDITIONAL pin on a generation the caller already
+        holds alive (graft-gauge's shadow samples and swap-probation
+        holds, ISSUE 19). Unlike :meth:`Registry.pin` this does not
+        re-resolve the name — the whole point is to keep THIS
+        generation, current or retired, from draining. Raises if the
+        generation already drained (there is no handle left to keep)."""
+        with self._lock:
+            if self.drained.is_set():
+                raise RuntimeError(
+                    f"generation v{self.version} of {self.name!r} "
+                    "already drained")
+            return self._pin_locked()
+
     def release(self) -> None:
         """Drop one pin; the last release of a retired generation drains
         it (fires ``drained`` + callbacks, drops the handle)."""
@@ -192,6 +206,29 @@ class Registry:
             obs.gauge("serve.generations_live", live_n)
             obs.event("generation_published", index=name, version=v)
             return gen
+
+    def rollback(self, name: str, gen: Generation,
+                 on_drain: Optional[Callable] = None) -> Generation:
+        """Republish ``gen``'s handle as a NEW generation of ``name`` —
+        the recall-alarm rollback path (graft-gauge, ISSUE 19): a
+        hot-swap whose post-publish recall estimate degrades versus its
+        predecessor's is reverted by re-promoting the predecessor's
+        handle. The caller must still hold a pin on ``gen`` (the
+        quality monitor's probation pin) — a drained generation has no
+        handle left to serve, and this raises then. Versions stay
+        monotone: the rollback is a fresh generation wrapping the old
+        handle, so in-flight batches on the degraded generation finish
+        on their pins exactly like any other swap."""
+        handle = gen.handle
+        if handle is None or gen.drained.is_set():
+            raise ValueError(
+                f"cannot roll back {name!r} to v{gen.version}: "
+                "generation already drained")
+        new = self.publish(name, handle, on_drain=on_drain)
+        obs.counter("serve.recall_rollbacks_total", index=name)
+        obs.event("generation_rolled_back", index=name,
+                  version=new.version, restored_version=gen.version)
+        return new
 
     def drop(self, name: str) -> None:
         """Unpublish ``name`` (retire its current generation)."""

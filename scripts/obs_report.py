@@ -19,11 +19,21 @@ Three subcommands over flight-recorder JSONL dumps and
 * ``stitch FILE...`` — group span/error/waterfall events from MANY
   dumps (router + each worker process) by trace id: the cross-process
   post-mortem view one flight dump per process cannot give alone.
+* ``recall FILE...`` — the graft-gauge quality timeline (ISSUE 19;
+  docs/serving.md §14): every ``serve.recall_estimate`` point with its
+  Wilson band (``serve.recall_ci_low``/``_ci_high``) per (worker,
+  index, rung), drawn as an ASCII confidence-band strip. Flight dumps
+  give the full timeline (each gauge write is a ``kind="metric"``
+  event); snapshot sidecars (including federated ones, whose points
+  carry ``worker`` labels) each contribute their final point.
+  ``--band X`` marks the stated recall band and flags proven breaches
+  (``ci_high < band``); ``--json PATH`` dumps the points.
 
 Examples:
     python scripts/obs_report.py waterfall OBS_r13/flight-*.jsonl
     python scripts/obs_report.py federate OBS_r13/*.obs.json --json FED.json
     python scripts/obs_report.py stitch OBS_r13/flight-*.jsonl --trace 1a2b.3c.4
+    python scripts/obs_report.py recall flight-*.jsonl --band 0.9
 """
 
 from __future__ import annotations
@@ -230,6 +240,156 @@ def cmd_stitch(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# recall timeline (graft-gauge, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+_RECALL_EST = "serve.recall_estimate"
+_RECALL_LO = "serve.recall_ci_low"
+_RECALL_HI = "serve.recall_ci_high"
+
+
+def _source_label(path: str) -> str:
+    label = os.path.splitext(os.path.basename(path))[0]
+    return label[:-4] if label.endswith(".obs") else label
+
+
+def recall_points(paths: List[str]) -> List[dict]:
+    """Every recall-estimate point the artifacts hold, as
+    ``{"t", "worker", "index", "rung", "estimate", "ci_low",
+    "ci_high"}`` rows sorted by series then time.
+
+    Flight JSONL dumps yield the full timeline: the monitor writes the
+    three gauges together (estimate, ci_low, ci_high — in that order),
+    so a point closes on each ``ci_high`` metric event. Snapshot
+    sidecars yield their single last-value point per series; a
+    federated sidecar's ``worker`` label wins over the filename."""
+    points: List[dict] = []
+    for path in paths:
+        src = _source_label(path)
+        if path.endswith(".jsonl"):
+            open_pts: Dict[tuple, dict] = {}
+            for evt in load_events(path):
+                if evt.get("kind") != "metric":
+                    continue
+                name = evt.get("name")
+                if name not in (_RECALL_EST, _RECALL_LO, _RECALL_HI):
+                    continue
+                lbl = evt.get("labels") or {}
+                key = (str(lbl.get("worker", src)),
+                       str(lbl.get("index")), str(lbl.get("rung")))
+                d = open_pts.setdefault(key, {})
+                d[name] = float(evt.get("value", 0.0))
+                d["t"] = evt.get("t")
+                if name == _RECALL_HI and _RECALL_EST in d:
+                    points.append({
+                        "t": d.get("t"), "worker": key[0],
+                        "index": key[1], "rung": key[2],
+                        "estimate": d.get(_RECALL_EST),
+                        "ci_low": d.get(_RECALL_LO),
+                        "ci_high": d.get(_RECALL_HI)})
+                    open_pts[key] = {}
+        else:
+            try:
+                with open(path) as fp:
+                    data = json.load(fp)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(data, dict):
+                continue
+            t = data.get("time_unix")
+            metrics = data.get("metrics", {})
+            series: Dict[tuple, dict] = {}
+            for name in (_RECALL_EST, _RECALL_LO, _RECALL_HI):
+                entry = metrics.get(name) or {}
+                for pt in entry.get("points", []):
+                    lbl = pt.get("labels") or {}
+                    key = (str(lbl.get("worker", src)),
+                           str(lbl.get("index")), str(lbl.get("rung")))
+                    series.setdefault(key, {})[name] = pt.get("value")
+            for key, d in series.items():
+                if _RECALL_EST not in d:
+                    continue
+                points.append({
+                    "t": t, "worker": key[0], "index": key[1],
+                    "rung": key[2], "estimate": d.get(_RECALL_EST),
+                    "ci_low": d.get(_RECALL_LO),
+                    "ci_high": d.get(_RECALL_HI)})
+    points.sort(key=lambda p: (p["worker"], p["index"], p["rung"],
+                               p["t"] or 0.0))
+    return points
+
+
+def render_recall_strip(pts: List[dict], band: Optional[float],
+                        width: int = BAR_WIDTH) -> str:
+    """One series' timeline: a row per point with the Wilson band drawn
+    as ``[-----*----]`` over a fixed axis from the series' CI floor to
+    1.0 (recall's natural ceiling), the band threshold as ``|``, and
+    proven breaches (``ci_high < band``) flagged."""
+    floor = min([p["ci_low"] for p in pts
+                 if p.get("ci_low") is not None] + [band or 1.0])
+    floor = max(0.0, min(floor - 0.02, 0.98))
+    span = 1.0 - floor
+
+    def col(v: float) -> int:
+        return max(0, min(width - 1,
+                          int(round((v - floor) / span * (width - 1)))))
+
+    t0 = next((p["t"] for p in pts if p["t"] is not None), 0.0) or 0.0
+    lines = [f"  axis [{floor:.2f} .. 1.00]"
+             + (f"  band={band:.2f}" if band is not None else "")]
+    for p in pts:
+        cells = [" "] * width
+        if band is not None:
+            cells[col(band)] = "|"
+        lo, hi, est = p.get("ci_low"), p.get("ci_high"), p["estimate"]
+        if lo is not None and hi is not None:
+            for c in range(col(lo), col(hi) + 1):
+                cells[c] = "-"
+            cells[col(lo)] = "["
+            cells[col(hi)] = "]"
+        cells[col(est)] = "*"
+        t_txt = (f"{p['t'] - t0:8.2f}s" if p["t"] is not None
+                 else "       ? ")
+        ci_txt = ("" if lo is None or hi is None
+                  else f"  [{lo:.4f}, {hi:.4f}]")
+        breach = (" ALARM" if band is not None and hi is not None
+                  and hi < band else "")
+        lines.append(f"  {t_txt} {''.join(cells)} "
+                     f"{est:.4f}{ci_txt}{breach}")
+    return "\n".join(lines)
+
+
+def cmd_recall(args) -> int:
+    points = recall_points(args.files)
+    if args.index:
+        points = [p for p in points if p["index"] == args.index]
+    if args.rung:
+        points = [p for p in points if p["rung"] == args.rung]
+    if not points:
+        print("no recall-estimate points found (is the quality lane "
+              "on? serve.quality_sample_rate > 0, RAFT_TPU_OBS=flight "
+              "for timelines)", file=sys.stderr)
+        return 1
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump({"points": points}, fp, indent=1, default=str)
+            fp.write("\n")
+        print(f"wrote {args.json}", file=sys.stderr)
+    groups: Dict[tuple, List[dict]] = {}
+    for p in points:
+        groups.setdefault((p["worker"], p["index"], p["rung"]),
+                          []).append(p)
+    for (worker, index, rung), pts in sorted(groups.items()):
+        pts = pts[-args.limit:]
+        print(f"recall estimate  worker={worker}  index={index}  "
+              f"rung={rung}  ({len(pts)} point(s))")
+        print(render_recall_strip(pts, args.band))
+        print()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="obs_report", description=__doc__.splitlines()[0])
@@ -257,6 +417,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     st.add_argument("files", nargs="+")
     st.add_argument("--trace", default=None)
     st.set_defaults(fn=cmd_stitch)
+
+    rc = sub.add_parser("recall",
+                        help="graft-gauge recall timeline with CI bands")
+    rc.add_argument("files", nargs="+")
+    rc.add_argument("--index", default=None, help="filter to one index")
+    rc.add_argument("--rung", default=None,
+                    help='filter to one rung label (e.g. "all")')
+    rc.add_argument("--band", type=float, default=None,
+                    help="stated recall band: drawn on the axis, "
+                         "proven breaches (ci_high < band) flagged")
+    rc.add_argument("--limit", type=int, default=32,
+                    help="render at most the newest N points per series")
+    rc.add_argument("--json", default=None,
+                    help="also dump the points as JSON here")
+    rc.set_defaults(fn=cmd_recall)
 
     args = ap.parse_args(argv)
     return args.fn(args)

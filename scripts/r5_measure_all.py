@@ -141,6 +141,19 @@ OPTIONAL_STAGES = [
       "--concurrency", "8", "--duration-s", "30", "--k", "1,10",
       "--out", "SERVE_TIERED_r12.json",
       "--merge-into", "TIERED_r12.json"], 1200),
+    # graft-gauge acceptance (ISSUE 19, ROADMAP item 9): the closed-
+    # loop quality drill — a loose-margin retune-recovery leg (seeded
+    # serve_probe_margin/floor budgets, bounded tighten steps walk the
+    # pooled Wilson estimate back inside the band), then a crippled
+    # n_probes=1 hot-swap the probation window convicts and rolls
+    # back. Flags match the committed QUALITY_r19.json so the stage
+    # REPRODUCES the artifact (zero-retrace columns re-checked at TPU
+    # service times on chip day)
+    ("quality_drift",
+     [PY, "scripts/serve_loadgen.py", "--drift", "--n", "1024",
+      "--dim", "16", "--n-lists", "16", "--k", "8",
+      "--query-pool", "256", "--duration-s", "30", "--seed", "7",
+      "--out", "QUALITY_r19.json"], 1200),
     # graft-flow acceptance (ISSUE 16): serial vs pipelined memmap
     # tiered rerank under injected slow fetch — wall-clock speedup,
     # stall totals, overlap fraction, bitwise verdict (PIPE_r16.json;

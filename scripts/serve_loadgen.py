@@ -197,6 +197,24 @@ def main() -> int:
     ap.add_argument("--n-lists", type=int, default=16,
                     help="IVF lists for the SLO-mode index (the "
                          "exhaustive baseline probes all of them)")
+    ap.add_argument("--drift", action="store_true",
+                    help="the graft-gauge quality drill (ISSUE 19): a "
+                         "loose-margin retune-recovery leg, then a "
+                         "crippled-swap probation-rollback leg, both "
+                         "closed loop against the shadow-oracle recall "
+                         "estimator (docs/serving.md §14) — emits the "
+                         "QUALITY_r19.json acceptance artifact")
+    ap.add_argument("--quality-rate", type=float, default=1.0,
+                    help="shadow-oracle sample rate for --drift")
+    ap.add_argument("--quality-band", type=float, default=0.9,
+                    help="recall band the --drift monitor defends")
+    ap.add_argument("--drift-margin-bp", type=int, default=100,
+                    help="loosened serve_probe_margin budget (basis "
+                         "points) the retune leg starts from — low "
+                         "enough that ambiguous queries read as easy")
+    ap.add_argument("--drift-floor-bp", type=int, default=50,
+                    help="loosened serve_probe_floor budget (bp) for "
+                         "the retune leg")
     ap.add_argument("--out", default=None,
                     help="report path (default SERVE_r05.json, or "
                          "FABRIC_r13.json with --fabric)")
@@ -235,6 +253,10 @@ def main() -> int:
         if obs.mode() == "off" and not os.environ.get("RAFT_TPU_OBS"):
             obs.set_mode("on")    # rung/shed/miss counters feed the report
         return _run_slo(args, ks, rng, obs, serve)
+    if args.drift:
+        if obs.mode() == "off" and not os.environ.get("RAFT_TPU_OBS"):
+            obs.set_mode("on")    # the recall gauges ARE the drill signal
+        return _run_drift(args, ks, rng, obs, serve)
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
 
     if args.out is None:
@@ -712,6 +734,230 @@ def _run_slo(args, ks, rng, obs, serve) -> int:
           flush=True)
     print(f"wrote {out} (measured {report['date']})", flush=True)
     return 0
+
+
+def _run_drift(args, ks, rng, obs, serve) -> int:
+    """The graft-gauge closed-loop quality drill (ISSUE 19; ROADMAP
+    item 9 acceptance; docs/serving.md §14): two legs over clustered
+    data with hard between-cluster queries, one per actuator of the
+    online recall estimator.
+
+    * **retune leg** — the ``serve_probe_margin``/``serve_probe_floor``
+      budgets are seeded DOWN to ``--drift-margin-bp`` /
+      ``--drift-floor-bp``, so the adaptive policy reads ambiguous
+      queries as easy and serves them at the minimum rung; the pooled
+      Wilson upper bound falls below the band (a proven breach, not a
+      wobble) and the monitor's bounded tighten steps must walk recall
+      back inside it — no human in the loop, zero new traces.
+    * **rollback leg** — fresh budgets, retune disabled; a healthy
+      baseline generation is hot-swapped for one pinned to
+      ``n_probes=1``; the swap-probation window must convict the swap
+      against the predecessor's pinned baseline, republish the healthy
+      handle as a fresh monotone generation, and recover in-band.
+
+    Artifact: QUALITY_r19.json (per-leg estimator timelines, action
+    logs with evidence, acceptance booleans)."""
+    from raft_tpu import tuning
+    from raft_tpu.neighbors import ivf_flat
+
+    k = max(ks)
+    band = args.quality_band
+    out = args.out or "QUALITY_r19.json"
+
+    # tight clusters + between-cluster midpoint queries: the regime
+    # where a too-loose margin policy measurably under-recalls (the
+    # truth set splits across lists) yet the exhaustive oracle rung
+    # still scores 1.0 — recall loss is attributable, not noise
+    n_centers = max(args.n_lists, 8)
+    centers = (5.0 * rng.standard_normal((n_centers, args.dim))
+               ).astype(np.float32)
+    per = max(args.n // n_centers, 8)
+    dataset = np.concatenate(
+        [c + rng.standard_normal((per, args.dim)).astype(np.float32)
+         for c in centers], axis=0)
+    a, b = (rng.integers(0, n_centers, (args.query_pool,))
+            for _ in range(2))
+    hard = ((centers[a] + centers[b]) / 2
+            + 0.5 * rng.standard_normal((args.query_pool, args.dim))
+            ).astype(np.float32)
+
+    def qparams(**kw):
+        return serve.ServeParams(
+            max_batch_rows=16, max_wait_ms=0.2, max_k=max(k, 16),
+            adaptive_probes=True,
+            quality_sample_rate=args.quality_rate,
+            quality_band=band, quality_min_samples=8,
+            quality_window=16, **kw)
+
+    def run_leg(srv, done, deadline_s, wrng, label, timeline):
+        """Drive hard-query traffic until ``done(quality_stats)`` or
+        the deadline, sampling the estimator into ``timeline``."""
+        t0 = time.monotonic()
+        st = srv.stats("t")["quality"]
+        converged = done(st)
+        while not converged and time.monotonic() - t0 < deadline_s:
+            for _ in range(8):
+                srv.submit(hard[wrng.integers(0, hard.shape[0], (4,))],
+                           k=k, index="t").result(timeout=60.0)
+                time.sleep(0.002)
+            st = srv.stats("t")["quality"]
+            timeline.append({
+                "t_s": round(time.monotonic() - t0, 2),
+                "estimate": st["estimate"],
+                "ci_low": st["ci_low"], "ci_high": st["ci_high"],
+                "samples": st["samples"],
+                "retune_steps": st["retune_steps"],
+                "generation": srv.generation("t"),
+            })
+            converged = done(st)
+        print(f"{label}: {'converged' if converged else 'DEADLINE'} "
+              f"after {time.monotonic() - t0:.1f}s — est="
+              f"{st['estimate']} ci=[{st['ci_low']}, {st['ci_high']}] "
+              f"steps={st['retune_steps']} "
+              f"actions={[x[0] for x in st['actions']]}", flush=True)
+        return st, converged
+
+    deadline_s = max(args.duration_s * 4, 120.0)
+    build_params = ivf_flat.IndexParams(n_lists=args.n_lists)
+
+    # ---- leg 1: margin drift -> bounded retune recovery --------------
+    tuning.record_budget("serve_probe_margin", args.drift_margin_bp)
+    tuning.record_budget("serve_probe_floor", args.drift_floor_bp)
+    wrng = np.random.default_rng(args.seed + 101)
+    t_build = time.perf_counter()
+    srv = serve.Server(qparams(quality_rollback=False))
+    srv.create_index("t", dataset, algo="ivf_flat",
+                     build_params=build_params)
+    print(f"retune leg up: ivf_flat n={dataset.shape[0]} d={args.dim} "
+          f"n_lists={args.n_lists} margins seeded to "
+          f"{args.drift_margin_bp}/{args.drift_floor_bp}bp "
+          f"(build+warmup {time.perf_counter() - t_build:.1f}s)",
+          flush=True)
+    traces0 = serve.total_trace_count()
+    tl_retune: list = []
+    st_r, retune_ok = run_leg(
+        srv,
+        lambda s: (s["retune_steps"] > 0 and s["estimate"] is not None
+                   and s["samples"] >= 8 and s["estimate"] >= band),
+        deadline_s, wrng, "retune", tl_retune)
+    retune_traces = int(serve.total_trace_count() - traces0)
+    max_retunes = qparams().quality_max_retunes
+    srv.close()
+    tuning.reload()        # the next leg starts from healthy defaults
+    breach_r = min((p["ci_high"] for p in tl_retune
+                    if p["ci_high"] is not None), default=None)
+
+    # ---- leg 2: crippled hot-swap -> probation rollback --------------
+    wrng = np.random.default_rng(args.seed + 202)
+    t_build = time.perf_counter()
+    srv = serve.Server(qparams(quality_retune=False))
+    srv.create_index("t", dataset, algo="ivf_flat",
+                     build_params=build_params)
+    print(f"rollback leg up (build+warmup "
+          f"{time.perf_counter() - t_build:.1f}s)", flush=True)
+    tl_roll: list = []
+    base_st, base_ok = run_leg(
+        srv,
+        lambda s: (s["estimate"] is not None and s["samples"] >= 8
+                   and s["estimate"] >= band),
+        deadline_s, wrng, "rollback-baseline", tl_roll)
+    gen_healthy = srv.generation("t")
+    # one probe cannot cover between-cluster queries; its own pinned
+    # exhaustive oracle convicts it against the predecessor's baseline
+    srv.swap("t", dataset=dataset,
+             search_params=ivf_flat.SearchParams(n_probes=1), wait=True)
+    gen_swapped = srv.generation("t")
+    t_swap = time.monotonic()
+    traces1 = serve.total_trace_count()
+    st_b, rolled = run_leg(
+        srv, lambda s: any(x[0] == "rollback" for x in s["actions"]),
+        deadline_s, wrng, "rollback", tl_roll)
+    detect_s = round(time.monotonic() - t_swap, 2)
+    rb_detail = None
+    kinds = [x[0] for x in st_b["actions"]]
+    if "rollback" in kinds:
+        rb_detail = dict(st_b["actions"][kinds.index("rollback")][1])
+    st_b2, recovered = run_leg(
+        srv, lambda s: (s["estimate"] is not None
+                        and s["estimate"] >= band),
+        deadline_s, wrng, "rollback-recovery", tl_roll)
+    roll_traces = int(serve.total_trace_count() - traces1)
+    gen_final = srv.generation("t")
+    srv.close()
+    tuning.reload()
+
+    acceptance = {
+        # the retune leg's breach must be PROVEN (ci_high under the
+        # band), the recovery in-band, the steps bounded, and the whole
+        # episode free of new trace compilation
+        "retune_drift_proven": bool(breach_r is not None
+                                    and breach_r < band),
+        "retune_recovered_in_band": bool(retune_ok),
+        "retune_steps_bounded": bool(
+            0 < st_r["retune_steps"] <= max_retunes),
+        "retune_zero_retraces": retune_traces == 0,
+        "rollback_convicted_swap": bool(rolled),
+        "rollback_detect_s": detect_s if rolled else None,
+        "rollback_versions_monotone": bool(gen_final > gen_swapped
+                                           > gen_healthy),
+        "rollback_recovered_in_band": bool(recovered),
+        "rollback_zero_retraces": roll_traces == 0,
+    }
+    ok = all(v for kk, v in acceptance.items()
+             if kk != "rollback_detect_s")
+    report = {
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": {
+            "algo": "ivf_flat", "n": int(dataset.shape[0]),
+            "dim": args.dim, "n_lists": args.n_lists, "k": k,
+            "query_pool": args.query_pool,
+            "quality_rate": args.quality_rate, "band": band,
+            "quality_window": 16, "quality_min_samples": 8,
+            "drift_margin_bp": args.drift_margin_bp,
+            "drift_floor_bp": args.drift_floor_bp,
+            "seed": args.seed,
+        },
+        "retune": {
+            "actions": st_r["actions"],
+            "retune_steps": st_r["retune_steps"],
+            "max_retunes": max_retunes,
+            "min_ci_high_seen": breach_r,
+            "final": {"estimate": st_r["estimate"],
+                      "ci_low": st_r["ci_low"],
+                      "ci_high": st_r["ci_high"]},
+            "new_traces": retune_traces,
+            "timeline": tl_retune,
+        },
+        "rollback": {
+            "baseline_estimate": base_st["estimate"],
+            "baseline_in_band": bool(base_ok),
+            "generations": {"healthy": gen_healthy,
+                            "swapped": gen_swapped,
+                            "final": gen_final},
+            "detect_s": detect_s if rolled else None,
+            "evidence": rb_detail,
+            "actions": st_b2["actions"],
+            "final": {"estimate": st_b2["estimate"],
+                      "ci_low": st_b2["ci_low"],
+                      "ci_high": st_b2["ci_high"]},
+            "new_traces": roll_traces,
+            "timeline": tl_roll,
+        },
+        "acceptance": acceptance,
+        "pass": bool(ok),
+    }
+    with open(os.path.join(ROOT, out), "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    if args.obs_snapshot:
+        obs.write_snapshot(os.path.join(ROOT, args.obs_snapshot))
+    # GL005 contract: every number this prints is citable with its
+    # artifact + capture date
+    print(json.dumps({"acceptance": acceptance, "pass": bool(ok),
+                      "artifact": out, "date": report["date"]}),
+          flush=True)
+    print(f"wrote {out} (measured {report['date']})", flush=True)
+    return 0 if ok else 1
 
 
 def _drive_fabric(fab, args, ks, duration_s, seed_base, serve,

@@ -13,7 +13,12 @@ import pytest
 from raft_tpu.analysis.cli import main as cli_main
 from raft_tpu.analysis.kernels import lint_paths as kern_lint_paths
 from raft_tpu.analysis.kernels import lint_source as kern_lint_source
-from raft_tpu.analysis.lint import lint_paths, lint_source
+from raft_tpu.analysis.lint import (
+    documented_metric_names,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
 from raft_tpu.analysis.races import lint_paths as race_lint_paths
 from raft_tpu.analysis.races import lint_source as race_lint_source
 from raft_tpu.analysis.rules import RULES
@@ -1265,6 +1270,151 @@ def test_cli_gl019_acceptance_seed(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert any(f["rule"] == "GL019" for f in out["findings"]), out
+
+
+# ---------------------------------------------------------------------------
+# GL023 undocumented-metric (ISSUE 19; catalog contract over
+# docs/observability.md)
+# ---------------------------------------------------------------------------
+
+
+_CATALOG = ("| `serve.documented_total` | `index` | fixture |\n"
+            "| `serve.filled_ratio{bucket}` | — | label-suffix row |\n")
+
+
+def _metric_tree(tmp_path, source, catalog=_CATALOG):
+    """Plant a raft_tpu/ module beside a docs/observability.md catalog
+    and lint it — the GL023 shape: the rule resolves the catalog by
+    walking UP from the linted file."""
+    pkg = tmp_path / "raft_tpu"
+    pkg.mkdir(exist_ok=True)
+    if catalog is not None:
+        docs = tmp_path / "docs"
+        docs.mkdir(exist_ok=True)
+        (docs / "observability.md").write_text(catalog)
+    mod = pkg / "fixture.py"
+    mod.write_text(textwrap.dedent(source))
+    findings = lint_file(mod)
+    return [f.rule for f in findings if not f.suppressed], findings
+
+
+def test_gl023_undocumented_positive(tmp_path):
+    rules, findings = _metric_tree(tmp_path, """
+        from raft_tpu import obs
+
+        def deliver(n):
+            obs.counter("serve.phantom_total", n, index="t")
+    """)
+    assert rules == ["GL023"]
+    assert "serve.phantom_total" in findings[0].message
+
+
+def test_gl023_documented_and_label_suffix_negative(tmp_path):
+    # a plain row, a row spelled with its example labels, and the
+    # name= kwarg form — all documented, none fire
+    rules, _ = _metric_tree(tmp_path, """
+        from raft_tpu import obs
+
+        def deliver(n, ratio):
+            obs.counter("serve.documented_total", n, index="t")
+            obs.observe("serve.filled_ratio", ratio)
+            obs.gauge(value=1.0, name="serve.documented_total")
+    """)
+    assert "GL023" not in rules
+
+
+def test_gl023_dynamic_name_positive(tmp_path):
+    # a name the static check (and the operator's grep) cannot read
+    rules, findings = _metric_tree(tmp_path, """
+        from raft_tpu import obs
+
+        def deliver(family, n):
+            obs.counter(f"serve.{family}_total", n)
+    """)
+    assert rules == ["GL023"]
+    assert "dynamically" in findings[0].message
+
+
+def test_gl023_suppression(tmp_path):
+    rules, findings = _metric_tree(tmp_path, """
+        from raft_tpu import obs
+
+        def deliver(n):
+            # graft-lint: allow-undocumented-metric internal debug series
+            obs.counter("serve.phantom_total", n)
+    """)
+    assert "GL023" not in rules
+    assert any(f.rule == "GL023" and f.suppressed for f in findings)
+
+
+def test_gl023_bare_emitters_only_inside_obs(tmp_path):
+    # inside obs/ the writers are local names; elsewhere a bare
+    # counter() is someone else's function
+    src = """
+        def capture(gauge, counter):
+            gauge("serve.phantom_total", 1.0)
+            counter("serve.phantom_total")
+    """
+    rules, _ = _metric_tree(tmp_path, src)
+    assert "GL023" not in rules
+    obs_pkg = tmp_path / "raft_tpu" / "obs"
+    obs_pkg.mkdir()
+    mod = obs_pkg / "fixture.py"
+    mod.write_text(textwrap.dedent(src))
+    findings = lint_file(mod)
+    assert [f.rule for f in findings if not f.suppressed] \
+        == ["GL023", "GL023"]
+
+
+def test_gl023_no_catalog_means_no_contract(tmp_path):
+    # a detached fixture tree with no docs/observability.md above it
+    # has nothing to check against
+    rules, _ = _metric_tree(tmp_path, """
+        from raft_tpu import obs
+
+        def deliver(n):
+            obs.counter("serve.phantom_total", n)
+    """, catalog=None)
+    assert "GL023" not in rules
+
+
+def test_gl023_outside_package_exempt():
+    findings = lint_source(textwrap.dedent("""
+        from raft_tpu import obs
+
+        def deliver(n):
+            obs.counter("serve.phantom_total", n)
+    """), "serve/fixture.py")
+    assert "GL023" not in [f.rule for f in findings]
+
+
+def test_gl023_catalog_names_must_be_single_line():
+    # a name wrapped across a doc line is not greppable and does not
+    # document — the drift class the serving section's old prose had
+    names = documented_metric_names(
+        "| `serve.one_line_total{index}` | ok |\n"
+        "prose mention of `serve.wrapped_total{index,\n"
+        "action}` spanning a wrap\n")
+    assert "serve.one_line_total" in names
+    assert not any(n.startswith("serve.wrapped") for n in names)
+
+
+def test_cli_gl023_acceptance_seed(tmp_path, capsys):
+    """ISSUE 19 acceptance seed: a planted undocumented metric emission
+    in a raft_tpu/ module exits rc 1 naming GL023."""
+    pkg = tmp_path / "raft_tpu"
+    pkg.mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "| `serve.documented_total` | `index` | fixture |\n")
+    (pkg / "seeded.py").write_text(
+        'from raft_tpu import obs\n'
+        'def deliver(n):\n'
+        '    obs.counter("serve.phantom_total", n, index="t")\n')
+    rc = cli_main(["--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "GL023" for f in out["findings"]), out
 
 
 # ---------------------------------------------------------------------------

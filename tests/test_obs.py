@@ -186,6 +186,37 @@ def test_histogram_buckets_fixed_at_first_observation():
     assert point["bucket_counts"] == [1, 1]
 
 
+def test_unit_interval_bucket_preset():
+    """ISSUE 19 satellite: the shared unit-interval preset for ratio
+    histograms — monotone, capped at exactly 1.0, dense near the top
+    where recall bands live (0.9/0.95/0.99 are resolvable edges)."""
+    bs = obs.UNIT_BUCKETS
+    assert bs[-1] == 1.0
+    assert all(a < b for a, b in zip(bs, bs[1:]))
+    assert all(0.0 < b <= 1.0 for b in bs)
+    for edge in (0.9, 0.95, 0.99):
+        assert edge in bs
+    # consumers share the preset object, not a drifting copy
+    from raft_tpu.serve.batcher import FILL_BUCKETS
+
+    assert FILL_BUCKETS is obs.UNIT_BUCKETS
+    obs.set_mode("on")
+    obs.observe("serve.batch_fill_ratio", 0.93,
+                buckets=FILL_BUCKETS, index="t")
+    obs.observe("serve.recall_sample", 1.0,
+                buckets=obs.UNIT_BUCKETS, index="t", rung="all")
+    snap = obs.snapshot(runtime_gauges=False)["metrics"]
+    for name in ("serve.batch_fill_ratio", "serve.recall_sample"):
+        assert snap[name]["points"][0]["buckets"] == list(bs)
+    fill = snap["serve.batch_fill_ratio"]["points"][0]
+    # 0.93 resolves into (0.925, 0.95] — the band-adjacent bucket
+    assert fill["bucket_counts"][bs.index(0.95)] == 1
+    recall = snap["serve.recall_sample"]["points"][0]
+    # perfect recall lands IN 1.0 (le semantics), not the overflow slot
+    assert recall["bucket_counts"][bs.index(1.0)] == 1
+    assert recall["bucket_counts"][-1] == 0
+
+
 # ---------------------------------------------------------------------------
 # Prometheus exposition round-trip
 # ---------------------------------------------------------------------------

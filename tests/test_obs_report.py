@@ -109,6 +109,102 @@ def test_stitch_groups_by_trace_id(obs_report, events, capsys):
     assert "span:" in out and "waterfall:" in out
 
 
+# ---------------------------------------------------------------------------
+# recall timeline (graft-gauge, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _recall_metric_lines(index, rung, triplets, t0=100.0):
+    """Flight ``kind="metric"`` lines as the monitor writes them:
+    estimate, ci_low, ci_high per update, in that order."""
+    lines = []
+    for i, (est, lo, hi) in enumerate(triplets):
+        t = t0 + i
+        for name, v in (("serve.recall_estimate", est),
+                        ("serve.recall_ci_low", lo),
+                        ("serve.recall_ci_high", hi)):
+            lines.append(json.dumps({
+                "t": t, "kind": "metric", "name": name, "value": v,
+                "labels": {"index": index, "rung": rung}}))
+    return lines
+
+
+def test_recall_points_pair_gauge_triplets(obs_report, tmp_path):
+    dump = tmp_path / "flight-q.jsonl"
+    dump.write_text("\n".join(
+        _recall_metric_lines("t", "all",
+                             [(0.9, 0.8, 0.96), (0.95, 0.9, 0.98)])
+        + _recall_metric_lines("t", "16", [(0.7, 0.6, 0.8)])
+        + [json.dumps({"t": 1.0, "kind": "metric",
+                       "name": "serve.queue_depth", "value": 3.0,
+                       "labels": {"index": "t"}})]) + "\n")
+    pts = obs_report.recall_points([str(dump)])
+    assert len(pts) == 3
+    by_rung = {}
+    for p in pts:
+        by_rung.setdefault(p["rung"], []).append(p)
+    assert len(by_rung["all"]) == 2 and len(by_rung["16"]) == 1
+    first = by_rung["all"][0]
+    assert (first["estimate"], first["ci_low"], first["ci_high"]) \
+        == (0.9, 0.8, 0.96)
+    # timeline ordering within the series
+    assert by_rung["all"][0]["t"] < by_rung["all"][1]["t"]
+
+
+def test_recall_cli_band_flags_proven_breach(obs_report, tmp_path,
+                                             capsys):
+    dump = tmp_path / "flight-q.jsonl"
+    dump.write_text("\n".join(_recall_metric_lines(
+        "t", "all", [(0.95, 0.9, 0.99), (0.7, 0.6, 0.8)])) + "\n")
+    out_json = str(tmp_path / "pts.json")
+    rc = obs_report.main(["recall", str(dump), "--band", "0.9",
+                          "--json", out_json])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "band=0.90" in out
+    # the ci_high=0.8 point is a PROVEN breach; ci_high=0.99 is not
+    assert out.count("ALARM") == 1
+    assert "[" in out and "]" in out and "*" in out
+    dumped = json.load(open(out_json))
+    assert len(dumped["points"]) == 2
+
+
+def test_recall_snapshot_sidecar_and_federated_workers(obs_report,
+                                                       tmp_path,
+                                                       capsys):
+    snap = {"time_unix": 50.0, "metrics": {
+        "serve.recall_estimate": {"kind": "gauge", "points": [
+            {"labels": {"worker": "w0", "index": "t", "rung": "all"},
+             "value": 0.97},
+            {"labels": {"worker": "w1", "index": "t", "rung": "all"},
+             "value": 0.91}]},
+        "serve.recall_ci_low": {"kind": "gauge", "points": [
+            {"labels": {"worker": "w0", "index": "t", "rung": "all"},
+             "value": 0.93},
+            {"labels": {"worker": "w1", "index": "t", "rung": "all"},
+             "value": 0.85}]},
+        "serve.recall_ci_high": {"kind": "gauge", "points": [
+            {"labels": {"worker": "w0", "index": "t", "rung": "all"},
+             "value": 0.99},
+            {"labels": {"worker": "w1", "index": "t", "rung": "all"},
+             "value": 0.95}]}}}
+    path = tmp_path / "fed.obs.json"
+    path.write_text(json.dumps(snap))
+    pts = obs_report.recall_points([str(path)])
+    # a federated sidecar's worker label wins over the filename
+    assert {p["worker"] for p in pts} == {"w0", "w1"}
+    rc = obs_report.main(["recall", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "worker=w0" in out and "worker=w1" in out
+
+
+def test_recall_cli_no_points_is_rc1(obs_report, tmp_path, capsys):
+    rc = obs_report.main(["recall", FIXTURE])
+    capsys.readouterr()
+    assert rc == 1
+
+
 def test_obs_report_runs_as_script():
     """The CLI entry the r5 battery / a chip-day operator shells out
     to: a subprocess run over the fixture exits 0 and prints bars."""
