@@ -248,6 +248,7 @@ class FileLinter:
         self._check_unspanned_entries()
         self._check_untraced_rpc()
         self._check_undocumented_metric()
+        self._check_hand_wired_pipeline()
         # nested defs are revisited by the per-function GL003 pass; dedupe
         seen: Set[Tuple[str, int, str]] = set()
         unique: List[Finding] = []
@@ -900,6 +901,77 @@ class FileLinter:
                            "labels, who emits it) so dashboards and "
                            "alerts have a contract, or suppress with a "
                            "reason for a deliberately internal series")
+
+    # -- GL024 hand-wired pipeline -----------------------------------------
+
+    # the multi-stage entry points and kernel internals a serve/comms
+    # adapter must not call directly (ISSUE 20): composition belongs in
+    # a compiled plan, not re-plumbed per call site
+    _PIPELINE_INTERNALS = frozenset({
+        "search_refined", "search_refined_stream", "_pq_search",
+        "_ivf_search", "_beam_search", "_beam_search_pallas"})
+    _SEARCH_OWNERS = frozenset({
+        "ivf_pq", "ivf_flat", "brute_force", "cagra", "hybrid"})
+
+    def _is_plan_dispatch(self, node: ast.Call) -> bool:
+        """A call that routes through graft-plan: ``plan.compile(...)``
+        / ``compile_plan(...)`` (any plan-module alias), or the serve
+        handle's compiled-plan cache (``self.compiled(k, rung)``)."""
+        name = _dotted(node.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        if last == "compile_plan" or last == "compiled":
+            return True
+        if last == "compile" and "plan" in name.rsplit(".", 2)[-2:][0]:
+            return True
+        return False
+
+    def _check_hand_wired_pipeline(self) -> None:
+        """GL024: serve adapters and sharded variants dispatch search
+        pipelines through ``plan.compile`` (ISSUE 20). A top-level
+        function (with its nested closures) that calls a multi-stage
+        entry point or kernel internal directly, and never touches the
+        plan compiler, is a hand-wired pipeline — the drift class the
+        plan IR exists to end."""
+        if self.rules is not None and "GL024" not in self.rules:
+            return
+        parts = Path(self.path).parts
+        if "raft_tpu" not in parts or not {"serve", "comms"} & set(parts):
+            return
+
+        def roots(body):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield stmt
+                elif isinstance(stmt, ast.ClassDef):
+                    yield from roots(stmt.body)
+
+        for fn in roots(self.tree.body):
+            sites: List[Tuple[ast.Call, str]] = []
+            dispatches_plan = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_plan_dispatch(node):
+                    dispatches_plan = True
+                    continue
+                name = _dotted(node.func) or ""
+                bits = name.rsplit(".", 2)
+                last = bits[-1]
+                if last in self._PIPELINE_INTERNALS:
+                    sites.append((node, name))
+                elif (last == "search" and len(bits) >= 2
+                        and bits[-2] in self._SEARCH_OWNERS):
+                    sites.append((node, name))
+            if dispatches_plan:
+                continue
+            for node, name in sites:
+                self._emit("GL024", node,
+                           f"hand-wired pipeline: {name}() called "
+                           "directly from serve/comms dispatch — "
+                           "compose the stages as a plan and route "
+                           "through plan.compile (docs/plans.md), or "
+                           "suppress with a reason naming why this is "
+                           "a deliberate single-stage fast path")
 
     # -- GL004 f64 ---------------------------------------------------------
 

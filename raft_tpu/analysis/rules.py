@@ -297,6 +297,22 @@ register_rule(
     "reason saying why operators never see it",
 )
 register_rule(
+    "GL024", "hand-wired-pipeline",
+    "serve/comms code calls a multi-stage search entry point "
+    "(search_refined, a kernel-internal _pq_search/_ivf_search/"
+    "_beam_search, or an algorithm's .search) without dispatching "
+    "through plan.compile",
+    "ISSUE 20 made pipeline composition data: serve adapters and "
+    "sharded variants compose stages as compiled plans "
+    "(docs/plans.md), so validation, warmup, rung variants, and the "
+    "bitwise plan-vs-legacy matrix all see one program. A hand-wired "
+    "call re-plumbs the stages invisibly — it drifts from the plan "
+    "the tests pin and grows a bespoke surface per feature. Route "
+    "through plan.compile (or the serve handle's compiled-plan "
+    "cache); a deliberate single-stage fast path suppresses with a "
+    "reason naming why no multi-stage plan applies",
+)
+register_rule(
     "GL022", "unmodeled-lock-edge",
     "runtime-observed lock-order edge absent from the static model "
     "(reconciliation mode)",

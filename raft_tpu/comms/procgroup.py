@@ -132,10 +132,12 @@ def search_shard_entry(entry: tuple, q: np.ndarray,
     if algo == "ivf_flat":
         from raft_tpu.neighbors import ivf_flat
 
+        # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path: the fabric worker runs one per-shard scan; the router owns the multi-stage tail
         d, i = ivf_flat.search(sp, idx, q, kq)
     else:
         from raft_tpu.neighbors import brute_force
 
+        # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path: exact per-shard scan, no pipeline to plan
         d, i = brute_force.search(idx, q, kq)
     d = np.asarray(d).astype(np.float32, copy=False)
     i = np.asarray(i).astype(np.int32, copy=False)

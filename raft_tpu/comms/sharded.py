@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.comms.compat import shard_map
 
 from raft_tpu import obs
+from raft_tpu import plan as plan_mod
 from raft_tpu.distance.types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors.common import merge_topk
@@ -254,6 +255,7 @@ def sharded_ivf_search(
         norms = rest.pop(0) if has_norms else None
         bad = rest.pop(0) if partial else None
         rank = jax.lax.axis_index(axis_name)
+        # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path: one collective per-shard scan + merge, no multi-stage tail
         d, i = ivf_flat._ivf_search(
             q, centers, storage, indices, list_sizes,
             int(k), n_probes, metric, group, bucket_batch, 0,
@@ -374,16 +376,8 @@ def sharded_ivf_pq_search(
     )
     bucket_batch = int(search_params.bucket_batch)
     per_cluster = int(index.codebook_kind) == ivf_pq.codebook_gen.PER_CLUSTER
-    if index.cache_kind == "rabitq":
-        # the sharded local scan discriminates uint32 caches by
-        # cache_scales and would silently score sign-bit words as pq4
-        # codes; the rabitq rung shards as per-shard PIPELINES instead
-        raise ValueError(
-            "sharded_ivf_pq_search does not scan the rabitq cache yet — "
-            "run ivf_pq.search_refined per shard (the multi-stage "
-            "pipeline) or shard an i8/i4/pq4-cache index"
-        )
     has_cache = index.recon_cache is not None
+    has_fac = index.cache_kind == "rabitq"
     lut = ivf_pq._norm_dtype_knob(search_params.lut_dtype)
     if lut == "i8" and index.cache_kind not in ("i8", "i4"):
         # mirror ivf_pq.search(): a pq4 code cache is not the i8 LUT path
@@ -398,13 +392,26 @@ def sharded_ivf_pq_search(
         from raft_tpu.neighbors import tiered
 
         src = tiered.as_source(rerank_source)
-    cache_refine = refine_ratio > 1 and src is None
-    if cache_refine and index.cache_kind not in ("i4", "i8"):
+    cache_refine = (refine_ratio > 1 and src is None
+                    and index.cache_kind in ("i4", "i8"))
+    # rabitq shards as first-stage subplan + ROUTER-side rerank: the
+    # 1-bit scan returns GLOBAL slots (shard offset applied in-trace),
+    # the merged slot shortlist re-scores at full PQ fidelity from the
+    # full index's packed codes once, host-side of the collective
+    codes_refine = (refine_ratio > 1 and src is None and has_fac)
+    if refine_ratio > 1 and src is None and not (cache_refine
+                                                 or codes_refine):
         raise ValueError(
             "refine_ratio > 1 needs the decoded-RESIDUAL cache (i8/i4; "
             "build with cache_decoded=True within the cache budget) or "
             "a host rerank_source= (neighbors.tiered) — a pq4 code "
             "cache carries no fidelity beyond the scan itself"
+        )
+    if codes_refine and int(index.codes.shape[-1]) == 0:
+        raise ValueError(
+            "sharded rabitq refine re-scores the merged shortlist from "
+            "the packed PQ codes — build with keep_codes=True, or pass "
+            "a host rerank_source= (neighbors.tiered)"
         )
     k_search = k * refine_ratio
     if k_search > n_probes * cap:
@@ -412,52 +419,77 @@ def sharded_ivf_pq_search(
             f"k*refine_ratio={k_search} exceeds the per-shard candidate "
             f"pool (n_probes/shard={n_probes} x cap={cap})"
         )
-    # with a host rerank source the shards merge their FIRST-stage
-    # shortlists at full k_search width; the tiered rerank happens once
-    # on the merged candidates, host-side of the collective
-    k_merge = k_search if src is not None else k
+    # with a router-side rerank tail (host source or rabitq codes) the
+    # shards merge their FIRST-stage shortlists at full k_search width;
+    # the exact rerank happens once on the merged candidates
+    k_merge = k_search if (src is not None or codes_refine) else k
 
     has_scales = has_cache and index.cache_scales is not None
     partial = partial_ok or faultinject.has_shard_faults()
+
+    # the pipeline as DATA (raft_tpu.plan): the pre-merge subplan runs
+    # per worker inside shard_map, the rerank tail (if any) once on the
+    # router — split_at_merge cuts at the collective
+    tail_kind = ("tiered" if src is not None
+                 else "codes" if codes_refine else None)
+    p = plan_mod.sharded_ivf_pq_plan(
+        int(k), int(k_search), int(k_merge),
+        local_rerank=cache_refine, tail=tail_kind)
+    head_plan, tail_plan = plan_mod.split_at_merge(p)
+    head_cp = plan_mod.compile(
+        head_plan, index, k=int(k), search_params=search_params,
+        refine_ratio=refine_ratio,
+        n_probes=n_probes, metric=metric, group=group,
+        bucket_batch=bucket_batch,
+        codebook_kind=int(index.codebook_kind),
+        compute_dtype=str(search_params.compute_dtype),
+        local_recall_target=float(search_params.local_recall_target),
+        merge_recall_target=float(search_params.merge_recall_target),
+        lut=lut, internal=internal,
+        pq_dim=int(index.pq_dim), pq_bits=int(index.pq_bits),
+        recon_scale=float(index.recon_scale),
+        axis_name=axis_name, select_min=select_min)
+    tail_cp = (None if tail_plan is None
+               else plan_mod.compile(tail_plan, index, k=int(k),
+                                     source=src))
+
+    local_slots = local_lists * cap
 
     def local(q, centers, centers_rot, rotation, pq_centers, codes,
               indices, list_sizes, rec_norms, *rest):
         rest = list(rest)
         cache = rest.pop(0) if has_cache else None
         scales = rest.pop(0) if has_scales else None
-        qnorms = rest.pop(0) if has_scales else None
+        qnorms = rest.pop(0) if (has_scales or has_fac) else None
+        fac = rest.pop(0) if has_fac else None
         bad = rest.pop(0) if partial else None
         rank = jax.lax.axis_index(axis_name)
-        search_ids = (ivf_pq._slot_indices(indices) if cache_refine
-                      else indices)
+        if cache_refine:
+            # per-shard rerank decodes from ITS OWN cache: LOCAL slots
+            search_ids = ivf_pq._slot_indices(indices)
+        elif codes_refine:
+            # router rerank decodes from the FULL index: local slots
+            # lift to global flat slots by the shard's block offset
+            s = ivf_pq._slot_indices(indices)
+            search_ids = jnp.where(s >= 0, s + rank * local_slots, -1)
+        else:
+            search_ids = indices
         arrays = (q, centers, centers_rot, rotation, pq_centers, codes,
                   search_ids, list_sizes, rec_norms, None, cache,
-                  jnp.float32(index.recon_scale), scales, qnorms,
-                  None)      # cache_fac: rabitq rejected above
-        d, i = ivf_pq._pq_search(
-            arrays, int(k_search), n_probes, metric, group, bucket_batch,
-            int(index.codebook_kind), 0,
-            str(search_params.compute_dtype),
-            float(search_params.local_recall_target),
-            float(search_params.merge_recall_target),
-            lut, internal, int(index.pq_dim), int(index.pq_bits), "xla",
-        )
-        if cache_refine:
-            # per-shard cache-decoded exact re-rank, then slots -> ids
-            d, s = ivf_pq._refine_slots(
-                q, i, int(k), metric, cache, scales, centers_rot,
-                rotation, jnp.float32(index.recon_scale),
-            )
-            i = jnp.where(
-                s >= 0, indices.reshape(-1)[jnp.maximum(s, 0)], -1
-            )
+                  jnp.float32(index.recon_scale), scales, qnorms, fac)
+        extra = {"indices": indices, "cache": cache, "scales": scales}
         if partial:
-            d, i, valid = _mask_invalid(d, i, rank, bad, select_min)
-        gd = jax.lax.all_gather(d, axis_name, axis=1, tiled=True)
-        gi = jax.lax.all_gather(i, axis_name, axis=1, tiled=True)
-        md, mi = merge_topk(gd, gi, k_merge, select_min)
+            cov = {}
+
+            def pre_merge(d, i):
+                d, i, valid = _mask_invalid(d, i, rank, bad, select_min)
+                cov["valid"] = valid
+                return d, i
+
+            extra["pre_merge"] = pre_merge
+        md, mi = head_cp(q, arrays=arrays, extra=extra)
         if partial:
-            return md, mi, _coverage(valid, axis_name)
+            return md, mi, _coverage(cov["valid"], axis_name)
         return md, mi
 
     args = [queries, index.centers, index.centers_rot, index.rotation,
@@ -480,9 +512,13 @@ def sharded_ivf_pq_search(
     if has_scales:
         args.append(index.cache_scales)        # [C, rot] per-list scales
         in_specs.append(P(axis_name, None))
+    if has_scales or has_fac:
         qn = (index.cache_qnorms if index.cache_qnorms is not None
               else index.rec_norms)
         args.append(qn)
+        in_specs.append(P(axis_name, None))
+    if has_fac:
+        args.append(index.cache_fac)           # [C, cap] discriminator
         in_specs.append(P(axis_name, None))
     if partial:
         args.append(_dead_rank_array())
@@ -499,14 +535,13 @@ def sharded_ivf_pq_search(
                         queries=int(queries.shape[0]), k=int(k),
                         shards=int(nshards), refine_ratio=refine_ratio):
         out = jax.jit(fn)(*args)
-        if src is not None:
-            # tiered rerank over the MERGED shortlist: only its unique
-            # rows are fetched from the host source; uncovered shards'
-            # -1 rows stay invalid and sink at the exact ranking
+        if tail_cp is not None:
+            # router-side rerank over the MERGED shortlist (tiered
+            # fetch of unique rows, or rabitq slot decode from the
+            # packed codes); uncovered shards' -1 rows stay invalid
+            # and sink at the exact ranking
             md, mi = out[0], out[1]
-            with obs.span("sharded_ivf_pq.tiered_rerank",
-                          kc=int(k_merge)):
-                rd, ri = src.rerank(queries, mi, int(k), index.metric)
+            rd, ri = tail_cp(queries, extra={"candidates": (md, mi)})
             out = (rd, ri) + tuple(out[2:])
     if partial:
         return _finish_partial(out, partial_ok, "sharded_ivf_pq_search")
@@ -706,6 +741,7 @@ def sharded_cagra_search(
         if fused:
             pack = rest.pop(0)[0]                        # [rows, W]
             codes = rest.pop(0)[0]                       # [rows, d] i8
+            # graft-lint: allow-hand-wired-pipeline cagra's beam loop compiles as one scan node (ROADMAP 8(b)); the sharded variant calls the kernel arm directly
             d, i = cagra._beam_search_pallas(
                 q, ds[0], graph[0], norms, pack, codes,
                 jnp.float32(index.code_scale), int(k), itopk, width,
@@ -713,6 +749,7 @@ def sharded_cagra_search(
                 impl == "pallas_interpret",
             )
         else:
+            # graft-lint: allow-hand-wired-pipeline cagra's beam loop compiles as one scan node (ROADMAP 8(b)); the sharded variant calls the kernel arm directly
             d, i = cagra._beam_search(
                 q, ds[0], graph[0], norms, int(k), itopk, width, iters,
                 int(index.metric), "f32" if dtype == "auto" else dtype,
@@ -839,6 +876,7 @@ def sharded_ivf_row_search(
 
     def local(q, centers, storage, indices, list_sizes, *rest):
         norms = rest[0][0] if has_norms else None
+        # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path: one collective per-shard scan + merge, no multi-stage tail
         d, i = ivf_flat._ivf_search(
             q, centers[0], storage[0], indices[0], list_sizes[0],
             int(k), n_probes, metric, group,

@@ -5,6 +5,7 @@ from raft_tpu.neighbors import (
     brute_force,
     cagra,
     epsilon_neighborhood,
+    hybrid,
     ivf_flat,
     ivf_pq,
     nn_descent,
@@ -25,6 +26,7 @@ __all__ = [
     "ball_cover",
     "brute_force",
     "epsilon_neighborhood",
+    "hybrid",
     "nn_descent",
     "cagra",
     "ivf_flat",

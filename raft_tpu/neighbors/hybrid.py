@@ -1,0 +1,208 @@
+"""Hybrid dense + sparse search (ROADMAP 6(a), ISSUE 20): one index
+holding a dense embedding block next to a sparse lexical block (CSR at
+rest), searched as a ``score_fuse`` PLAN — each leg over-fetches at the
+fuse width, the fuse node re-scores every candidate on the OTHER leg
+and weight-merges ``w_dense * dense + w_sparse * sparse`` over the
+UNION of candidates, and one ``merge_topk`` keeps the fused top-k.
+
+The pipeline is not a code path here: :func:`search` compiles
+:func:`raft_tpu.plan.hybrid_plan` and executes it — the same program
+the serve engine warms per (bucket, k) and the batcher/registry/
+tombstone machinery serves end-to-end (``ServeEngine(algo="hybrid")``).
+
+Rows are stored ``[dense_dim dense columns | vocab sparse columns]``;
+queries arrive in the same layout (``split_queries`` cuts them). Both
+legs score by inner product — the one metric whose weighted sum is
+itself a meaningful ranking score (an RRF-style rank fusion would be a
+different ``score_fuse`` op, not a different pipeline).
+
+The exact-fusion trick is the padded ELL sidecar: re-scoring the dense
+leg's candidates lexically needs random-access rows of the CSR block,
+which CSR cannot give a fixed-shape gather for. ``build`` therefore
+keeps ``[n, r_max]`` column/value sidecars (ELL layout, zero-padded);
+one fused gather re-scores any candidate set at fixed shape, and the
+zero padding contributes nothing to the dot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import brute_force
+from raft_tpu.sparse.types import CSR, dense_to_csr
+
+__all__ = ["IndexParams", "SearchParams", "Index", "build",
+           "split_queries", "search", "side_scale"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """``dense_dim`` cuts the row layout; the weights set the fused
+    ranking score ``w_dense * <q_d, x_d> + w_sparse * <q_s, x_s>``."""
+    dense_dim: int
+    w_dense: float = 1.0
+    w_sparse: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """``fuse_expand``: each leg over-fetches ``max(k * fuse_expand,
+    16)`` candidates before fusion — the hybrid analogue of
+    refine_ratio (a candidate missing from BOTH legs' shortlists
+    cannot be recovered by the re-score)."""
+    fuse_expand: int = 4
+
+
+@dataclasses.dataclass
+class Index:
+    dense: jax.Array            # [n, dense_dim] f32
+    dense_bf: brute_force.Index  # IP sub-index over the dense block
+    docs: CSR                   # [n, vocab] sparse block, CSR at rest
+    ell_cols: jax.Array         # [n, r_max] i32, zero-padded
+    ell_vals: jax.Array         # [n, r_max] f32, zero-padded
+    dense_dim: int
+    w_dense: float
+    w_sparse: float
+
+    @property
+    def metric(self) -> DistanceType:
+        return DistanceType.InnerProduct
+
+    @property
+    def size(self) -> int:
+        return int(self.dense.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.dense_dim + self.docs.shape[1])
+
+
+def build(params: IndexParams, dataset) -> Index:
+    """Build from rows laid out ``[dense | sparse]`` (host-side: the
+    CSR nnz and the ELL ``r_max`` are data-dependent)."""
+    X = np.asarray(dataset, np.float32)
+    dd = int(params.dense_dim)
+    if X.ndim != 2 or not 0 < dd < X.shape[1]:
+        raise ValueError(
+            f"hybrid rows are [dense | sparse]: need 2-D data with "
+            f"0 < dense_dim < row width, got {X.shape} dense_dim={dd}")
+    with obs.entry_span("build", "hybrid", rows=int(X.shape[0]),
+                        dense_dim=dd):
+        return _build(params, X, dd)
+
+
+def _build(params: IndexParams, X, dd: int) -> Index:
+    dense = X[:, :dd]
+    sparse_part = X[:, dd:]
+    docs = dense_to_csr(sparse_part)
+    indptr = np.diff(np.asarray(docs.indptr))
+    r_max = max(int(indptr.max(initial=0)), 1)
+    n = X.shape[0]
+    ell_cols = np.zeros((n, r_max), np.int32)
+    ell_vals = np.zeros((n, r_max), np.float32)
+    ptr = np.asarray(docs.indptr)
+    cols = np.asarray(docs.indices)
+    vals = np.asarray(docs.vals)
+    for r in range(n):
+        lo, hi = int(ptr[r]), int(ptr[r + 1])
+        ell_cols[r, : hi - lo] = cols[lo:hi]
+        ell_vals[r, : hi - lo] = vals[lo:hi]
+    return Index(
+        dense=jnp.asarray(dense),
+        dense_bf=brute_force.build(dense, metric="inner_product"),
+        docs=docs,
+        ell_cols=jnp.asarray(ell_cols),
+        ell_vals=jnp.asarray(ell_vals),
+        dense_dim=dd,
+        w_dense=float(params.w_dense),
+        w_sparse=float(params.w_sparse),
+    )
+
+
+def split_queries(index: Index, queries) -> Tuple[jax.Array, jax.Array]:
+    """Cut ``[m, dense_dim + vocab]`` query rows into the two legs'
+    operands (the layout contract ``build`` stored rows under)."""
+    q = jnp.asarray(queries)
+    if q.shape[1] != index.dim:
+        raise ValueError(f"query width {q.shape[1]} != index dim "
+                         f"{index.dim} (= {index.dense_dim} dense + "
+                         f"{index.docs.shape[1]} vocab)")
+    return q[:, : index.dense_dim], q[:, index.dense_dim:]
+
+
+def side_scale(index: Index) -> np.ndarray:
+    """Per-column weights that make a plain inner product over raw
+    ``[dense | sparse]`` rows equal the fused score — the serve side
+    buffer scales its rows by this so side hits rank on the same
+    scale as main-index hits."""
+    return np.concatenate([
+        np.full(index.dense_dim, index.w_dense, np.float32),
+        np.full(index.docs.shape[1], index.w_sparse, np.float32),
+    ])
+
+
+@jax.jit
+def _fuse_rescore(qd, qs, dense, ell_cols, ell_vals,
+                  dense_d, dense_i, sparse_d, sparse_i, wd, ws):
+    """Union fusion at fixed shape: score each leg's candidates on the
+    other leg (ELL gather for lexical, row gather + dot for dense),
+    weight-sum, and mask the second leg's duplicates so the union
+    carries each candidate once. Invalid slots (id -1) score the
+    worst-possible sentinel (IP: -inf) and sink at the merge."""
+    m = qd.shape[0]
+    rows = jnp.arange(m)[:, None, None]
+
+    # dense-leg candidates: lexical re-score from the ELL sidecar
+    dj = jnp.maximum(dense_i, 0)
+    lex = jnp.sum(qs[rows, ell_cols[dj]] * ell_vals[dj], axis=-1)
+    fused1 = wd * dense_d + ws * lex
+
+    # sparse-leg candidates: dense re-score by row gather + dot
+    sj = jnp.maximum(sparse_i, 0)
+    den = jnp.einsum("mcd,md->mc", dense[sj], qd)
+    fused2 = wd * den + ws * sparse_d
+
+    # union semantics: a candidate on both legs keeps its dense-leg
+    # slot; -1 pads never alias a real id (compare against -2)
+    dup = jnp.any(
+        sparse_i[:, :, None] == jnp.where(dense_i < 0, -2, dense_i)[:, None, :],
+        axis=-1)
+    neg = jnp.float32(-jnp.inf)
+    fused1 = jnp.where(dense_i >= 0, fused1, neg)
+    fused2 = jnp.where((sparse_i >= 0) & ~dup, fused2, neg)
+    return (jnp.concatenate([fused1, fused2], axis=1),
+            jnp.concatenate([dense_i, sparse_i], axis=1))
+
+
+def search(
+    search_params: Optional[SearchParams],
+    index: Index,
+    queries,
+    k: int,
+    prefilter=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused top-k by compiling and executing the hybrid plan (the
+    standalone entry point; serving compiles the same plan per handle).
+
+    Returns (fused scores [m, k], indices [m, k]), best-first
+    (inner product: larger is closer).
+    """
+    from raft_tpu import plan as plan_mod
+
+    sp = search_params if search_params is not None else SearchParams()
+    if not 0 < k <= index.size:
+        raise ValueError(f"k={k} out of range for index size {index.size}")
+    with obs.entry_span("search", "hybrid",
+                        queries=int(np.shape(queries)[0]), k=int(k)):
+        p = plan_mod.hybrid_plan(fuse_expand=int(sp.fuse_expand))
+        compiled = plan_mod.compile(p, index, k=int(k), search_params=sp,
+                                    select_min=False)
+        return compiled(jnp.asarray(queries), prefilter=prefilter)

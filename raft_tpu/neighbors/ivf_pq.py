@@ -2588,108 +2588,33 @@ def search_refined(
             "exact PQ; for raw-dataset refine there, pass dataset= or "
             "use neighbors.refine"
         )
+    from raft_tpu import plan as _plan
+
     src_obj = None if dataset is None else _tiered.as_source(dataset)
     queries = jnp.asarray(queries)
-    m = int(queries.shape[0])
     kc = refined_shortlist_width(search_params, index, k, refine_ratio)
-    rot = index.rot_dim
-    fetch = None
+    # the pipeline is the canonical plan (raft_tpu/plan/canonical.py),
+    # compiled fresh per call — the bind work is a handful of closures
+    # (serve caches its compiled variants per handle; library callers
+    # pay exactly what the hand-wired dispatch paid, since the legacy
+    # path also rebuilt the slot substitution per call). The stage
+    # spans + rerank.* counters (docs/observability.md) are emitted by
+    # the node executors, byte-identical names/labels to the
+    # hand-wired emission.
     with obs.span("ivf_pq.search_refined", refine_ratio=int(refine_ratio),
                   k=int(k), cache_kind=kind) as _sp:
-        source = ("cache" if src_obj is None and kind in ("i8", "i4")
-                  else "codes" if src_obj is None
-                  else "host" if src_obj.kind == "host" else "dataset")
         if src_obj is not None:
-            with obs.span("ivf_pq.first_stage", kc=kc) as s1:
-                d1, ids1 = search(search_params, index, queries, kc,
-                                  prefilter=prefilter)
-                if obs.enabled():
-                    s1.sync(ids1)
-            row_bytes = int(src_obj.row_bytes)
-            # stage-split rerank: the host gather (shortlist sync +
-            # dedup + mmap read + upload) times under its own `fetch`
-            # span — before graft-flow this was invisibly folded into
-            # rerank time, hiding exactly the latency the prefetch
-            # pipeline overlaps
-            with obs.span("ivf_pq.fetch", source=source) as sf:
-                prepared = src_obj.prepare(queries, ids1)
-            with obs.span("ivf_pq.rerank", source=source) as s2:
-                d, ids, fetch = src_obj.score(prepared, int(k),
-                                              index.metric)
-                if obs.enabled():
-                    s2.sync(ids)
-            shortlist = ids1
+            source = "host" if src_obj.kind == "host" else "dataset"
+            p = _plan.refined_plan("tiered")
         else:
-            slot_filter = _slot_prefilter(index, prefilter)
-            slot_index = dataclasses.replace(
-                index, indices=_slot_indices(index.indices))
-            with obs.span("ivf_pq.first_stage", kc=kc) as s1:
-                _, slots = search(search_params, slot_index, queries, kc,
-                                  prefilter=slot_filter)
-                if obs.enabled():
-                    s1.sync(slots)
-            with obs.span("ivf_pq.rerank", source=source) as s2:
-                if source == "cache":
-                    row_bytes = (rot // 2 if kind == "i4" else rot) + 4
-                    d, s = _refine_slots(
-                        jnp.asarray(queries), slots, int(k),
-                        int(index.metric), index.recon_cache,
-                        index.cache_scales, index.centers_rot,
-                        index.rotation, jnp.float32(index.recon_scale),
-                    )
-                else:
-                    row_bytes = packed_words(index.pq_dim,
-                                             index.pq_bits) * 4
-                    codes3 = index.codes
-                    d, s = _refine_slots_codes(
-                        jnp.asarray(queries), slots, int(k),
-                        int(index.metric), codes3, index.pq_centers,
-                        index.centers_rot, int(index.codebook_kind),
-                        int(index.pq_dim), int(index.pq_bits),
-                        rotation=index.rotation,
-                    )
-                ids = jnp.where(
-                    s >= 0, index.indices.reshape(-1)[jnp.maximum(s, 0)],
-                    -1)
-                if obs.enabled():
-                    s2.sync(ids)
-            shortlist = slots
+            source = "cache" if kind in ("i8", "i4") else "codes"
+            p = _plan.refined_plan(source)
+        compiled = _plan.compile(p, index, k=int(k),
+                                 search_params=search_params,
+                                 refine_ratio=int(refine_ratio),
+                                 source=src_obj)
+        d, ids = compiled(queries, prefilter=prefilter)
         if obs.enabled():
-            # the bytes-moved split ROADMAP item 3 budgets against —
-            # counting what was ACTUALLY read at fidelity: valid
-            # shortlist slots only (when k*refine_ratio over-fetches
-            # past the available candidates the sentinel (-1) padding
-            # slots fetch nothing), and on the tiered host path the
-            # per-batch UNIQUE rows (the gather dedupes repeats before
-            # a byte moves). Stage latency is device-complete (synced
-            # above).
-            if source == "host" and fetch is not None:
-                valid_slots = int(fetch.valid_slots)
-                fetched_rows = int(fetch.unique_rows)
-            else:
-                # the shortlist is already host-synced by s1.sync above
-                valid_slots = int(np.count_nonzero(
-                    np.asarray(shortlist) >= 0))
-                fetched_rows = valid_slots
-            obs.counter("rerank.queries_total", m, algo="ivf_pq")
-            obs.counter("rerank.shortlist_rows", valid_slots,
-                        algo="ivf_pq")
-            obs.counter("rerank.bytes_fetched_total",
-                        fetched_rows * row_bytes, source=source)
-            obs.gauge("rerank.bytes_per_query",
-                      fetched_rows * row_bytes / max(m, 1),
-                      source=source)
-            if getattr(s1, "device_ms", None) is not None:
-                obs.observe("rerank.stage_ms", s1.device_ms,
-                            stage="first_stage")
-            if src_obj is not None and sf.ms is not None:
-                # the fetch stage is HOST work (sync+gather+upload
-                # dispatch): wall-clock is the honest number — there is
-                # no device compute to sync on
-                obs.observe("rerank.stage_ms", sf.ms, stage="fetch")
-            if getattr(s2, "device_ms", None) is not None:
-                obs.observe("rerank.stage_ms", s2.device_ms,
-                            stage="rerank")
             _sp.set(source=source, shortlist=kc)
         return d, ids
 

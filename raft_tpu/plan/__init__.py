@@ -1,0 +1,51 @@
+"""graft-plan: a declarative query-plan IR + compiler for every search
+pipeline (ISSUE 20; ROADMAP item 8; docs/plans.md).
+
+A search is a :class:`Plan` — a small JSON-able DAG of typed stages
+(``coarse`` / ``probe`` / ``scan`` / ``filter`` / ``rerank`` /
+``fetch`` / ``score_fuse`` / ``merge``), each node carrying the
+dispatch-table op key naming its kernel family.  :func:`compile` binds
+a plan to an index at one (bucket, k, rung) point and returns the
+executable program; :mod:`~raft_tpu.plan.canonical` spells the
+pipelines the stack used to hand-wire (refined ivf_pq, the serve
+dispatch variants, hybrid dense+sparse fusion, the sharded
+worker/router split) as data.
+"""
+
+from raft_tpu.plan.canonical import (
+    hybrid_plan,
+    refined_plan,
+    serve_plan,
+    sharded_ivf_pq_plan,
+    split_at_merge,
+)
+from raft_tpu.plan.compiler import (
+    OPS,
+    CompiledPlan,
+    compile_plan,
+    register_op,
+)
+from raft_tpu.plan.ir import (
+    CANDIDATE_STAGES,
+    STAGES,
+    WIDTH_SYMBOLS,
+    Node,
+    Plan,
+    PlanError,
+    from_dict,
+    from_json,
+    to_dict,
+    to_json,
+    validate,
+)
+
+# the public compile entry point the tentpole names: plan.compile(...)
+compile = compile_plan  # noqa: A001 — deliberate, scoped to this package
+
+__all__ = [
+    "CANDIDATE_STAGES", "CompiledPlan", "Node", "OPS", "Plan",
+    "PlanError", "STAGES", "WIDTH_SYMBOLS", "compile", "compile_plan",
+    "from_dict", "from_json", "hybrid_plan", "refined_plan",
+    "register_op", "serve_plan", "sharded_ivf_pq_plan",
+    "split_at_merge", "to_dict", "to_json", "validate",
+]

@@ -1,0 +1,211 @@
+"""Canonical plans: the DAGs the re-plumbed consumers compile.
+
+Every pipeline the stack used to hand-wire is spelled here ONCE as
+data — ``ivf_pq.search_refined``'s three rerank shapes, the serve
+``_Handle`` dispatch variants, the hybrid dense+sparse fusion
+(ROADMAP 6(a)), and the sharded worker/router split.  Tests pin each
+compiled canonical plan bitwise against the dispatch it replaced;
+graft-lint GL024 keeps serve/comms from growing new hand-wired
+pipelines beside them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from raft_tpu.plan.ir import Node, Plan, PlanError, validate
+
+__all__ = [
+    "refined_plan", "serve_plan", "hybrid_plan", "sharded_ivf_pq_plan",
+    "split_at_merge",
+]
+
+
+def refined_plan(source: str) -> Plan:
+    """The :func:`raft_tpu.neighbors.ivf_pq.search_refined` pipeline
+    for one rerank ``source``:
+
+    * ``"tiered"`` — explicit dataset / RerankSource: first stage at
+      the shortlist width emits global ids, the tiered fetch gathers
+      the unique shortlist rows, the source scores them exactly;
+    * ``"cache"`` / ``"codes"`` — cacheless: the slot-translated
+      prefilter feeds a slot-substituted first stage, and the rerank
+      decodes the slot shortlist from the i8/i4 residual cache or the
+      packed PQ codes.
+    """
+    if source == "tiered":
+        nodes = (
+            Node("pre", "filter", "prefilter"),
+            Node("coarse", "coarse", "ivf.centers"),
+            Node("probe", "probe", "rung", inputs=("coarse",)),
+            Node("stage1", "scan", "ivf_pq.search",
+                 params={"width": "shortlist", "first_stage": True},
+                 inputs=("probe", "pre")),
+            Node("fetch", "fetch", "tiered.prepare", inputs=("stage1",)),
+            Node("rerank", "rerank", "tiered.score",
+                 params={"width": "k"}, inputs=("stage1", "fetch")),
+        )
+        return Plan("ivf_pq.refined.tiered", nodes, "rerank")
+    if source in ("cache", "codes"):
+        nodes = (
+            Node("pre", "filter", "slot_prefilter"),
+            Node("coarse", "coarse", "ivf.centers"),
+            Node("probe", "probe", "rung", inputs=("coarse",)),
+            Node("stage1", "scan", "ivf_pq.first_stage",
+                 params={"width": "shortlist"}, inputs=("probe", "pre")),
+            Node("rerank", "rerank", f"ivf_pq.{source}",
+                 params={"width": "k"}, inputs=("stage1",)),
+        )
+        return Plan(f"ivf_pq.refined.{source}", nodes, "rerank")
+    raise PlanError(f"unknown refined rerank source {source!r} "
+                    f"(want tiered | cache | codes)")
+
+
+def serve_plan(algo: str, variant: str = "plain") -> Plan:
+    """The serve engine's per-handle dispatch as a plan.  ``variant``
+    selects among the shapes ``_Handle.search_main`` used to branch
+    between:
+
+    * ``"plain"`` — the single-stage scan every algo has;
+    * ``"refined_tiered"`` / ``"refined_cache"`` / ``"refined_codes"``
+      — ivf_pq multi-stage rerank (tiered source / residual cache /
+      packed codes);
+    * ``"raw_refine"`` — ivf_pq over-fetch + exact device rerank
+      against the generation's raw rows;
+    * ``"exact"`` — the quality monitor's oracle: exhaustive probing
+      (rung pins n_probes = n_lists) re-ranked from the exact tier
+      (ROADMAP 9(a); same DAG as refined_tiered — the bias fix is in
+      what the rung binds, not in the shape).
+    """
+    if algo == "hybrid":
+        return hybrid_plan()
+    if algo in ("brute_force", "cagra"):
+        nodes = (
+            Node("pre", "filter", "prefilter"),
+            Node("scan", "scan", f"{algo}.search",
+                 params={"width": "k"}, inputs=("pre",)),
+        )
+        return Plan(f"serve.{algo}", nodes, "scan")
+    if algo == "ivf_flat" or (algo == "ivf_pq" and variant == "plain"):
+        nodes = (
+            Node("pre", "filter", "prefilter"),
+            Node("coarse", "coarse", "ivf.centers"),
+            Node("probe", "probe", "rung", inputs=("coarse",)),
+            Node("scan", "scan", f"{algo}.search",
+                 params={"width": "k"}, inputs=("probe", "pre")),
+        )
+        return Plan(f"serve.{algo}", nodes, "scan")
+    if algo != "ivf_pq":
+        raise PlanError(f"no serve plan for algo {algo!r}")
+    if variant in ("refined_tiered", "exact"):
+        base = refined_plan("tiered")
+        return Plan(f"serve.ivf_pq.{variant}", base.nodes, base.output)
+    if variant in ("refined_cache", "refined_codes"):
+        base = refined_plan(variant.split("_", 1)[1])
+        return Plan(f"serve.ivf_pq.{variant}", base.nodes, base.output)
+    if variant == "raw_refine":
+        nodes = (
+            Node("pre", "filter", "prefilter"),
+            Node("coarse", "coarse", "ivf.centers"),
+            Node("probe", "probe", "rung", inputs=("coarse",)),
+            Node("scan", "scan", "ivf_pq.search",
+                 params={"width": "refine"}, inputs=("probe", "pre")),
+            Node("rerank", "rerank", "exact.device",
+                 params={"width": "k"}, inputs=("scan",)),
+        )
+        return Plan("serve.ivf_pq.raw_refine", nodes, "rerank")
+    raise PlanError(f"unknown ivf_pq serve variant {variant!r}")
+
+
+def hybrid_plan(fuse_expand: Optional[int] = None) -> Plan:
+    """ROADMAP 6(a) as a plan, not a code path: a dense brute-force leg
+    and a sparse CSR lexical leg each over-fetch at the fuse width, the
+    ``score_fuse`` node re-scores each leg's candidates on the OTHER
+    leg and weight-merges (union semantics, duplicates masked), and one
+    ``merge_topk`` keeps the fused top-k."""
+    fuse_params = {"width": "fuse"}
+    if fuse_expand is not None:
+        fuse_params["expand"] = int(fuse_expand)
+    nodes = (
+        Node("pre", "filter", "prefilter"),
+        Node("dense", "scan", "hybrid.dense", params=dict(fuse_params),
+             inputs=("pre",)),
+        Node("sparse", "scan", "sparse.brute_force",
+             params=dict(fuse_params), inputs=("pre",)),
+        Node("fuse", "score_fuse", "weighted",
+             inputs=("dense", "sparse")),
+        Node("merge", "merge", "topk", params={"width": "k"},
+             inputs=("fuse",)),
+    )
+    return Plan("serve.hybrid", nodes, "merge")
+
+
+def sharded_ivf_pq_plan(k: int, k_search: int, k_merge: int,
+                        local_rerank: bool = False,
+                        tail: Optional[str] = None) -> Plan:
+    """The ``comms/sharded`` ivf_pq pipeline: everything up to and
+    including the ``collective.topk`` merge executes per worker inside
+    ``shard_map`` (the pre-merge subplan), everything after executes
+    once on the router (:func:`split_at_merge` cuts it there — the
+    plan, not a bespoke RPC surface, is what ships to workers).
+
+    ``local_rerank`` inserts the per-shard cache-decoded exact rerank
+    (i8/i4 caches, ``refine_ratio > 1``); ``tail`` adds a router-side
+    rerank over the merged shortlist — ``"tiered"`` for an explicit
+    ``rerank_source``, ``"codes"`` for the rabitq slot shortlist
+    re-scored at full PQ fidelity against the full index."""
+    nodes = [
+        Node("coarse", "coarse", "ivf.centers"),
+        Node("probe", "probe", "rung", inputs=("coarse",)),
+        Node("scan", "scan", "ivf_pq.local",
+             params={"width": int(k_search)}, inputs=("probe",)),
+    ]
+    pre_merge = "scan"
+    if local_rerank:
+        nodes.append(Node("local_rerank", "rerank", "ivf_pq.cache.local",
+                          params={"width": int(k)}, inputs=("scan",)))
+        pre_merge = "local_rerank"
+    nodes.append(Node("merge", "merge", "collective.topk",
+                      params={"width": int(k_merge)},
+                      inputs=(pre_merge,)))
+    output = "merge"
+    if tail == "tiered":
+        nodes.append(Node("tail", "rerank", "tiered.rerank",
+                          params={"width": int(k)}, inputs=("merge",)))
+        output = "tail"
+    elif tail == "codes":
+        nodes.append(Node("tail", "rerank", "ivf_pq.codes",
+                          params={"width": int(k)}, inputs=("merge",)))
+        output = "tail"
+    elif tail is not None:
+        raise PlanError(f"unknown sharded tail {tail!r}")
+    name = "sharded.ivf_pq" + (f".{tail}" if tail else "")
+    return Plan(name, tuple(nodes), output)
+
+
+def split_at_merge(plan: Plan) -> Tuple[Plan, Optional[Plan]]:
+    """Split a sharded plan at its ``collective.topk`` node: the head
+    (everything up to and including the merge) runs per worker inside
+    the collective program; the tail (if any) runs once on the router,
+    seeded with the merged candidates through an identity scan node
+    that keeps the tail a valid DAG."""
+    order = validate(plan)
+    cut = None
+    for n in order:
+        if n.op == "collective.topk":
+            cut = n
+            break
+    if cut is None:
+        raise PlanError(f"plan {plan.name!r} has no collective.topk "
+                        f"merge to split at")
+    pos = order.index(cut)
+    head = Plan(plan.name + ".head", tuple(order[:pos + 1]), cut.id)
+    validate(head)
+    rest = order[pos + 1:]
+    if not rest:
+        return head, None
+    seed = Node(cut.id, "scan", "identity",
+                params={"width": dict(cut.params).get("width")})
+    tail = Plan(plan.name + ".tail", (seed,) + tuple(rest), plan.output)
+    validate(tail)
+    return head, tail

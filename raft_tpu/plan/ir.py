@@ -1,0 +1,322 @@
+"""graft-plan IR: a search pipeline as a DAG of typed stages.
+
+The serving stack composes the same handful of stages everywhere —
+coarse scan, probe-rung selection, first-stage scan, prefilter,
+shortlist rerank, tiered fetch, score fusion, top-k merge — but until
+ISSUE 20 every composition was hand-wired per algorithm
+(``ivf_pq.search_refined``, the serve ``_Handle`` adapters, and the
+``comms/sharded`` variants each re-plumbed the same sequence).  This
+module is the declarative half of the fix: a :class:`Plan` is a small,
+JSON-serializable DAG of :class:`Node` objects, each carrying the
+stage it plays, the dispatch-table op key that names its kernel
+family (``tuning.choose`` keeps picking implementations per node
+through the ops the executor calls), and static parameters.  The
+imperative half — binding a plan to an index and producing one traced
+program per (bucket, k, rung) — lives in
+:mod:`raft_tpu.plan.compiler`.
+
+Validation enforces the stage contracts the hand-wired code used to
+enforce by construction: the graph must be acyclic, every node must
+feed the output, filters compose only *upstream* of candidate
+selection (a filter after a merge would un-delete rows the tombstone
+overlay already removed — the classic fan-in bug), ``score_fuse``
+takes exactly two candidate legs, and candidate widths only narrow
+downstream (a rerank that *widens* its shortlist would read rows the
+first stage never scored).  See docs/plans.md for the node catalog
+and the add-a-node guide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Mapping, Optional, Tuple
+
+# the stage vocabulary (ROADMAP item 8): every node plays exactly one
+STAGES = ("coarse", "probe", "scan", "filter", "rerank", "fetch",
+          "score_fuse", "merge")
+
+# stages whose value is a candidate set — a (distances, ids) pair of
+# some width. ``fetch`` rides with them: its value is an opaque
+# prepared-shortlist handle, but contract-wise it sits on the
+# candidate path between a scan and the rerank that scores it.
+CANDIDATE_STAGES = frozenset(
+    {"scan", "rerank", "fetch", "score_fuse", "merge"})
+
+# who may consume whom: stage -> allowed CONSUMER stages. ``filter``
+# deliberately cannot feed score_fuse/merge (filters compose into the
+# first stage so a filtered row never reaches a shortlist —
+# docs/serving.md §5), and nothing downstream of a merge may feed a
+# filter (the "filter-after-merge" negative the tests pin).
+_ALLOWED_CONSUMERS = {
+    "coarse": {"probe", "scan"},
+    "probe": {"scan"},
+    "filter": {"scan", "rerank", "filter"},
+    "scan": {"rerank", "fetch", "score_fuse", "merge"},
+    "fetch": {"rerank"},
+    "rerank": {"score_fuse", "merge", "rerank", "fetch"},
+    "score_fuse": {"merge"},
+    "merge": {"rerank", "fetch", "merge", "score_fuse"},
+}
+
+# symbolic candidate widths a node may declare instead of a literal
+# int, resolved by the compiler against its (k, refine_ratio, index)
+# bindings: "k" = the caller's k; "shortlist" = the canonical
+# first-stage over-fetch (ivf_pq.refined_shortlist_width); "refine" =
+# min(k * refine_ratio, rows) (the serve raw-refine over-fetch);
+# "fuse" = the hybrid per-leg candidate width.
+WIDTH_SYMBOLS = ("k", "shortlist", "refine", "fuse")
+
+_WIDTH_RANK = {"k": 0, "refine": 1, "shortlist": 1, "fuse": 1}
+
+
+class PlanError(ValueError):
+    """A plan failed validation (malformed DAG or a stage-contract
+    violation). Raised at plan build / compile time — never from the
+    compiled program's hot path."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """One typed stage in a plan DAG.
+
+    ``op`` is the dispatch-table key naming the kernel family the
+    compiler binds (e.g. ``"ivf_pq.first_stage"``); the executor it
+    resolves to calls the same tuned entry points the hand-wired
+    pipelines called, so ``tuning.choose`` keeps picking
+    implementations per node.  ``params`` holds static, JSON-able
+    configuration (widths may be symbolic — see
+    :data:`WIDTH_SYMBOLS`); anything runtime-bound (the index, the
+    queries, a prefilter) arrives through the compiler, never the IR.
+    """
+
+    id: str
+    stage: str
+    op: str
+    params: Mapping = dataclasses.field(default_factory=dict)
+    inputs: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        # normalize mutable containers so Plans hash/compare sanely
+        object.__setattr__(self, "params", dict(self.params))
+        object.__setattr__(self, "inputs", tuple(self.inputs))
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """A validated-on-demand DAG of :class:`Node`; ``output`` names the
+    node whose value — a (distances, ids) candidate pair at width k —
+    the compiled program returns."""
+
+    name: str
+    nodes: Tuple[Node, ...]
+    output: str
+
+    def __post_init__(self):
+        object.__setattr__(self, "nodes", tuple(self.nodes))
+
+    def node(self, node_id: str) -> Node:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        raise KeyError(node_id)
+
+
+def _toposort(plan: Plan) -> List[Node]:
+    """Kahn topological order; raises :class:`PlanError` on a cycle."""
+    by_id: Dict[str, Node] = {n.id: n for n in plan.nodes}
+    indeg = {n.id: 0 for n in plan.nodes}
+    consumers: Dict[str, List[str]] = {n.id: [] for n in plan.nodes}
+    for n in plan.nodes:
+        for src in n.inputs:
+            if src not in by_id:
+                raise PlanError(
+                    f"plan {plan.name!r}: node {n.id!r} reads "
+                    f"unknown input {src!r}")
+            indeg[n.id] += 1
+            consumers[src].append(n.id)
+    ready = sorted(nid for nid, d in indeg.items() if d == 0)
+    order: List[Node] = []
+    while ready:
+        nid = ready.pop(0)
+        order.append(by_id[nid])
+        for c in consumers[nid]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                ready.append(c)
+        ready.sort()   # deterministic order for a given plan
+    if len(order) != len(plan.nodes):
+        stuck = sorted(nid for nid, d in indeg.items() if d > 0)
+        raise PlanError(
+            f"plan {plan.name!r}: cycle through nodes {stuck}")
+    return order
+
+
+def _width_rank(value) -> Optional[int]:
+    """Comparable coarse rank for a declared candidate width: literal
+    ints compare exactly; symbolic widths compare by role ("k" is the
+    final width, everything else an over-fetch). None = undeclared
+    (no contract to check)."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        raise PlanError(f"width must be an int or one of "
+                        f"{WIDTH_SYMBOLS}, got {value!r}")
+    if isinstance(value, int):
+        if value < 1:
+            raise PlanError(f"width must be >= 1, got {value}")
+        return None          # literal-vs-symbol never comparable
+    if value in _WIDTH_RANK:
+        return _WIDTH_RANK[value]
+    raise PlanError(
+        f"width must be an int or one of {WIDTH_SYMBOLS}, got {value!r}")
+
+
+def validate(plan: Plan) -> List[Node]:
+    """Validate ``plan`` and return its nodes in topological order.
+
+    Checks: unique non-empty ids, known stages, resolvable inputs,
+    acyclicity, full reachability of the output, per-stage consumer
+    contracts (:data:`_ALLOWED_CONSUMERS` — including the
+    filter-after-merge rule), arity contracts (``score_fuse`` takes
+    exactly two candidate legs; ``rerank`` consumes a candidate or a
+    fetch), and the narrowing-width contract between candidate
+    stages."""
+    if not isinstance(plan.output, str) or not plan.output:
+        raise PlanError(f"plan {plan.name!r}: empty output")
+    seen = set()
+    for n in plan.nodes:
+        if not n.id or not isinstance(n.id, str):
+            raise PlanError(f"plan {plan.name!r}: empty node id")
+        if n.id in seen:
+            raise PlanError(
+                f"plan {plan.name!r}: duplicate node id {n.id!r}")
+        seen.add(n.id)
+        if n.stage not in STAGES:
+            raise PlanError(
+                f"plan {plan.name!r}: node {n.id!r} has unknown stage "
+                f"{n.stage!r} (want one of {STAGES})")
+        if not n.op or not isinstance(n.op, str):
+            raise PlanError(
+                f"plan {plan.name!r}: node {n.id!r} has no op key")
+        _width_rank(n.params.get("width"))
+    if plan.output not in seen:
+        raise PlanError(
+            f"plan {plan.name!r}: output {plan.output!r} is not a node")
+    order = _toposort(plan)
+    by_id = {n.id: n for n in plan.nodes}
+
+    out = by_id[plan.output]
+    if out.stage not in CANDIDATE_STAGES or out.stage == "fetch":
+        raise PlanError(
+            f"plan {plan.name!r}: output node {out.id!r} must be a "
+            f"candidate-producing stage (scan/rerank/score_fuse/merge), "
+            f"got {out.stage!r}")
+
+    # edge contracts
+    for n in plan.nodes:
+        for src_id in n.inputs:
+            src = by_id[src_id]
+            allowed = _ALLOWED_CONSUMERS[src.stage]
+            if n.stage not in allowed:
+                raise PlanError(
+                    f"plan {plan.name!r}: {src.stage} node {src.id!r} "
+                    f"cannot feed {n.stage} node {n.id!r} "
+                    f"(allowed consumers: {sorted(allowed)})")
+        cand_inputs = [by_id[s] for s in n.inputs
+                       if by_id[s].stage in CANDIDATE_STAGES]
+        if n.stage == "score_fuse" and len(cand_inputs) != 2:
+            raise PlanError(
+                f"plan {plan.name!r}: score_fuse node {n.id!r} needs "
+                f"exactly 2 candidate legs, got {len(cand_inputs)}")
+        if n.stage in ("rerank", "fetch", "merge") and not cand_inputs:
+            raise PlanError(
+                f"plan {plan.name!r}: {n.stage} node {n.id!r} has no "
+                f"candidate input to consume")
+        # narrowing-width contract: a candidate consumer never declares
+        # a wider set than any producer it reads
+        if n.stage in CANDIDATE_STAGES:
+            w_n = n.params.get("width")
+            for src in cand_inputs:
+                w_s = src.params.get("width")
+                if isinstance(w_n, int) and isinstance(w_s, int):
+                    if n.stage != "merge" and w_n > w_s:
+                        raise PlanError(
+                            f"plan {plan.name!r}: node {n.id!r} widens "
+                            f"its candidate set ({w_s} -> {w_n}); "
+                            f"widths only narrow downstream")
+                else:
+                    r_n, r_s = _width_rank(w_n), _width_rank(w_s)
+                    if (r_n is not None and r_s is not None
+                            and r_n > r_s):
+                        raise PlanError(
+                            f"plan {plan.name!r}: node {n.id!r} "
+                            f"(width {w_n!r}) widens over {src.id!r} "
+                            f"(width {w_s!r})")
+
+    # reachability: every node must feed the output (dead nodes are a
+    # spec bug, not an optimization opportunity)
+    live = {plan.output}
+    frontier = [plan.output]
+    while frontier:
+        nid = frontier.pop()
+        for src in by_id[nid].inputs:
+            if src not in live:
+                live.add(src)
+                frontier.append(src)
+    dead = sorted(seen - live)
+    if dead:
+        raise PlanError(
+            f"plan {plan.name!r}: nodes {dead} do not feed the "
+            f"output {plan.output!r}")
+    return order
+
+
+# ---------------------------------------------------------------------------
+# serialization — plans ship to workers (comms/sharded) and into
+# artifacts, so the wire format is plain JSON
+# ---------------------------------------------------------------------------
+
+_SCHEMA_VERSION = 1
+
+
+def to_dict(plan: Plan) -> dict:
+    """Plain-dict form (JSON-able; ``from_dict`` round-trips it)."""
+    return {
+        "schema": _SCHEMA_VERSION,
+        "name": plan.name,
+        "output": plan.output,
+        "nodes": [
+            {"id": n.id, "stage": n.stage, "op": n.op,
+             "params": dict(n.params), "inputs": list(n.inputs)}
+            for n in plan.nodes
+        ],
+    }
+
+
+def from_dict(d: Mapping) -> Plan:
+    """Inverse of :func:`to_dict`; validates the result."""
+    if int(d.get("schema", 1)) != _SCHEMA_VERSION:
+        raise PlanError(
+            f"unknown plan schema {d.get('schema')!r} "
+            f"(this build speaks {_SCHEMA_VERSION})")
+    try:
+        nodes = tuple(
+            Node(id=nd["id"], stage=nd["stage"], op=nd["op"],
+                 params=dict(nd.get("params", {})),
+                 inputs=tuple(nd.get("inputs", ())))
+            for nd in d["nodes"])
+        plan = Plan(name=str(d.get("name", "plan")), nodes=nodes,
+                    output=d["output"])
+    except (KeyError, TypeError) as e:
+        raise PlanError(f"malformed plan dict: {e!r}") from e
+    validate(plan)
+    return plan
+
+
+def to_json(plan: Plan) -> str:
+    return json.dumps(to_dict(plan), sort_keys=True)
+
+
+def from_json(s: str) -> Plan:
+    return from_dict(json.loads(s))

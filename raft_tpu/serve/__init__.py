@@ -84,6 +84,8 @@ TRACKED_JITS = (
     ("raft_tpu.neighbors.tiered", "_score_fetched_hot"),
     ("raft_tpu.neighbors.tiered", "_promote_scatter"),
     ("raft_tpu.serve.engine", "_merge_with_side"),
+    ("raft_tpu.neighbors.hybrid", "_fuse_rescore"),
+    ("raft_tpu.sparse.neighbors", "_score_block_dense_q"),
     ("raft_tpu.matrix.select_k", "_select_k"),
     ("raft_tpu.matrix.select_k", "_tournament_topk"),
 )

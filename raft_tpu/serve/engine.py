@@ -54,9 +54,8 @@ from raft_tpu.obs import trace as obs_trace
 from raft_tpu.core import pipeline as _pipeline
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.distance.types import is_min_close, resolve_metric
-from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.neighbors import brute_force, cagra, hybrid, ivf_flat, ivf_pq
 from raft_tpu.neighbors.common import BitsetFilter, merge_topk
-from raft_tpu.neighbors.refine import refine as _exact_refine
 from raft_tpu.resilience import errors as _rerrors
 from raft_tpu.resilience import faultinject
 from raft_tpu.serve import adaptive as _adaptive
@@ -72,7 +71,7 @@ from raft_tpu.serve.mutation import MutableState
 from raft_tpu.serve.quality import QualityMonitor
 from raft_tpu.serve.registry import Registry
 
-ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+ALGOS = ("brute_force", "ivf_flat", "ivf_pq", "cagra", "hybrid")
 
 # the refine over-fetch a rabitq-cache index is served at when the
 # caller left refine_ratio defaulted — ONE home: _Handle.pipeline_rr
@@ -181,7 +180,8 @@ class _Handle:
                  "user_search_params", "build_params",
                  "refine_ratio", "metric", "select_min", "dtype", "dim",
                  "rows", "raw_dataset", "_raw_dev", "_side_cache",
-                 "tiered_source", "adaptive")
+                 "tiered_source", "adaptive", "_plan_cache",
+                 "_plan_memo")
 
     def __init__(self, algo: str, index, state: MutableState,
                  search_params, build_params, refine_ratio: int,
@@ -222,6 +222,14 @@ class _Handle:
         # resolved n_probes ceiling, so a swap re-derives the whole
         # ladder (not just the ceiling) against the successor index
         self.adaptive = adaptive
+        # compiled query plans (ISSUE 20): one CompiledPlan per
+        # (k, rung, n_probes, refine_ratio) point, built lazily and by
+        # warmup — per GENERATION, so a swap/compaction recompiles
+        # against the successor index by construction. The memo shares
+        # derived device arrays (the slot-substituted indices block)
+        # across this handle's variants.
+        self._plan_cache: Dict[tuple, object] = {}
+        self._plan_memo: Dict[str, object] = {}
 
     def pipeline_rr(self) -> int:
         """The refine_ratio the multi-stage pipeline dispatches at:
@@ -253,6 +261,15 @@ class _Handle:
         trace-key-is-the-value discipline."""
         if rung is None:
             return self.search_params, self.pipeline_rr()
+        if rung == "exact":
+            # ROADMAP 9(a): the shadow oracle's exact-tier rung —
+            # exhaustive probing with the shortlist re-ranked from the
+            # exact tier. n_probes carries the VALUE (n_lists), so the
+            # trace-key-is-the-value discipline holds: an adaptive and
+            # a non-adaptive handle compile the same program here.
+            sp = dataclasses.replace(self.search_params,
+                                     n_probes=int(self.index.n_lists))
+            return sp, self.pipeline_rr()
         if self.adaptive is None:
             if self.algo in ("ivf_flat", "ivf_pq"):
                 sp = dataclasses.replace(self.search_params,
@@ -276,11 +293,29 @@ class _Handle:
         is exact over the filtered index whatever the training quality;
         ivf_pq's refined pipeline reranks its shortlist with exact
         distances — both outrank any ceiling a bad swap can configure.
-        brute_force/cagra have no probe axis to escalate."""
+        brute_force/cagra have no probe axis to escalate.
+
+        ROADMAP 9(a) bias fix: when the generation carries an EXACT
+        tier (a tiered ``RerankSource`` or the raw row store), the
+        oracle is the exact-rerank plan at exhaustive probing (the
+        ``"exact"`` rung) — not the same quantizer's exhaustive rung. A
+        quantized oracle scores its own quantization error as ground
+        truth: the candidates IT mis-ranks look "matched" when serving
+        mis-ranks them the same way, so recall over-estimates on
+        ivf_pq/rabitq exactly where the estimate matters."""
         if self.algo not in ("ivf_flat", "ivf_pq"):
             return None
         n_lists = int(self.index.n_lists)
         cur = int(getattr(self.search_params, "n_probes", n_lists))
+        if self.algo == "ivf_pq" and (
+                getattr(self, "tiered_source", None) is not None
+                or getattr(self, "raw_dataset", None) is not None):
+            if n_lists > cur:
+                return "exact"
+            # ceiling already exhaustive: the exact tier still outranks
+            # a quantized-only serving path (refine_ratio == 1); the
+            # refined pipelines already ARE the exact-rerank program
+            return "exact" if self.plan_variant(None) == "plain" else None
         return n_lists if n_lists > cur else None
 
     def raw_dev(self):
@@ -294,54 +329,91 @@ class _Handle:
 
     def search_main(self, qdev, k: int, filt: BitsetFilter,
                     rung: Optional[int] = None):
-        """Search the main index; ``rung`` (an adaptive probe-ladder
-        value) overrides the resolved ``n_probes`` — and, on the rabitq
-        pipeline, the per-rung refine_ratio. ``rung=None`` is the
+        """Search the main index through this generation's compiled
+        query plan (ISSUE 20); ``rung`` (an adaptive probe-ladder
+        value, or the shadow oracle's ``"exact"``) selects among the
+        compiled plan variants — each overriding only ``n_probes``
+        (and, on the rabitq pipeline, the per-rung refine_ratio), so
+        the trace key stays the VALUE. ``rung=None`` is the
         exhaustive/non-adaptive path, byte-for-byte today's."""
+        return self.compiled(int(k), rung)(qdev, prefilter=filt)
+
+    def plan_variant(self, rung) -> str:
+        """Which canonical serve plan (plan/canonical.py) this handle's
+        configuration dispatches for ``rung`` — the same resolution
+        order the hand-wired ``search_main`` branched through:
+        tiered-source refined, rabitq refined (raw store else packed
+        codes), raw-refine over-fetch, else the plain scan. The shadow
+        oracle's ``"exact"`` rung is its own variant (same DAG as the
+        tiered refined plan; the bias fix is in what the rung binds)."""
+        if self.algo != "ivf_pq":
+            return "plain"
+        if rung == "exact":
+            return "exact"
+        kind = getattr(self.index, "cache_kind", "none")
+        if self.tiered_source is not None and (
+                kind == "rabitq" or self.refine_ratio > 1):
+            # the tiered-memory shape (docs/serving.md §12): the raw
+            # originals stay HOST-resident and the rerank stage fetches
+            # only this batch's unique shortlist rows
+            return "refined_tiered"
+        if kind == "rabitq" and (
+                self.raw_dataset is not None
+                or int(self.index.codes.shape[-1]) > 0):
+            # the rabitq rung IS a multi-stage pipeline: sign-bit first
+            # stage + exact rerank. Rerank source: the generation's raw
+            # row store when serving kept it, else the index's own PQ
+            # codes.
+            return ("refined_tiered" if self.raw_dataset is not None
+                    else "refined_codes")
+        if self.refine_ratio > 1 and self.raw_dataset is not None:
+            return "raw_refine"
+        return "plain"
+
+    def compiled(self, k: int, rung=None):
+        """The compiled plan for one (k, rung) point — cached per
+        generation. The key carries the RESOLVED (n_probes,
+        refine_ratio) pair, not just the rung, so a quality retune that
+        moves a rung's refine ratio compiles a fresh program instead of
+        serving a stale one (the hand-wired path re-resolved per call;
+        the cache must not change that)."""
         sp, rr = self.rung_params(rung)
-        if self.algo == "brute_force":
-            return brute_force.search(self.index, qdev, k, prefilter=filt)
-        if self.algo == "ivf_flat":
-            return ivf_flat.search(sp, self.index, qdev, k,
-                                   prefilter=filt)
-        if self.algo == "ivf_pq":
-            kind = getattr(self.index, "cache_kind", "none")
-            if self.tiered_source is not None and (
-                    kind == "rabitq" or self.refine_ratio > 1):
-                # the tiered-memory shape (docs/serving.md §12): the
-                # raw originals stay HOST-resident and the rerank
-                # stage fetches only this batch's unique shortlist
-                # rows (hot rows served from the HBM cache). Bitwise
-                # identical to the raw_dev() full-upload paths below.
-                return ivf_pq.search_refined(
-                    sp, self.index, qdev, k,
-                    refine_ratio=rr, prefilter=filt,
-                    dataset=self.tiered_source)
-            if kind == "rabitq" and (
-                    self.raw_dataset is not None
-                    or int(self.index.codes.shape[-1]) > 0):
-                # the rabitq rung IS a multi-stage pipeline: sign-bit
-                # first stage + exact rerank, with tombstone/user
-                # prefilters composed into the first stage so filtered
-                # rows never reach the shortlist (docs/serving.md §5).
-                # Rerank source: the generation's raw row store when
-                # serving kept it, else the index's own PQ codes.
-                return ivf_pq.search_refined(
-                    sp, self.index, qdev, k,
-                    refine_ratio=rr, prefilter=filt,
-                    dataset=self.raw_dev())
-            if self.refine_ratio > 1 and self.raw_dataset is not None:
-                kc = min(k * self.refine_ratio, self.rows)
-                d, i = ivf_pq.search(sp, self.index, qdev,
-                                     kc, prefilter=filt)
-                return _exact_refine(self.raw_dev(), qdev, i, k,
-                                     self.metric)
-            return ivf_pq.search(sp, self.index, qdev, k,
-                                 prefilter=filt)
-        if self.algo == "cagra":
-            return cagra.search(self.search_params, self.index, qdev, k,
-                                prefilter=filt)
-        raise ValueError(f"unknown algo {self.algo!r}")
+        key = (int(k), rung, getattr(sp, "n_probes", None), rr)
+        cp = self._plan_cache.get(key)
+        if cp is None:
+            cp = self._compile_variant(int(k), rung, sp, rr)
+            # benign publish race: concurrent threads compile identical
+            # programs for the same key; last write wins
+            self._plan_cache[key] = cp
+        return cp
+
+    def _compile_variant(self, k: int, rung, sp, rr: int):
+        from raft_tpu import plan as _plan
+        from raft_tpu.neighbors import tiered as _tiered
+
+        variant = self.plan_variant(rung)
+        p = _plan.serve_plan(self.algo, variant)
+        source = None
+        raw_dev = None
+        refine_ratio = rr
+        if variant in ("refined_tiered", "exact"):
+            # the exact tier: the host tiered source when serving keeps
+            # one, else the device-resident raw rows as a full-upload
+            # source (bitwise-identical scoring either way)
+            source = (self.tiered_source
+                      if self.tiered_source is not None
+                      else _tiered.as_source(self.raw_dev()))
+        elif variant == "raw_refine":
+            raw_dev = self.raw_dev()
+            refine_ratio = self.refine_ratio
+        extra = {"select_min": self.select_min}
+        if self.algo == "hybrid":
+            extra["fuse_expand"] = int(getattr(sp, "fuse_expand", 4))
+        return _plan.compile(p, self.index, k=int(k), rung=rung,
+                             search_params=sp,
+                             refine_ratio=int(refine_ratio),
+                             source=source, raw_dev=raw_dev,
+                             memo=self._plan_memo, **extra)
 
     def side_index(self):
         """Brute-force index + device id map over the (padded) side
@@ -379,6 +451,11 @@ class _Handle:
         seq, a, b = snap
         if not isinstance(a, np.ndarray):  # cache hit: already built
             return a, b
+        if self.algo == "hybrid":
+            # column weights fold the fuse into the side scan: a plain
+            # IP over weighted rows IS the fused score, so side hits
+            # merge against main-index hits on the same scale
+            a = a * hybrid.side_scale(self.index)[None, :]
         idx = brute_force.build(a, metric=self.metric)
         ids_dev = jax.device_put(b.astype(np.int32))
         with self.state.lock:
@@ -1061,6 +1138,7 @@ class _IndexServing:
                              rung=batch.rung)
         if side_idx is not None:
             k_side = min(kq, side_idx.size)
+            # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path: the side upsert buffer is a small exact scan merged after the main compiled plan, not a pipeline
             sd, sp = brute_force.search(
                 side_idx, qdev, k_side,
                 prefilter=None if side_bits is None
@@ -1195,7 +1273,8 @@ class _IndexServing:
                                     and h.algo == "ivf_pq"
                                     and (h.refine_ratio > 1 or getattr(
                                         h.index, "cache_kind", "none")
-                                        == "rabitq")):
+                                        == "rabitq"
+                                        or rung == "exact")):
                                 # tiered rerank: the fetched-block rung
                                 # is data-dependent (unique shortlist
                                 # rows), so trace the whole pow2 rung
@@ -1898,10 +1977,18 @@ _ALGO_MODULES = {
     "ivf_flat": ivf_flat,
     "ivf_pq": ivf_pq,
     "cagra": cagra,
+    "hybrid": hybrid,
 }
 
 
 def _build_index(algo: str, dataset: np.ndarray, build_params):
+    if algo == "hybrid":
+        if build_params is None:
+            raise ValueError(
+                "algo='hybrid' needs build_params=hybrid.IndexParams("
+                "dense_dim=...) — the engine cannot guess where the "
+                "dense columns end and the vocab begins")
+        return hybrid.build(build_params, dataset)
     if algo == "brute_force":
         if build_params is None:
             return brute_force.build(dataset)
@@ -1939,6 +2026,8 @@ def _default_search_params(algo: str, index, search_params):
                                    local_recall_target=1.0)
     if algo == "cagra":
         return cagra.SearchParams(itopk_size=128)
+    if algo == "hybrid":
+        return hybrid.SearchParams()
     return None
 
 
@@ -1970,5 +2059,7 @@ def _extend_index(h: _Handle, vectors: np.ndarray, int_ids: np.ndarray):
     if algo == "brute_force":
         return brute_force.build(full, metric=h.metric,
                                  metric_arg=h.index.metric_arg), full
+    if algo == "hybrid":
+        return hybrid.build(h.build_params, full), full
     params = h.build_params or cagra.IndexParams()
     return cagra.build(params, full), full

@@ -5,6 +5,7 @@ cross_component_nn}.cuh).
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -77,6 +78,75 @@ def brute_force_knn(
             out_d.append(md)
             out_i.append(mi)
     return jnp.concatenate(out_d, axis=0), jnp.concatenate(out_i, axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5, 6, 7, 8))
+def _score_block_dense_q(qs, yb, filter_bits, filter_nbits, col0, kb,
+                         metric_val, minim, oor):
+    from raft_tpu.neighbors.common import filter_keep
+
+    d = sparse_distance._pairwise(qs, yb, metric_val, 2.0, None, None)
+    sentinel = jnp.inf if minim else -jnp.inf
+    cols = col0 + jnp.arange(yb.shape[0], dtype=jnp.int32)
+    if filter_bits is not None:
+        keep = filter_keep(filter_bits, filter_nbits,
+                           jnp.broadcast_to(cols[None, :], d.shape),
+                           out_of_range=oor)
+        d = jnp.where(keep, d, sentinel)
+    dd, ii = select_k(d, kb, select_min=minim)
+    # global doc ids; sentinel slots (padding / filtered-out) stay -1
+    ids = jnp.where(dd == sentinel, -1, col0 + ii.astype(jnp.int32))
+    return dd, ids
+
+
+def brute_force_knn_dense_queries(
+    queries, docs: CSR, k: int, metric="inner_product",
+    prefilter=None, block_rows: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN of DENSE query rows against a sparse CSR document
+    matrix — the hybrid plan's lexical leg (ROADMAP 6(a)): the query
+    batch is small and dense (the vocab slice of a hybrid query), the
+    documents stay sparse at rest and densify one row block at a time.
+    ``prefilter`` composes exactly like the dense scans (filter_keep
+    over GLOBAL doc ids, so serve's tombstone masks work unchanged);
+    dropped and padding slots return id -1 at the sentinel distance.
+
+    Returns (distances [m, k], indices [m, k]), best-first.
+    """
+    from raft_tpu.neighbors.common import as_filter, knn_merge_parts
+
+    metric = sparse_distance.check_sparse_metric(metric)
+    minim = is_min_close(metric)
+    queries = jnp.asarray(queries)
+    n = docs.shape[0]
+    if not 0 < k <= n:
+        raise ValueError(f"k={k} out of range for doc count {n}")
+    filt = as_filter(prefilter)
+    bits = getattr(filt, "bitset", None)
+    oor = getattr(filt, "out_of_range", "drop")
+    part_d, part_i = [], []
+    for c0 in range(0, n, block_rows):
+        c1 = min(c0 + block_rows, n)
+        yb = sparse_distance.densify_block(docs, c0, c1)
+        dd, ii = _score_block_dense_q(
+            queries, yb,
+            None if bits is None else bits.bits,
+            None if bits is None else int(bits.n_bits),
+            jnp.int32(c0), min(k, c1 - c0), int(metric), bool(minim), oor)
+        if dd.shape[1] < k:  # tiny tail block: pad to k for stacking
+            pad = k - dd.shape[1]
+            fill = jnp.inf if minim else -jnp.inf
+            dd = jnp.pad(dd, ((0, 0), (0, pad)), constant_values=fill)
+            ii = jnp.pad(ii, ((0, 0), (0, pad)), constant_values=-1)
+        part_d.append(dd)
+        part_i.append(ii)
+    if len(part_d) == 1:
+        return part_d[0], part_i[0]
+    # ids are already global (offset applied in-kernel): no translations
+    md, mi = knn_merge_parts(jnp.stack(part_d), jnp.stack(part_i), k,
+                             select_min=minim)
+    sentinel = jnp.inf if minim else -jnp.inf
+    return md, jnp.where(md == sentinel, -1, mi)
 
 
 def knn_graph(

@@ -97,6 +97,15 @@ OPTIONAL_STAGES = [
       "--ab-obs", "--out", "FABRIC_r13.json",
       "--federate-out", "OBS_r13/FEDERATED_r13.json",
       "--obs-snapshot", "FABRIC_r13.obs.json"], 1200),
+    # graft-plan acceptance (ISSUE 20): compiled-plan serving vs the
+    # legacy library dispatch at identical batch shapes (QPS/recall/
+    # retrace columns + bitwise verdict), plus the hybrid dense+sparse
+    # score_fuse plan served end-to-end vs a fused numpy oracle
+    ("plan_ab",
+     [PY, "scripts/serve_loadgen.py", "--plan-ab", "--n", "20000",
+      "--dim", "64", "--n-lists", "16", "--k", "10",
+      "--query-pool", "256", "--max-batch-rows", "32",
+      "--duration-s", "10", "--out", "PLAN_r20.json"], 900),
     # graft-helm acceptance (ISSUE 18): the self-healing chaos curve —
     # primary-vs-p2c balancer A/B at matched topology, then a scripted
     # slow/flap/permanent-dead schedule under the HelmController with a

@@ -35,9 +35,18 @@ scrapes every worker's metrics registry through the
 ``collect_metrics`` RPC at the end of the run and archives the merged
 fleet snapshot (JSON + Prometheus text).
 
-Wired as the optional ``serve_loadgen`` / ``fabric_loadgen`` stages of
-``scripts/r5_measure_all.py`` (pass ``--serve`` there, or select with
-``--only``).
+``--plan-ab`` runs the graft-plan acceptance A/B (ISSUE 20,
+docs/plans.md): the compiled-plan serving path vs the legacy library
+dispatch it replaced, at identical batch shapes on the same
+ivf_pq/rabitq index — QPS / recall@k / steady-state retrace columns
+plus the bitwise verdict, then the hybrid dense+sparse ``score_fuse``
+plan served end-to-end through the batcher against a fused numpy
+oracle. Emits ``PLAN_r20.json`` and exits non-zero if any acceptance
+bar fails.
+
+Wired as the optional ``serve_loadgen`` / ``fabric_loadgen`` /
+``plan_ab`` stages of ``scripts/r5_measure_all.py`` (pass ``--serve``
+there, or select with ``--only``).
 
 Examples:
     python scripts/serve_loadgen.py --n 20000 --dim 64 --algo ivf_flat \
@@ -215,6 +224,14 @@ def main() -> int:
     ap.add_argument("--drift-floor-bp", type=int, default=50,
                     help="loosened serve_probe_floor budget (bp) for "
                          "the retune leg")
+    ap.add_argument("--plan-ab", action="store_true",
+                    help="graft-plan A/B (ISSUE 20): serve through the "
+                         "compiled-plan dispatch vs the legacy library "
+                         "entry point at identical batch shapes — "
+                         "QPS/recall/retrace columns + bitwise verdict, "
+                         "plus the hybrid dense+sparse score_fuse plan "
+                         "served end-to-end vs a fused numpy oracle "
+                         "(PLAN_r20.json)")
     ap.add_argument("--out", default=None,
                     help="report path (default SERVE_r05.json, or "
                          "FABRIC_r13.json with --fabric)")
@@ -257,6 +274,8 @@ def main() -> int:
         if obs.mode() == "off" and not os.environ.get("RAFT_TPU_OBS"):
             obs.set_mode("on")    # the recall gauges ARE the drill signal
         return _run_drift(args, ks, rng, obs, serve)
+    if args.plan_ab:
+        return _run_plan_ab(args, ks, rng, obs, serve)
     dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
 
     if args.out is None:
@@ -590,6 +609,151 @@ def _mean_probed(before, after):
         total += d
         probes += d * rung
     return (probes / total) if total else None
+
+
+def _run_plan_ab(args, ks, rng, obs, serve) -> int:
+    """graft-plan A/B (ISSUE 20; docs/plans.md): the compiled-plan
+    serving path vs the legacy library dispatch it replaced, measured
+    at identical batch shapes on the SAME index — QPS, recall@k vs
+    exact ground truth, steady-state retraces (the GL007 hook), and
+    the bitwise verdict the test matrix pins; then the hybrid
+    dense+sparse ``score_fuse`` plan served end-to-end through the
+    batcher against a fused numpy oracle. Artifact: PLAN_r20.json."""
+    from raft_tpu.neighbors import brute_force, hybrid, ivf_pq
+
+    k = max(ks)
+    out = args.out or "PLAN_r20.json"
+    B = int(min(args.max_batch_rows, 32))
+    window_s = max(args.duration_s / 2, 1.0)
+    dataset = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+    reps = max(1, args.query_pool // B)
+    pool = rng.standard_normal((reps * B, args.dim)).astype(np.float32)
+    _, ti = brute_force.knn(pool, dataset, k, metric="sqeuclidean")
+    truth = np.asarray(ti)
+
+    def recall(ids):
+        return float(np.mean([
+            len(set(map(int, ids[r])) & set(map(int, truth[r]))) / k
+            for r in range(ids.shape[0])]))
+
+    # rabitq + dataset kept: the serving plan is the multi-stage
+    # refined_tiered variant — the richest legacy path to A/B against
+    bp = ivf_pq.IndexParams(
+        n_lists=args.n_lists, pq_dim=max(args.dim // 8, 4),
+        metric="sqeuclidean", cache_dtype="rabitq")
+    sp = ivf_pq.SearchParams(n_probes=max(4, args.n_lists // 2))
+
+    srv = serve.Server(serve.ServeParams(
+        max_batch_rows=B, max_wait_ms=args.max_wait_ms, max_k=k))
+    t_build = time.perf_counter()
+    srv.create_index("default", dataset, algo="ivf_pq", build_params=bp,
+                     search_params=sp, refine_ratio=16)
+    build_s = time.perf_counter() - t_build
+    h = srv.registry.get("default").handle
+    print(f"plan-ab: ivf_pq/rabitq n={args.n} d={args.dim} "
+          f"n_lists={args.n_lists} k={k} B={B} "
+          f"(build+warmup {build_s:.1f}s)", flush=True)
+
+    def timed(fn):
+        # one untimed pass settles one-time shape work AND collects the
+        # answer ids; the timed window then loops the pool
+        parts = [np.asarray(fn(pool[b * B:(b + 1) * B])[1])
+                 for b in range(reps)]
+        ids = np.concatenate(parts, axis=0)
+        tr0 = serve.total_trace_count()
+        rows = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window_s:
+            for b in range(reps):
+                fn(pool[b * B:(b + 1) * B])
+                rows += B
+        dt = time.perf_counter() - t0
+        return {"qps": round(rows / dt, 1),
+                "recall_at_k": round(recall(ids), 4),
+                "retraces": serve.total_trace_count() - tr0}, ids
+
+    plan_col, plan_ids = timed(lambda q: srv.search(q, k))
+    rr = h.pipeline_rr()
+    legacy_col, legacy_ids = timed(
+        lambda q: ivf_pq.search_refined(sp, h.index, q, k,
+                                        refine_ratio=rr,
+                                        dataset=dataset))
+    bitwise = bool(np.array_equal(plan_ids, legacy_ids))
+    srv.close()
+
+    # hybrid score_fuse leg: served end-to-end through the batcher,
+    # recall vs the fused numpy oracle over the SAME rows
+    dd = max(args.dim // 4, 8)
+    vocab = args.dim
+    n_h = int(min(args.n, 4096))
+    hr = np.random.default_rng(args.seed + 5)
+    dense = hr.standard_normal((n_h, dd)).astype(np.float32)
+    spr = hr.standard_normal((n_h, vocab)).astype(np.float32)
+    spr[hr.random((n_h, vocab)) > 0.15] = 0.0
+    hx = np.concatenate([dense, spr], axis=1)
+    m_h = min(reps * B, 4 * B)
+    hq = np.concatenate([
+        hr.standard_normal((m_h, dd)).astype(np.float32),
+        np.where(hr.random((m_h, vocab)) < 0.2,
+                 hr.standard_normal((m_h, vocab)), 0).astype(np.float32),
+    ], axis=1)
+    wd, ws = 0.8, 1.2
+    srv2 = serve.Server(serve.ServeParams(
+        max_batch_rows=B, max_wait_ms=args.max_wait_ms, max_k=k))
+    fuse_expand = 16  # each leg over-fetches k*16 before the fuse
+    srv2.create_index(
+        "default", hx, algo="hybrid",
+        build_params=hybrid.IndexParams(dense_dim=dd, w_dense=wd,
+                                        w_sparse=ws),
+        search_params=hybrid.SearchParams(fuse_expand=fuse_expand))
+    hyb_parts = []
+    for b in range(0, m_h, B):
+        hyb_parts.append(np.asarray(srv2.search(hq[b:b + B], k)[1]))
+    tr0 = serve.total_trace_count()
+    for b in range(0, m_h, B):        # steady-state pass: zero retraces
+        srv2.search(hq[b:b + B], k)
+    hyb_retraces = serve.total_trace_count() - tr0
+    srv2.close()
+    hyb_ids = np.concatenate(hyb_parts, axis=0)
+    fused = wd * (hq[:, :dd] @ dense.T) + ws * (hq[:, dd:] @ spr.T)
+    oids = np.argsort(-fused, axis=1)[:, :k]
+    hyb_recall = float(np.mean([
+        len(set(map(int, hyb_ids[r])) & set(map(int, oids[r]))) / k
+        for r in range(m_h)]))
+
+    acceptance = {
+        "bitwise_plan_vs_legacy": bitwise,
+        "plan_zero_retraces": plan_col["retraces"] == 0,
+        "hybrid_recall_ok": hyb_recall > 0.95,
+        "hybrid_zero_retraces": hyb_retraces == 0,
+    }
+    ok = all(acceptance.values())
+    report = {
+        "config": {
+            "n": args.n, "dim": args.dim, "n_lists": args.n_lists,
+            "k": k, "batch_rows": B, "query_pool": reps * B,
+            "n_probes": sp.n_probes, "refine_ratio": int(rr),
+            "cache": "rabitq+tiered", "window_s": window_s,
+            "seed": args.seed,
+        },
+        "arms": {"plan": plan_col, "legacy": legacy_col},
+        "hybrid": {
+            "rows": n_h, "dense_dim": dd, "vocab": vocab,
+            "queries": m_h, "w_dense": wd, "w_sparse": ws,
+            "fuse_expand": fuse_expand,
+            "recall_vs_fused_numpy_oracle": round(hyb_recall, 4),
+            "retraces_steady_state": hyb_retraces,
+        },
+        "acceptance": acceptance,
+        "pass": ok,
+    }
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"arms": report["arms"],
+                      "hybrid_recall": round(hyb_recall, 4),
+                      "acceptance": acceptance, "pass": ok,
+                      "out": out}, indent=1))
+    return 0 if ok else 1
 
 
 def _run_slo(args, ks, rng, obs, serve) -> int:

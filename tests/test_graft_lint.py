@@ -1418,6 +1418,100 @@ def test_cli_gl023_acceptance_seed(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# GL024 hand-wired-pipeline (ISSUE 20; serve/comms must dispatch
+# through plan.compile)
+# ---------------------------------------------------------------------------
+
+
+def _serve_rules(src, path="raft_tpu/serve/fixture.py"):
+    findings = lint_source(textwrap.dedent(src), path)
+    return [f.rule for f in findings if not f.suppressed]
+
+
+def test_gl024_hand_wired_refined_positive():
+    rules = _serve_rules("""
+        from raft_tpu.neighbors import ivf_pq
+
+        def _dispatch(sp, idx, q, k):
+            return ivf_pq.search_refined(sp, idx, q, k, refine_ratio=4)
+    """)
+    assert "GL024" in rules
+
+
+def test_gl024_kernel_internal_positive():
+    rules = _serve_rules("""
+        from raft_tpu.neighbors import ivf_flat
+
+        def _local(q, arrays, k):
+            return ivf_flat._ivf_search(q, *arrays, k)
+    """, path="raft_tpu/comms/fixture.py")
+    assert "GL024" in rules
+
+
+def test_gl024_plan_dispatch_negative():
+    # the same entry point inside a function that compiles a plan is
+    # the plan's executor surface, not a hand-wired pipeline
+    rules = _serve_rules("""
+        from raft_tpu import plan as plan_mod
+        from raft_tpu.neighbors import ivf_pq
+
+        def _dispatch(p, idx, q, k, sp):
+            cp = plan_mod.compile(p, idx, k=k, search_params=sp)
+            if cp is None:
+                return ivf_pq.search_refined(sp, idx, q, k)
+            return cp(q)
+    """)
+    assert "GL024" not in rules
+
+
+def test_gl024_handle_compiled_cache_negative():
+    rules = _serve_rules("""
+        class _Handle:
+            def search_main(self, q, k, rung=None):
+                return self.compiled(int(k), rung)(q)
+    """)
+    assert "GL024" not in rules
+
+
+def test_gl024_outside_serve_comms_negative():
+    # the library entry points themselves (and their tests) are legal —
+    # the rule guards the serving dispatch surface only
+    rules = _serve_rules("""
+        from raft_tpu.neighbors import ivf_pq
+
+        def _helper(sp, idx, q, k):
+            return ivf_pq.search_refined(sp, idx, q, k)
+    """, path="raft_tpu/neighbors/fixture.py")
+    assert "GL024" not in rules
+
+
+def test_gl024_suppression_with_reason():
+    rules = _serve_rules("""
+        from raft_tpu.neighbors import brute_force
+
+        def _side_scan(idx, q, k):
+            # graft-lint: allow-hand-wired-pipeline deliberate single-stage fast path over the side buffer
+            return brute_force.search(idx, q, k)
+    """)
+    assert "GL024" not in rules
+
+
+def test_cli_gl024_acceptance_seed(tmp_path, capsys):
+    """ISSUE 20 acceptance seed: a planted hand-wired serve adapter
+    exits rc 1 naming GL024."""
+    pkg = tmp_path / "raft_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "seeded.py").write_text(
+        "from raft_tpu.neighbors import ivf_pq\n"
+        "def _adapter(sp, idx, q, k):\n"
+        "    return ivf_pq.search_refined(sp, idx, q, k)\n")
+    rc = cli_main(["--format=json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert any(f["rule"] == "GL024" for f in out["findings"]), out
+
+
+# ---------------------------------------------------------------------------
 # graft-race engine: GL010-GL014 (ISSUE 7)
 # ---------------------------------------------------------------------------
 

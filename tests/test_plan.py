@@ -1,0 +1,504 @@
+"""graft-plan suite (ISSUE 20; docs/plans.md).
+
+Four families, all riding tier-1 under the ``plan`` marker:
+
+* IR validation negatives — the stage contracts the hand-wired
+  pipelines enforced by construction (cyclic DAG, filter-after-merge,
+  score_fuse arity, widening shortlists) now fail loudly at plan
+  build time;
+* serialization round-trip — every canonical plan survives
+  ``to_json``/``from_json`` intact (plans ship to sharded workers as
+  JSON, so the wire format is the contract);
+* plan-vs-legacy bitwise matrix — the serve engine's compiled-plan
+  dispatch returns byte-identical (distances AND ids) answers to the
+  library entry points it replaced, across index types x tombstone x
+  prefilter x tiered source, and across an upsert + compact hot-swap;
+* end-to-end acceptance — the hybrid dense+sparse ``score_fuse`` plan
+  against a fused numpy oracle, the sharded rabitq worker/router
+  subplan split bitwise vs single-process ``search_refined``, and
+  zero steady-state retraces over mixed-size post-warmup traffic
+  (the GL007 ``_cache_size`` hook via ``serve.trace_cache_sizes``).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import plan as plan_mod
+from raft_tpu import serve
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.neighbors.common import BitsetFilter
+from raft_tpu.plan import Node, Plan, PlanError
+
+pytestmark = pytest.mark.plan
+
+
+# ---------------------------------------------------------------------------
+# IR validation
+# ---------------------------------------------------------------------------
+
+
+def _scan(nid="s", width="shortlist", **kw):
+    return Node(id=nid, stage="scan", op="ivf_pq.search",
+                params={"width": width}, **kw)
+
+
+def test_canonical_plans_validate():
+    for p in [
+        plan_mod.refined_plan("tiered"),
+        plan_mod.refined_plan("cache"),
+        plan_mod.refined_plan("codes"),
+        plan_mod.hybrid_plan(),
+        plan_mod.sharded_ivf_pq_plan(8, 32, 32, tail="codes"),
+        plan_mod.sharded_ivf_pq_plan(8, 32, 8, local_rerank=True),
+        plan_mod.serve_plan("ivf_pq", "plain"),
+        plan_mod.serve_plan("ivf_pq", "refined_tiered"),
+        plan_mod.serve_plan("ivf_pq", "exact"),
+        plan_mod.serve_plan("brute_force", "raw_refine"),
+        plan_mod.serve_plan("hybrid", "plain"),
+    ]:
+        order = plan_mod.validate(p)
+        assert [n.id for n in order]  # toposort returned every node
+        assert len(order) == len(p.nodes)
+
+
+def test_serialization_round_trip():
+    for p in [
+        plan_mod.refined_plan("tiered"),
+        plan_mod.hybrid_plan(fuse_expand=8),
+        plan_mod.sharded_ivf_pq_plan(10, 40, 40, tail="tiered"),
+    ]:
+        assert plan_mod.from_json(plan_mod.to_json(p)) == p
+        d = plan_mod.to_dict(p)
+        assert d["schema"] == 1
+        assert plan_mod.from_dict(d) == p
+
+
+def test_from_dict_rejects_unknown_schema():
+    d = plan_mod.to_dict(plan_mod.refined_plan("codes"))
+    d["schema"] = 99
+    with pytest.raises(PlanError, match="schema"):
+        plan_mod.from_dict(d)
+
+
+def test_validate_rejects_cycle():
+    p = Plan(name="cyc", nodes=(
+        _scan("s"),
+        Node(id="r1", stage="rerank", op="x", params={"width": "k"},
+             inputs=("s", "r2")),
+        Node(id="r2", stage="rerank", op="x", params={"width": "k"},
+             inputs=("r1",)),
+    ), output="r1")
+    with pytest.raises(PlanError, match="cycle"):
+        plan_mod.validate(p)
+
+
+def test_validate_rejects_filter_after_merge():
+    p = Plan(name="fam", nodes=(
+        _scan("s"),
+        Node(id="m", stage="merge", op="topk", params={"width": "k"},
+             inputs=("s",)),
+        Node(id="f", stage="filter", op="bitset", inputs=("m",)),
+        Node(id="s2", stage="scan", op="x", params={"width": "k"},
+             inputs=("f",)),
+    ), output="s2")
+    with pytest.raises(PlanError, match="cannot feed"):
+        plan_mod.validate(p)
+
+
+def test_validate_rejects_stage_contract_mismatches():
+    # score_fuse with a single candidate leg
+    p = Plan(name="one-leg", nodes=(
+        _scan("s"),
+        Node(id="f", stage="score_fuse", op="weighted",
+             params={"width": "fuse"}, inputs=("s",)),
+        Node(id="m", stage="merge", op="topk", params={"width": "k"},
+             inputs=("f",)),
+    ), output="m")
+    with pytest.raises(PlanError, match="exactly 2 candidate legs"):
+        plan_mod.validate(p)
+
+    # rerank with nothing to rerank
+    p = Plan(name="no-cand", nodes=(
+        Node(id="f", stage="filter", op="bitset"),
+        Node(id="r", stage="rerank", op="x", params={"width": "k"},
+             inputs=("f",)),
+    ), output="r")
+    with pytest.raises(PlanError, match="no candidate input"):
+        plan_mod.validate(p)
+
+    # a rerank that WIDENS its shortlist reads rows the first stage
+    # never scored
+    p = Plan(name="widen", nodes=(
+        Node(id="s", stage="scan", op="x", params={"width": 16}),
+        Node(id="r", stage="rerank", op="x", params={"width": 32},
+             inputs=("s",)),
+    ), output="r")
+    with pytest.raises(PlanError, match="widen"):
+        plan_mod.validate(p)
+
+    # symbolic widths carry the same contract: "shortlist" over "k"
+    p = Plan(name="widen-sym", nodes=(
+        Node(id="s", stage="scan", op="x", params={"width": "k"}),
+        Node(id="r", stage="rerank", op="x",
+             params={"width": "shortlist"}, inputs=("s",)),
+    ), output="r")
+    with pytest.raises(PlanError, match="widens"):
+        plan_mod.validate(p)
+
+
+def test_validate_rejects_malformed_graphs():
+    with pytest.raises(PlanError, match="duplicate"):
+        plan_mod.validate(Plan(name="d", nodes=(_scan("a"), _scan("a")),
+                               output="a"))
+    with pytest.raises(PlanError, match="unknown stage"):
+        plan_mod.validate(Plan(name="st", nodes=(
+            Node(id="a", stage="warp", op="x"),), output="a"))
+    with pytest.raises(PlanError, match="unknown input"):
+        plan_mod.validate(Plan(name="in", nodes=(
+            Node(id="a", stage="scan", op="x", inputs=("ghost",)),),
+            output="a"))
+    with pytest.raises(PlanError, match="not a node"):
+        plan_mod.validate(Plan(name="out", nodes=(_scan("a"),),
+                               output="zzz"))
+    with pytest.raises(PlanError, match="do not feed"):
+        plan_mod.validate(Plan(name="dead", nodes=(
+            _scan("a"), _scan("b")), output="a"))
+    with pytest.raises(PlanError, match="candidate-producing"):
+        plan_mod.validate(Plan(name="outf", nodes=(
+            Node(id="f", stage="filter", op="bitset"),), output="f"))
+    with pytest.raises(PlanError, match="width"):
+        plan_mod.validate(Plan(name="w", nodes=(
+            Node(id="a", stage="scan", op="x",
+                 params={"width": "huge"}),), output="a"))
+
+
+def test_split_at_merge_produces_valid_subplans():
+    p = plan_mod.sharded_ivf_pq_plan(8, 32, 32, tail="codes")
+    head, tail = plan_mod.split_at_merge(p)
+    plan_mod.validate(head)
+    assert tail is not None
+    plan_mod.validate(tail)
+    # the tail re-enters on an identity seed carrying the cut's width
+    seed = [n for n in tail.nodes if n.op == "identity"]
+    assert len(seed) == 1
+    # a tail-less pipeline splits into (whole plan, None)
+    head2, tail2 = plan_mod.split_at_merge(
+        plan_mod.sharded_ivf_pq_plan(8, 32, 8))
+    assert tail2 is None
+    plan_mod.validate(head2)
+
+
+# ---------------------------------------------------------------------------
+# plan-vs-legacy bitwise matrix (serve dispatch vs library entry points)
+# ---------------------------------------------------------------------------
+
+_N, _DIM, _K, _M = 768, 32, 8, 24
+
+
+def _data(seed=7, n=_N, dim=_DIM, m=_M):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, dim)).astype(np.float32),
+            rng.standard_normal((m, dim)).astype(np.float32))
+
+
+def _keep_filter(n, drop_ids):
+    bs = Bitset(n)
+    if len(drop_ids):
+        bs.set(np.asarray(drop_ids, np.int64), False)
+    return BitsetFilter(bs)
+
+
+_MATRIX = {
+    # algo key -> (build_params, search_params, refine_ratio)
+    "brute_force": (None, None, 1),
+    "ivf_flat": (ivf_flat.IndexParams(n_lists=8, metric="sqeuclidean"),
+                 ivf_flat.SearchParams(n_probes=4), 1),
+    "ivf_pq": (ivf_pq.IndexParams(n_lists=8, pq_dim=8,
+                                  metric="sqeuclidean"),
+               ivf_pq.SearchParams(n_probes=4), 1),
+    # rabitq cache + dataset kept => the refined_tiered serving plan
+    # (first-stage sign-bit scan + exact-tier rerank)
+    "rabitq": (ivf_pq.IndexParams(n_lists=8, pq_dim=8,
+                                  metric="sqeuclidean",
+                                  cache_dtype="rabitq"),
+               ivf_pq.SearchParams(n_probes=4), 4),
+}
+
+
+def _legacy(algo, h, q, k, prefilter, dataset):
+    """The pre-plan dispatch: the library entry point the serve adapter
+    hand-wired before ISSUE 20, on the SAME index object the handle
+    serves."""
+    if algo == "brute_force":
+        return brute_force.search(h.index, q, k, prefilter=prefilter)
+    if algo == "ivf_flat":
+        return ivf_flat.search(h.search_params, h.index, q, k,
+                               prefilter=prefilter)
+    if algo == "ivf_pq":
+        return ivf_pq.search(h.search_params, h.index, q, k,
+                             prefilter=prefilter)
+    assert algo == "rabitq"
+    return ivf_pq.search_refined(h.search_params, h.index, q, k,
+                                 refine_ratio=h.pipeline_rr(),
+                                 prefilter=prefilter, dataset=dataset)
+
+
+@pytest.mark.parametrize("algo", sorted(_MATRIX))
+def test_plan_vs_legacy_bitwise_matrix(algo):
+    """Serving through the compiled plan is byte-identical — distances
+    AND ids — to the legacy library dispatch, with and without
+    tombstones and user prefilters composed in."""
+    bp, sp, rr = _MATRIX[algo]
+    x, q = _data()
+    serve_algo = "ivf_pq" if algo == "rabitq" else algo
+    drop = np.arange(0, _N, 5)      # user prefilter: every 5th row
+    dead = np.arange(3, _N, 7)      # tombstones: every 7th from 3
+
+    with serve.Server(serve.ServeParams(max_batch_rows=32,
+                                        max_wait_ms=1.0, max_k=_K)) as srv:
+        srv.create_index("ix", x, algo=serve_algo, build_params=bp,
+                         search_params=sp, refine_ratio=rr, warmup=False)
+        h = srv.registry.get("ix").handle
+
+        cases = [
+            ("plain", None, []),
+            ("prefilter", _keep_filter(_N, drop), []),
+        ]
+        for label, filt, tomb in cases:
+            sd, si = srv.search(q, _K, index="ix", prefilter=filt)
+            ld, li = _legacy(algo, h, q, _K, filt, x)
+            assert np.array_equal(np.asarray(si), np.asarray(li)), label
+            assert np.array_equal(np.asarray(sd), np.asarray(ld)), label
+
+        # tombstones: serve composes the delete mask; legacy composes
+        # the equivalent keep-bitset explicitly
+        srv.delete(dead, index="ix")
+        for label, user_drop in [("tombstone", []),
+                                 ("tombstone+prefilter", drop)]:
+            filt = None if not len(user_drop) \
+                else _keep_filter(_N, user_drop)
+            both = np.union1d(dead, np.asarray(user_drop, np.int64)) \
+                if len(user_drop) else dead
+            sd, si = srv.search(q, _K, index="ix", prefilter=filt)
+            ld, li = _legacy(algo, h, q, _K, _keep_filter(_N, both), x)
+            assert np.array_equal(np.asarray(si), np.asarray(li)), label
+            assert np.array_equal(np.asarray(sd), np.asarray(ld)), label
+
+
+def test_plan_vs_legacy_across_upsert_compact_swap():
+    """An upsert + compact hot-swap recompiles the successor
+    generation's plans; the post-swap serving path stays bitwise
+    against legacy dispatch on the swapped-in index, including
+    tombstones laid down after the swap."""
+    x, q = _data(seed=13)
+    bp = ivf_pq.IndexParams(n_lists=8, pq_dim=8, metric="sqeuclidean")
+    sp = ivf_pq.SearchParams(n_probes=4)
+    extra = np.random.default_rng(14).standard_normal(
+        (16, _DIM)).astype(np.float32)
+
+    with serve.Server(serve.ServeParams(max_batch_rows=32,
+                                        max_wait_ms=1.0, max_k=_K)) as srv:
+        srv.create_index("ix", x, algo="ivf_pq", build_params=bp,
+                         search_params=sp, warmup=False)
+        g1 = srv.registry.get("ix")
+        srv.upsert(extra, np.arange(_N, _N + 16), index="ix")
+        srv.compact(index="ix", wait=True)
+        g2 = srv.registry.get("ix")
+        assert g2.handle is not g1.handle   # the swap published a successor
+        h = g2.handle
+        n2 = _N + 16
+
+        sd, si = srv.search(q, _K, index="ix")
+        ld, li = ivf_pq.search(h.search_params, h.index, q, _K)
+        assert np.array_equal(np.asarray(si), np.asarray(li))
+        assert np.array_equal(np.asarray(sd), np.asarray(ld))
+
+        dead = np.arange(0, n2, 9)
+        srv.delete(dead, index="ix")
+        sd, si = srv.search(q, _K, index="ix")
+        ld, li = ivf_pq.search(h.search_params, h.index, q, _K,
+                               prefilter=_keep_filter(n2, dead))
+        assert np.array_equal(np.asarray(si), np.asarray(li))
+        assert np.array_equal(np.asarray(sd), np.asarray(ld))
+
+
+# ---------------------------------------------------------------------------
+# sharded rabitq: worker subplan + router tail vs single-process
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_rabitq_bitwise_vs_single_process(eight_device_mesh):
+    """PR 10 leftover: rabitq-cached shards route through the per-shard
+    first-stage subplan + router-side codes rerank tail — bitwise
+    (ids AND distances) against single-process ``search_refined`` at
+    exhaustive probing."""
+    from raft_tpu.comms import sharded
+
+    rng = np.random.default_rng(0)
+    n, dim, k = 2048, 32, 10
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((24, dim)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=8, metric="sqeuclidean",
+                           cache_dtype="rabitq"), x)
+    sp = ivf_pq.SearchParams(n_probes=8)
+
+    rd, ri = ivf_pq.search_refined(sp, idx, q, k, refine_ratio=4)
+    sd, si = sharded.sharded_ivf_pq_search(sp, idx, q, k,
+                                           eight_device_mesh,
+                                           refine_ratio=4)
+    assert np.array_equal(np.asarray(ri), np.asarray(si))
+    assert np.array_equal(np.asarray(rd), np.asarray(sd))
+
+    # refine_ratio=1 serves the sign-bit estimates directly
+    pd, pi = sharded.sharded_ivf_pq_search(sp, idx, q, k,
+                                           eight_device_mesh,
+                                           refine_ratio=1)
+    assert np.asarray(pi).shape == (24, k)
+
+    # a rerank_source swaps the codes tail for the exact tiered tail —
+    # also bitwise against the single-process dataset rerank
+    td, ti = sharded.sharded_ivf_pq_search(sp, idx, q, k,
+                                           eight_device_mesh,
+                                           refine_ratio=4,
+                                           rerank_source=x)
+    xd, xi = ivf_pq.search_refined(sp, idx, q, k, refine_ratio=4,
+                                   dataset=x)
+    assert np.array_equal(np.asarray(ti), np.asarray(xi))
+    assert np.array_equal(np.asarray(td), np.asarray(xd))
+
+    # degraded answers still compose: the pre-merge hook masks invalid
+    # lanes before the collective, coverage reports the healthy fraction
+    _, pii, cov = sharded.sharded_ivf_pq_search(
+        sp, idx, q, k, eight_device_mesh, refine_ratio=4,
+        partial_ok=True)
+    assert float(np.asarray(cov)) == 1.0
+    assert np.array_equal(np.asarray(pii), np.asarray(ri))
+
+
+# ---------------------------------------------------------------------------
+# hybrid dense+sparse score_fuse plan (ROADMAP 6(a))
+# ---------------------------------------------------------------------------
+
+
+def _hybrid_rows(count, dd, vocab, r, density=0.15):
+    dense = r.standard_normal((count, dd)).astype(np.float32)
+    sp = r.standard_normal((count, vocab)).astype(np.float32)
+    sp[r.random((count, vocab)) > density] = 0.0
+    return np.concatenate([dense, sp], axis=1)
+
+
+def test_hybrid_plan_recall_vs_fused_oracle():
+    from raft_tpu.neighbors import hybrid
+
+    rng = np.random.default_rng(1)
+    n, dd, vocab, k, m = 600, 16, 64, 10, 24
+    x = _hybrid_rows(n, dd, vocab, rng, density=0.12)
+    q = _hybrid_rows(m, dd, vocab, rng, density=0.2)
+    wd, ws = 0.7, 1.3
+    idx = hybrid.build(
+        hybrid.IndexParams(dense_dim=dd, w_dense=wd, w_sparse=ws), x)
+    d, i = hybrid.search(hybrid.SearchParams(fuse_expand=8), idx, q, k)
+    d, i = np.asarray(d), np.asarray(i)
+
+    fused = wd * (q[:, :dd] @ x[:, :dd].T) + ws * (q[:, dd:] @ x[:, dd:].T)
+    oracle = np.argsort(-fused, axis=1)[:, :k]
+    rec = np.mean([len(set(i[r_]) & set(oracle[r_])) / k
+                   for r_ in range(m)])
+    assert rec > 0.95
+    # returned scores ARE the fused scores of the returned ids
+    assert np.max(np.abs(d - np.take_along_axis(fused, i, axis=1))) < 1e-4
+
+    # prefilter composes into BOTH legs upstream of the fuse
+    filt = _keep_filter(n, np.arange(0, n, 3))
+    _, fi = hybrid.search(hybrid.SearchParams(fuse_expand=8), idx, q, k,
+                          prefilter=filt)
+    assert not np.any(np.asarray(fi) % 3 == 0)
+
+
+def test_hybrid_served_end_to_end():
+    """The score_fuse plan serves through the normal batcher/registry/
+    tombstone machinery: recall vs the fused numpy oracle holds before
+    and after delete + upsert traffic, and deleted rows never
+    resurface."""
+    from raft_tpu.neighbors import hybrid
+
+    rng = np.random.default_rng(3)
+    n, dd, vocab, k = 320, 12, 48, 6
+    x = _hybrid_rows(n, dd, vocab, rng)
+    q = _hybrid_rows(16, dd, vocab, rng)
+    wd, ws = 0.8, 1.2
+
+    def fused_oracle(rows, qq):
+        return (wd * (qq[:, :dd] @ rows[:, :dd].T)
+                + ws * (qq[:, dd:] @ rows[:, dd:].T))
+
+    with serve.Server(serve.ServeParams(max_batch_rows=16,
+                                        max_wait_ms=1.0, max_k=8)) as srv:
+        srv.create_index(
+            "h", x, algo="hybrid",
+            build_params=hybrid.IndexParams(dense_dim=dd, w_dense=wd,
+                                            w_sparse=ws))
+        _, i = srv.search(q, k, index="h")
+        oracle = fused_oracle(x, q)
+        oids = np.argsort(-oracle, axis=1)[:, :k]
+        rec = np.mean([len(set(i[r]) & set(oids[r])) / k
+                       for r in range(q.shape[0])])
+        assert rec > 0.95
+
+        srv.delete(np.asarray(oids[:, 0]), index="h")
+        new_rows = _hybrid_rows(8, dd, vocab, rng)
+        srv.upsert(new_rows, np.arange(n, n + 8), index="h")
+        _, i2 = srv.search(q, k, index="h")
+        all_rows = np.concatenate([x, new_rows], axis=0)
+        o2 = fused_oracle(all_rows, q)
+        o2[:, oids[:, 0]] = -np.inf          # deletes are global
+        oids2 = np.argsort(-o2, axis=1)[:, :k]
+        rec2 = np.mean([len(set(i2[r]) & set(oids2[r])) / k
+                        for r in range(q.shape[0])])
+        assert rec2 > 0.95
+        assert not any(oids[r, 0] in set(i2[r])
+                       for r in range(q.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state retraces (GL007, serving edition)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_plan_traffic_zero_steady_state_retraces():
+    """Warmup walks the compiled plans over the (bucket, k, rung)
+    ladder; a mixed-size post-warmup traffic stream with tombstones
+    and prefilters must not grow ANY tracked trace cache."""
+    x, _ = _data(seed=21)
+    rng = np.random.default_rng(22)
+    bp = ivf_pq.IndexParams(n_lists=8, pq_dim=8, metric="sqeuclidean")
+    sp = ivf_pq.SearchParams(n_probes=4)
+
+    with serve.Server(serve.ServeParams(max_batch_rows=32,
+                                        max_wait_ms=1.0, max_k=_K)) as srv:
+        srv.create_index("ix", x, algo="ivf_pq", build_params=bp,
+                         search_params=sp, warmup=True)
+        filt = _keep_filter(_N, np.arange(0, _N, 11))
+        # settle pass: first traffic after warmup may pay one-time
+        # shape visits (e.g. the composed-filter upload)
+        for m in (1, 3, 8, 16):
+            qq = rng.standard_normal((m, _DIM)).astype(np.float32)
+            srv.search(qq, _K, index="ix")
+            srv.search(qq, _K, index="ix", prefilter=filt)
+        srv.delete(np.arange(0, _N, 13), index="ix")
+        srv.search(rng.standard_normal((4, _DIM)).astype(np.float32),
+                   _K, index="ix")
+
+        before = serve.trace_cache_sizes()
+        for m in (2, 5, 7, 12, 16, 1, 9):
+            qq = rng.standard_normal((m, _DIM)).astype(np.float32)
+            srv.search(qq, _K, index="ix")
+            srv.search(qq, _K, index="ix", prefilter=filt)
+        after = serve.trace_cache_sizes()
+        growth = {kk: after[kk] - before.get(kk, 0)
+                  for kk in after if after[kk] != before.get(kk, 0)}
+        assert not growth, f"steady-state retraces: {growth}"

@@ -195,6 +195,70 @@ def test_rung_params_override_on_non_adaptive_ivf():
     assert verbatim is sp
 
 
+def test_exact_tier_oracle_debiases_quantized_overscore():
+    """ROADMAP 9(a): a quantized oracle scores its own quantization
+    error as ground truth — candidates IT mis-ranks look "matched"
+    whenever serving mis-ranks them the same way, so the recall
+    estimate reads high exactly where it matters.  When the generation
+    carries an exact tier (``dataset=`` / a RerankSource), the oracle
+    rung becomes the exact-rerank PLAN (``"exact"``): exhaustive
+    probing + exact re-rank, whose answers track true recall."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force, ivf_pq
+
+    rng = np.random.default_rng(11)
+    n, dim, k = 2048, 32, 8
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((48, dim)).astype(np.float32)
+    _, ti = brute_force.knn(q, x, k, metric="sqeuclidean")
+    truth = [set(map(int, row)) for row in np.asarray(ti)]
+
+    bp = ivf_pq.IndexParams(n_lists=16, pq_dim=4, metric="sqeuclidean")
+    sp = ivf_pq.SearchParams(n_probes=4, local_recall_target=1.0)
+
+    def overlap(ids, oracle_sets):
+        ids = np.asarray(ids)
+        return float(np.mean([
+            len(set(map(int, ids[r])) & oracle_sets[r]) / k
+            for r in range(ids.shape[0])]))
+
+    with serve.Server(_params(warmup=False)) as srv:
+        # generation WITH the exact tier (dataset kept)
+        srv.create_index("a", x, algo="ivf_pq", build_params=bp,
+                         search_params=sp, refine_ratio=32, warmup=False)
+        ha = srv.registry.get("a").handle
+        # same index WITHOUT an exact tier: the quantizer is all it has
+        srv.add_index("b", ha.index, algo="ivf_pq", search_params=sp,
+                      warmup=False)
+        hb = srv.registry.get("b").handle
+
+        # rung selection: the tier flips the oracle to the exact plan
+        assert ha.oracle_rung() == "exact"
+        assert hb.oracle_rung() == 16
+
+        qd = jnp.asarray(q)
+        _, served = hb.compiled(k, None)(qd)
+        _, quant_oracle = hb.compiled(k, hb.oracle_rung())(qd)
+        _, exact_oracle = ha.compiled(k, ha.oracle_rung())(qd)
+
+        exact_sets = [set(map(int, row)) for row in np.asarray(exact_oracle)]
+        quant_sets = [set(map(int, row)) for row in np.asarray(quant_oracle)]
+
+        # the exact-tier oracle IS (near) ground truth; the quantized
+        # oracle is not even close on a pq_dim=4 quantizer
+        assert overlap(np.asarray(exact_oracle), truth) > 0.95
+        assert overlap(np.asarray(quant_oracle), truth) < 0.8
+
+        true_recall = overlap(served, truth)
+        quant_scored = overlap(served, quant_sets)
+        exact_scored = overlap(served, exact_sets)
+        # quantized oracle OVER-scores the served answers...
+        assert quant_scored > true_recall + 0.1
+        # ...the exact-tier oracle does not (tracks true recall)
+        assert abs(exact_scored - true_recall) < 0.05
+
+
 # ---------------------------------------------------------------------------
 # QualityMonitor closed loop (stub serving unit)
 # ---------------------------------------------------------------------------
